@@ -1,0 +1,21 @@
+"""mmlspark_tpu — a TPU-native framework with the capabilities of mmlspark.
+
+A from-scratch re-design of the reference (dbanda/mmlspark, a Spark/JVM
+library bridging native C++ ML engines over SWIG/JNI) for TPU hardware:
+the histogram GBDT engine is built directly in JAX/XLA/Pallas, distributed
+training uses compiler-scheduled ICI/DCN collectives over a
+``jax.sharding.Mesh`` instead of LightGBM's raw TCP socket allreduce, and
+DNN inference transformers run via ``jax.jit``.  The user-facing API mirrors
+mmlspark's stage names and params so existing pipelines port directly.
+
+See SURVEY.md at the repo root for the reference layer map this build tracks.
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import (DataTable, Pipeline, PipelineModel, Estimator, Transformer,
+                   Model)
+
+__all__ = ["core", "DataTable", "Pipeline", "PipelineModel", "Estimator",
+           "Transformer", "Model", "__version__"]

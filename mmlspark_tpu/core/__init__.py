@@ -1,0 +1,23 @@
+from .params import (Param, Params, TypeConverters, HasInputCol, HasOutputCol,
+                     HasInputCols, HasOutputCols, HasFeaturesCol, HasLabelCol,
+                     HasPredictionCol, HasProbabilityCol, HasRawPredictionCol,
+                     HasWeightCol, HasValidationIndicatorCol, HasSeed)
+from .schema import DataTable, to_table, from_table, features_matrix
+from .pipeline import (PipelineStage, Transformer, Estimator, Model, Pipeline,
+                       PipelineModel, STAGE_REGISTRY)
+from .mesh import (build_mesh, get_mesh, use_mesh, distributed_initialize,
+                   DATA_AXIS, FEATURE_AXIS)
+from .utils import ClusterUtil, FaultToleranceUtils, StopWatch
+
+__all__ = [
+    "Param", "Params", "TypeConverters", "HasInputCol", "HasOutputCol",
+    "HasInputCols", "HasOutputCols", "HasFeaturesCol", "HasLabelCol",
+    "HasPredictionCol", "HasProbabilityCol", "HasRawPredictionCol",
+    "HasWeightCol", "HasValidationIndicatorCol", "HasSeed",
+    "DataTable", "to_table", "from_table", "features_matrix",
+    "PipelineStage", "Transformer", "Estimator", "Model", "Pipeline",
+    "PipelineModel", "STAGE_REGISTRY",
+    "build_mesh", "get_mesh", "use_mesh", "distributed_initialize",
+    "DATA_AXIS", "FEATURE_AXIS",
+    "ClusterUtil", "FaultToleranceUtils", "StopWatch",
+]

@@ -1,0 +1,64 @@
+"""Structural fuzzing harness.
+
+Re-creation of the reference's signature testing idea (SURVEY.md §4;
+core/test/fuzzing/Fuzzing.scala, expected path, UNVERIFIED): every public
+stage declares *test objects* — an instance plus fitting/transform data —
+and from that single declaration the harness derives, automatically:
+
+* **SerializationFuzzing** — save/load round-trip of the stage (and of the
+  fitted model for estimators), then re-fit / re-transform and compare.
+* **ExperimentFuzzing** — fit→transform smoke execution.
+
+A meta-check (tests/test_fuzzing.py) asserts every class in
+``STAGE_REGISTRY`` has a registered test-object provider, so coverage is
+enforced structurally exactly as the reference's "FuzzingTest" does by
+reflecting over the jar.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .pipeline import Estimator, PipelineStage, Transformer
+from .schema import TableLike
+
+
+@dataclass
+class TestObject:
+    """One fuzzing scenario: a stage plus the data to exercise it with."""
+    stage: PipelineStage
+    fitting_data: Optional[TableLike] = None     # estimators
+    transform_data: Optional[TableLike] = None   # transformers / fitted models
+    #: columns whose values must round-trip exactly through save/load re-runs
+    compare_cols: Optional[List[str]] = None
+    #: tolerance for numeric comparison
+    tol: float = 1e-6
+
+
+# class name -> provider returning scenarios
+_PROVIDERS: Dict[str, Callable[[], List[TestObject]]] = {}
+
+#: stage class names exempt from fuzzing (abstract shims, external-IO stages
+#: that cannot run hermetically).  Every exemption must carry a reason.
+EXEMPT: Dict[str, str] = {}
+
+
+def fuzzing_objects(cls_name: str):
+    """Decorator registering a test-object provider for a stage class."""
+    def deco(fn: Callable[[], List[TestObject]]):
+        _PROVIDERS[cls_name] = fn
+        return fn
+    return deco
+
+
+def exempt(cls_name: str, reason: str) -> None:
+    EXEMPT[cls_name] = reason
+
+
+def get_provider(cls_name: str) -> Optional[Callable[[], List[TestObject]]]:
+    return _PROVIDERS.get(cls_name)
+
+
+def all_providers() -> Dict[str, Callable[[], List[TestObject]]]:
+    return dict(_PROVIDERS)

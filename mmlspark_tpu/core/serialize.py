@@ -1,0 +1,164 @@
+"""Stage persistence.
+
+TPU-native analog of the reference's ML persistence layer
+(core/serialize/ConstructorWritable.scala, expected path, UNVERIFIED).  The
+reference serializes stage params as Spark ML metadata plus constructor args
+for complex state; here every stage saves to a directory::
+
+    <path>/metadata.json     {"class": ..., "params": {...}, "version": ...}
+    <path>/arrays.npz        numpy arrays registered via _save_extra helpers
+    <path>/...               arbitrary extra files a stage chooses to write
+
+Stages holding non-Param state override ``_save_extra``/``_load_extra``
+(the moral equivalent of ``ConstructorWritable``'s extra constructor args).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, List
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _json_default(obj: Any):
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError(f"Param value {obj!r} is not JSON-serializable")
+
+
+def save_stage(stage, path: str, overwrite: bool = False) -> None:
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(
+            f"Path {path!r} exists; pass overwrite=True to replace")
+    # Write into a sibling temp dir and swap at the end, so a failed save
+    # never destroys an existing good artifact.
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=".tmp_save_", dir=parent)
+    try:
+        meta = {
+            "class": type(stage).__name__,
+            "module": type(stage).__module__,
+            "format_version": FORMAT_VERSION,
+            "params": {k: v for k, v in stage._iterSetParams()},
+        }
+        with open(os.path.join(tmp, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=_json_default)
+        stage._save_extra(tmp)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.rename(tmp, path)
+
+
+def load_stage(path: str):
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"No stage metadata at {meta_path}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    cls = _resolve_class(meta["class"], meta.get("module"))
+    stage = cls.__new__(cls)
+    # Re-run minimal init: Params.__init__ without subclass positional args.
+    stage._paramMap = {}
+    for k, v in meta.get("params", {}).items():
+        stage.set(k, v)
+    stage._load_extra(path)
+    return stage
+
+
+def _resolve_class(name: str, module: str):
+    from .pipeline import _ALL_STAGES
+    # Prefer an exact (module, name) match; bare-name fallback covers classes
+    # that moved modules between versions.
+    def lookup():
+        cls = _ALL_STAGES.get((module, name))
+        if cls is None:
+            cls = _ALL_STAGES.get(name)
+        return cls
+
+    cls = lookup()
+    if cls is None and module:
+        import importlib
+        importlib.import_module(module)  # registers the class on import
+        cls = lookup()
+    if cls is None:
+        raise KeyError(f"Unknown stage class {name!r} (module {module!r})")
+    return cls
+
+
+def save_stage_list(stages: List[Any], path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    order = []
+    for i, stage in enumerate(stages):
+        name = f"{i}_{type(stage).__name__}"
+        order.append(name)
+        save_stage(stage, os.path.join(path, name), overwrite=True)
+    with open(os.path.join(path, "order.json"), "w") as f:
+        json.dump(order, f)
+
+
+def load_stage_list(path: str) -> List[Any]:
+    with open(os.path.join(path, "order.json")) as f:
+        order = json.load(f)
+    return [load_stage(os.path.join(path, name)) for name in order]
+
+
+def save_arrays(path: str, name: str = "arrays", **arrays: np.ndarray) -> None:
+    np.savez_compressed(os.path.join(path, f"{name}.npz"), **arrays)
+
+
+def load_arrays(path: str, name: str = "arrays") -> Dict[str, np.ndarray]:
+    with np.load(os.path.join(path, f"{name}.npz"), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def save_json(path: str, name: str, obj: Any) -> None:
+    with open(os.path.join(path, f"{name}.json"), "w") as f:
+        json.dump(obj, f, default=_json_default)
+
+
+def load_json(path: str, name: str) -> Any:
+    with open(os.path.join(path, f"{name}.json")) as f:
+        return json.load(f)
+
+
+class StageWriter:
+    """Spark-style ``stage.write().overwrite().save(path)`` shim."""
+
+    def __init__(self, stage):
+        self._stage = stage
+        self._overwrite = False
+
+    def overwrite(self) -> "StageWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        save_stage(self._stage, path, overwrite=self._overwrite)
+
+
+class StageReader:
+    """Spark-style ``Cls.read().load(path)`` shim."""
+
+    def __init__(self, cls):
+        self._cls = cls
+
+    def load(self, path: str):
+        stage = load_stage(path)
+        if not isinstance(stage, self._cls):
+            raise TypeError(
+                f"Loaded {type(stage).__name__}, expected {self._cls.__name__}")
+        return stage

@@ -1,0 +1,87 @@
+"""Shared runtime utilities.
+
+Analogs of the reference's ``core/utils`` (ClusterUtil, FaultToleranceUtils,
+StreamUtilities — expected paths, UNVERIFIED; SURVEY.md §2.1 "Core").
+``ClusterUtil`` counted Spark executors/cores to plan LightGBM's one-task-per-
+executor coalescing; here the unit of parallelism is a mesh axis, so the
+cluster-topology helpers report JAX device/process topology instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Optional, TypeVar
+
+import jax
+
+log = logging.getLogger("mmlspark_tpu")
+
+T = TypeVar("T")
+
+
+class ClusterUtil:
+    """Device/process topology helpers (executor counting analog)."""
+
+    @staticmethod
+    def get_num_devices() -> int:
+        return jax.device_count()
+
+    @staticmethod
+    def get_num_local_devices() -> int:
+        return jax.local_device_count()
+
+    @staticmethod
+    def get_num_processes() -> int:
+        return jax.process_count()
+
+    @staticmethod
+    def get_process_index() -> int:
+        return jax.process_index()
+
+    @staticmethod
+    def get_default_platform() -> str:
+        return jax.default_backend()
+
+
+class FaultToleranceUtils:
+    """Retry helper for flaky IO (model download, HTTP) — reference analog."""
+
+    @staticmethod
+    def retry_with_timeout(fn: Callable[[], T], retries: int = 3,
+                           backoff_s: float = 0.5,
+                           exceptions=(Exception,)) -> T:
+        last: Optional[BaseException] = None
+        for attempt in range(retries):
+            try:
+                return fn()
+            except exceptions as e:  # noqa: PERF203 - retry loop
+                last = e
+                if attempt < retries - 1:
+                    sleep = backoff_s * (2 ** attempt)
+                    log.warning("Attempt %d/%d failed (%s); retrying in %.1fs",
+                                attempt + 1, retries, e, sleep)
+                    time.sleep(sleep)
+        assert last is not None
+        raise last
+
+
+class StopWatch:
+    """Minimal wall-clock timer used by the Timer stage and benchmarks."""
+
+    def __init__(self):
+        self.start = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.start
+
+    def restart(self) -> float:
+        now = time.perf_counter()
+        dt = now - self.start
+        self.start = now
+        return dt
+
+
+def block_until_ready(tree: Any) -> Any:
+    """jax.block_until_ready that tolerates non-array leaves."""
+    return jax.block_until_ready(tree)

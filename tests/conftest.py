@@ -1,0 +1,49 @@
+"""Test bootstrap: force an 8-device virtual CPU platform.
+
+The reference tests distributed behavior on ``local[*]`` with multiple
+partitions (SURVEY.md §4); the TPU-native analog is a host-platform mesh of
+8 virtual CPU devices, so every shard_map/psum path is exercised without TPU
+hardware.  Must run before jax initializes its backends, hence conftest.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The image's sitecustomize imports jax at interpreter startup (before this
+# file runs), so the env var alone is too late — update the live config too.
+# Backends are not yet instantiated at conftest-import time, so this works.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def binary_table(rng):
+    """Small adult-income-shaped binary classification table."""
+    from sklearn.datasets import make_classification
+    X, y = make_classification(
+        n_samples=2000, n_features=20, n_informative=10, n_redundant=4,
+        random_state=7, class_sep=0.8)
+    return {"features": X, "label": y.astype(np.float64)}
+
+
+@pytest.fixture(scope="session")
+def regression_table(rng):
+    from sklearn.datasets import make_regression
+    X, y = make_regression(
+        n_samples=2000, n_features=15, n_informative=10, noise=10.0,
+        random_state=11)
+    return {"features": X, "label": y.astype(np.float64)}
