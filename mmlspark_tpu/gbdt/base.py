@@ -1,0 +1,252 @@
+"""LightGBM-compatible estimator base.
+
+TPU-native analog of the reference's ``LightGBMBase`` shared train()
+orchestration (lightgbm/LightGBMBase.scala, expected path, UNVERIFIED;
+SURVEY.md §3.1).  Where the reference coalesces partitions to one task per
+executor, runs a socket rendezvous and boots the native engine per executor,
+this estimator bins features on host, ships the binned matrix to the device
+mesh, and runs the jitted boosting loop (:mod:`mmlspark_tpu.gbdt.engine`).
+
+Param names mirror the reference's public API (numIterations, learningRate,
+numLeaves, …) so existing mmlspark code ports unchanged.  Cluster-shaped
+params that have no TPU meaning (``useBarrierExecutionMode``, ``numTasks``,
+``numThreads``) are accepted and recorded but do not affect execution.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.params import (Param, Params, TypeConverters, HasFeaturesCol,
+                           HasLabelCol, HasPredictionCol, HasWeightCol,
+                           HasValidationIndicatorCol)
+from ..core.pipeline import Estimator, Model
+from ..core.schema import DataTable, features_matrix
+from ..core import serialize
+from .binning import fit_bin_mapper
+from .booster import Booster
+from .engine import TrainParams, train
+from .objectives import get_objective
+
+
+class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
+                     HasWeightCol, HasValidationIndicatorCol):
+    """Shared LightGBM params — names track the reference's LightGBMParams."""
+
+    numIterations = Param("numIterations", "Number of boosting iterations",
+                          default=100, typeConverter=TypeConverters.toInt)
+    learningRate = Param("learningRate", "Shrinkage rate", default=0.1,
+                         typeConverter=TypeConverters.toFloat)
+    numLeaves = Param("numLeaves", "Max leaves per tree", default=31,
+                      typeConverter=TypeConverters.toInt)
+    maxDepth = Param("maxDepth", "Max tree depth (<=0 means no limit)",
+                     default=-1, typeConverter=TypeConverters.toInt)
+    maxBin = Param("maxBin", "Max number of feature bins", default=255,
+                   typeConverter=TypeConverters.toInt)
+    lambdaL1 = Param("lambdaL1", "L1 regularization", default=0.0,
+                     typeConverter=TypeConverters.toFloat)
+    lambdaL2 = Param("lambdaL2", "L2 regularization", default=0.0,
+                     typeConverter=TypeConverters.toFloat)
+    minSumHessianInLeaf = Param("minSumHessianInLeaf",
+                                "Minimal sum of hessians in one leaf",
+                                default=1e-3,
+                                typeConverter=TypeConverters.toFloat)
+    minDataInLeaf = Param("minDataInLeaf",
+                          "Minimal number of rows in one leaf", default=20,
+                          typeConverter=TypeConverters.toInt)
+    minGainToSplit = Param("minGainToSplit", "Minimal split gain", default=0.0,
+                           typeConverter=TypeConverters.toFloat)
+    baggingFraction = Param("baggingFraction", "Row subsample fraction",
+                            default=1.0, typeConverter=TypeConverters.toFloat)
+    baggingFreq = Param("baggingFreq",
+                        "Resample rows every k iterations (0 disables)",
+                        default=0, typeConverter=TypeConverters.toInt)
+    baggingSeed = Param("baggingSeed", "Bagging seed", default=3,
+                        typeConverter=TypeConverters.toInt)
+    featureFraction = Param("featureFraction",
+                            "Feature subsample fraction per tree",
+                            default=1.0, typeConverter=TypeConverters.toFloat)
+    earlyStoppingRound = Param("earlyStoppingRound",
+                               "Stop if validation metric doesn't improve "
+                               "for this many rounds (0 disables)",
+                               default=0, typeConverter=TypeConverters.toInt)
+    boostFromAverage = Param("boostFromAverage",
+                             "Start scores from the label average",
+                             default=True, typeConverter=TypeConverters.toBool)
+    verbosity = Param("verbosity", "Engine verbosity", default=1,
+                      typeConverter=TypeConverters.toInt)
+    objective = Param("objective", "Training objective", default="regression",
+                      typeConverter=TypeConverters.toString)
+    parallelism = Param("parallelism",
+                        "Tree learner parallelism: serial, data, feature or "
+                        "voting (mapped to mesh axes on TPU)",
+                        default="data", typeConverter=TypeConverters.toString)
+    useBarrierExecutionMode = Param(
+        "useBarrierExecutionMode",
+        "Accepted for API parity; TPU meshes are always gang-scheduled",
+        default=False, typeConverter=TypeConverters.toBool)
+    numTasks = Param("numTasks",
+                     "Accepted for API parity; the mesh shape decides "
+                     "task layout on TPU", default=0,
+                     typeConverter=TypeConverters.toInt)
+    numThreads = Param("numThreads", "Accepted for API parity", default=0,
+                       typeConverter=TypeConverters.toInt)
+    initScoreCol = Param("initScoreCol", "Column with per-row initial scores",
+                         default=None, typeConverter=TypeConverters.toString)
+    featuresShapCol = Param("featuresShapCol",
+                            "Output column for SHAP values (empty disables)",
+                            default="", typeConverter=TypeConverters.toString)
+    seed = Param("seed", "Random seed", default=42,
+                 typeConverter=TypeConverters.toInt)
+    histogramMethod = Param("histogramMethod",
+                            "TPU histogram backend: auto, dot16, onehot, "
+                            "segment", default="auto",
+                            typeConverter=TypeConverters.toString)
+    passThroughArgs = Param("passThroughArgs",
+                            "Raw 'key=value key=value' LightGBM param string "
+                            "recorded into the model file",
+                            default="", typeConverter=TypeConverters.toString)
+
+    def _train_params(self) -> TrainParams:
+        pass_through = {}
+        for tok in self.getPassThroughArgs().split():
+            if "=" in tok:
+                k, _, v = tok.partition("=")
+                pass_through[k] = v
+        return TrainParams(
+            num_iterations=self.getNumIterations(),
+            learning_rate=self.getLearningRate(),
+            num_leaves=self.getNumLeaves(),
+            max_depth=self.getMaxDepth(),
+            max_bin=self.getMaxBin(),
+            lambda_l1=self.getLambdaL1(),
+            lambda_l2=self.getLambdaL2(),
+            min_data_in_leaf=self.getMinDataInLeaf(),
+            min_sum_hessian_in_leaf=self.getMinSumHessianInLeaf(),
+            min_gain_to_split=self.getMinGainToSplit(),
+            bagging_fraction=self.getBaggingFraction(),
+            bagging_freq=self.getBaggingFreq(),
+            feature_fraction=self.getFeatureFraction(),
+            early_stopping_round=self.getEarlyStoppingRound(),
+            boost_from_average=self.getBoostFromAverage(),
+            seed=self.getSeed(),
+            bagging_seed=self.getBaggingSeed(),
+            histogram_method=self.getHistogramMethod(),
+            verbosity=self.getVerbosity(),
+            pass_through=pass_through,
+        )
+
+
+class LightGBMBase(Estimator, LightGBMParams):
+    """Shared fit() orchestration for classifier/regressor/ranker."""
+
+    __abstractstage__ = True
+
+    _default_objective = "regression"
+
+    def _objective_kwargs(self) -> Dict:
+        return {}
+
+    def _prepare_labels(self, y: np.ndarray) -> np.ndarray:
+        return np.asarray(y, np.float64)
+
+    def _make_model(self, booster: Booster) -> "LightGBMModelBase":
+        raise NotImplementedError
+
+    def _grad_fn_override(self, table: DataTable, train_idx, y, w):
+        return None
+
+    def _val_metric(self):
+        return None
+
+    def _fit(self, table: DataTable) -> "LightGBMModelBase":
+        X = features_matrix(table, self.getFeaturesCol())
+        y = self._prepare_labels(table[self.getLabelCol()])
+        n = X.shape[0]
+        wcol = self.getWeightCol()
+        w = np.asarray(table[wcol], np.float64) if wcol else None
+
+        vcol = self.getValidationIndicatorCol()
+        if vcol:
+            val_mask = np.asarray(table[vcol]).astype(bool)
+            train_idx = ~val_mask
+        else:
+            val_mask = None
+            train_idx = np.ones(n, bool)
+
+        obj_name = getattr(self, "_resolved_objective", None) \
+            or self.getObjective() or self._default_objective
+        num_class = getattr(self, "_num_class", 1)
+        if obj_name in ("multiclass", "softmax") and num_class <= 1:
+            num_class = int(np.max(y)) + 1
+        objective = get_objective(obj_name, num_class=num_class,
+                                  **self._objective_kwargs())
+
+        mapper = fit_bin_mapper(X[train_idx], max_bin=self.getMaxBin(),
+                                seed=self.getSeed())
+        bins = mapper.transform(X[train_idx])
+        y_train = y[train_idx]
+        w_train = w[train_idx] if w is not None else None
+
+        val_kwargs = {}
+        if val_mask is not None and val_mask.any():
+            val_kwargs = dict(
+                val_bins=mapper.transform(X[val_mask]),
+                val_labels=y[val_mask],
+                val_weights=w[val_mask] if w is not None else None,
+                val_metric=self._val_metric(),
+            )
+
+        params = self._train_params()
+        feature_names = list(
+            getattr(table[self.getFeaturesCol()], "columns", [])) or None
+        booster = train(
+            bins, y_train, w_train, mapper, objective, params,
+            feature_names=feature_names,
+            grad_fn_override=self._grad_fn_override(
+                table, train_idx, y_train, w_train),
+            **val_kwargs)
+        model = self._make_model(booster)
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+
+class LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
+    """Shared scoring transformer; holds a :class:`Booster`."""
+
+    __abstractstage__ = True
+
+    def __init__(self, booster: Optional[Booster] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._booster = booster
+
+    def getModel(self) -> Booster:
+        """The underlying booster (mmlspark API parity)."""
+        return self._booster
+
+    def getNativeModel(self) -> str:
+        return self._booster.save_native_model_string()
+
+    def saveNativeModel(self, path: str) -> None:
+        """Save in LightGBM text format, loadable by stock LightGBM."""
+        self._booster.save_native_model(path)
+
+    @classmethod
+    def loadNativeModel(cls, path: str) -> "LightGBMModelBase":
+        return cls(booster=Booster.load_native_model(path))
+
+    def getFeatureImportances(self, importance_type: str = "split"):
+        return list(self._booster.feature_importances(importance_type))
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        with open(os.path.join(path, "model.lgb.txt"), "w") as f:
+            f.write(self._booster.save_native_model_string())
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        self._booster = Booster.load_native_model(
+            os.path.join(path, "model.lgb.txt"))

@@ -1,0 +1,132 @@
+"""Quantile feature binning — the framework's BinMapper.
+
+TPU-native analog of LightGBM's ``BinMapper``/``GreedyFindBin`` (invoked by
+the reference through ``LGBM_DatasetCreateFromMat``; SURVEY.md §2.2, §3.1).
+Continuous features are discretized into at most ``max_bin`` integer bins via
+per-feature upper bounds:
+
+* if a feature has ≤ ``max_bin`` distinct values, bounds are midpoints
+  between consecutive distinct values (exact, LightGBM-style);
+* otherwise bounds are weighted quantiles over a sample.
+
+Missing values (NaN) map to a dedicated trailing bin, so split finding can
+route them independently — the static-shape counterpart of LightGBM's
+default-direction handling.  Binning runs on host numpy (it is a one-time
+preprocessing pass, like the reference's executor-side dataset aggregation);
+the binned ``uint8``/``int32`` matrix is what ships to the TPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclass
+class BinMapper:
+    """Per-feature binning spec: ``upper_bounds[f]`` sorted ascending."""
+
+    upper_bounds: List[np.ndarray]   # len f, each (num_bins_f - 1,) finite
+    has_missing: np.ndarray          # (f,) bool
+    num_total_bins: int              # B used for histogram sizing (max over f)
+    missing_bin: int                 # index reserved for NaN (== B - 1)
+
+    @property
+    def num_features(self) -> int:
+        return len(self.upper_bounds)
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        """Map raw features to bin indices ``(n, f)``, NaN → missing_bin."""
+        n, f = X.shape
+        if f != self.num_features:
+            raise ValueError(
+                f"Expected {self.num_features} features, got {f}")
+        out = np.empty((n, f), dtype=np.int32)
+        for j in range(f):
+            col = X[:, j]
+            out[:, j] = np.searchsorted(self.upper_bounds[j], col, side="left")
+            nan_mask = np.isnan(col)
+            if nan_mask.any():
+                out[nan_mask, j] = self.missing_bin
+        return out
+
+    def bin_threshold_value(self, feature: int, bin_idx: int) -> float:
+        """Real-valued threshold for a split at ``bin <= bin_idx``.
+
+        Matches LightGBM's convention of storing the bin upper bound in the
+        model file, so exported models score identically on raw features.
+        """
+        ub = self.upper_bounds[feature]
+        if bin_idx >= len(ub):
+            # split isolating the top/missing bin: everything finite goes left
+            return np.inf
+        return float(ub[bin_idx])
+
+    def feature_infos(self) -> List[str]:
+        """LightGBM model-file ``feature_infos`` entries ([min:max] per feat)."""
+        infos = []
+        for ub in self.upper_bounds:
+            if len(ub) == 0:
+                infos.append("none")
+            else:
+                infos.append(f"[{ub[0]:.6g}:{ub[-1]:.6g}]")
+        return infos
+
+
+def fit_bin_mapper(X: np.ndarray, max_bin: int = 255,
+                   sample_cnt: int = 200000,
+                   min_data_in_bin: int = 3,
+                   seed: int = 0) -> BinMapper:
+    """Learn per-feature bin upper bounds (GreedyFindBin analog).
+
+    ``max_bin`` counts value bins; one extra trailing bin is reserved for
+    missing values, giving ``num_total_bins = max_bin + 1``.
+    """
+    n, f = X.shape
+    if n > sample_cnt:
+        rng = np.random.default_rng(seed)
+        idx = rng.choice(n, size=sample_cnt, replace=False)
+        sample = X[idx]
+    else:
+        sample = X
+    bounds: List[np.ndarray] = []
+    has_missing = np.zeros(f, dtype=bool)
+    for j in range(f):
+        col = sample[:, j]
+        nan = np.isnan(col)
+        has_missing[j] = bool(nan.any())
+        col = col[~nan]
+        bounds.append(_find_bounds(col, max_bin, min_data_in_bin))
+    num_total_bins = max_bin + 1
+    return BinMapper(upper_bounds=bounds, has_missing=has_missing,
+                     num_total_bins=num_total_bins,
+                     missing_bin=num_total_bins - 1)
+
+
+def _find_bounds(col: np.ndarray, max_bin: int,
+                 min_data_in_bin: int) -> np.ndarray:
+    if col.size == 0:
+        return np.empty(0, dtype=np.float64)
+    distinct, counts = np.unique(col, return_counts=True)
+    if len(distinct) <= 1:
+        return np.empty(0, dtype=np.float64)
+    if len(distinct) <= max_bin:
+        # Exact: midpoints between consecutive distinct values, but respect
+        # min_data_in_bin by merging tiny bins (LightGBM does the same).
+        mids = (distinct[:-1] + distinct[1:]) / 2.0
+        if min_data_in_bin > 1 and col.size >= 2 * min_data_in_bin:
+            keep, acc = [], 0
+            for i in range(len(mids)):
+                acc += counts[i]
+                if acc >= min_data_in_bin:
+                    keep.append(mids[i])
+                    acc = 0
+            mids = np.asarray(keep, dtype=np.float64)
+        return np.asarray(mids, dtype=np.float64)
+    # Quantile spacing over the empirical distribution.
+    qs = np.linspace(0, 1, max_bin + 1)[1:-1]
+    cuts = np.quantile(col, qs, method="linear")
+    cuts = np.unique(cuts)
+    return cuts.astype(np.float64)

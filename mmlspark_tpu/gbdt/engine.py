@@ -1,0 +1,238 @@
+"""Boosting loop — the executor-side training orchestration.
+
+TPU-native analog of the reference's executor training loop
+(``TrainUtils.trainLightGBM`` → ``LGBM_BoosterUpdateOneIter`` iterations;
+SURVEY.md §3.1).  One jitted ``boost_step`` fuses grad/hess computation, tree
+growth, and score update on device; the Python loop over iterations handles
+bagging/feature-fraction re-sampling, validation metrics, and early stopping —
+mirroring LightGBM's iteration loop on the host side of the JNI boundary,
+minus the JNI.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .binning import BinMapper, fit_bin_mapper
+from .booster import Booster, HostTree, host_tree_from_arrays
+from .grower import (GrowerConfig, TreeArrays, apply_shrinkage,
+                     grow_tree, predict_tree_binned, _grow_tree_impl)
+from .objectives import Objective, MulticlassObjective
+
+log = logging.getLogger("mmlspark_tpu.gbdt")
+
+
+@dataclass
+class TrainParams:
+    """Engine-level hyper-parameters (host-side; see LightGBMParams analog)."""
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    max_depth: int = -1
+    max_bin: int = 255
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    feature_fraction: float = 1.0
+    early_stopping_round: int = 0
+    boost_from_average: bool = True
+    seed: int = 42
+    bagging_seed: int = 3
+    histogram_method: str = "auto"
+    verbosity: int = 1
+    #: raw passthrough params recorded into the model file (parity with the
+    #: reference's passThroughArgs; engine-known keys override these)
+    pass_through: Dict[str, str] = field(default_factory=dict)
+
+
+@functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"),
+                   donate_argnums=(1,))
+def _boost_step(bins, scores, labels, weights, bag_mask, feature_mask,
+                obj: Objective, cfg: GrowerConfig, lr: float):
+    """One boosting iteration for a single tree (single-class)."""
+    g, h = obj.grad_hess(scores, labels, weights)
+    gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
+    tree, row_leaf = _grow_tree_impl(bins, gh, feature_mask, cfg)
+    scores = scores + lr * tree.leaf_value[row_leaf]
+    tree = apply_shrinkage(tree, lr)
+    return tree, scores
+
+
+@functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr", "k"),
+                   donate_argnums=(1,))
+def _boost_step_class_k(bins, scores, labels, weights, bag_mask, feature_mask,
+                        obj: MulticlassObjective, cfg: GrowerConfig,
+                        lr: float, k: int):
+    """One boosting iteration for class k of a multiclass model."""
+    g, h = obj.grad_hess(scores, labels, weights)
+    gh = jnp.stack([g[:, k] * bag_mask, h[:, k] * bag_mask, bag_mask], axis=1)
+    tree, row_leaf = _grow_tree_impl(bins, gh, feature_mask, cfg)
+    scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
+    tree = apply_shrinkage(tree, lr)
+    return tree, scores
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def _update_val_scores(tree: TreeArrays, val_bins, val_scores, lr,
+                       max_steps: int):
+    return val_scores + lr * predict_tree_binned(tree, val_bins, max_steps)
+
+
+def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
+          mapper: BinMapper, objective: Objective, params: TrainParams,
+          feature_names: Optional[List[str]] = None,
+          val_bins: Optional[np.ndarray] = None,
+          val_labels: Optional[np.ndarray] = None,
+          val_weights: Optional[np.ndarray] = None,
+          val_metric: Optional[Callable] = None,
+          grad_fn_override=None,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """Train a forest.  ``bins``: (n, f) int32 pre-binned features.
+
+    ``grad_fn_override``: optional ``(scores) -> (g, h)`` replacing the
+    objective's grad/hess (used by the ranking objective which closes over
+    query structure).
+    """
+    n, f = bins.shape
+    K = objective.num_model_per_iteration
+    rng = np.random.default_rng(params.seed)
+    bag_rng = np.random.default_rng(params.bagging_seed)
+
+    w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
+    objective.prepare(np.asarray(labels), w)
+    init = objective.init_score(np.asarray(labels), w) \
+        if params.boost_from_average else 0.0
+
+    bins_d = jnp.asarray(bins, jnp.int32)
+    labels_d = jnp.asarray(labels,
+                           jnp.int32 if K > 1 else jnp.float32)
+    weights_d = jnp.asarray(w, jnp.float32)
+    scores = jnp.full((n, K) if K > 1 else (n,), init, jnp.float32)
+
+    cfg = GrowerConfig(
+        num_leaves=params.num_leaves, max_depth=params.max_depth,
+        num_bins=mapper.num_total_bins, lambda_l1=params.lambda_l1,
+        lambda_l2=params.lambda_l2, min_data_in_leaf=params.min_data_in_leaf,
+        min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
+        min_gain_to_split=params.min_gain_to_split,
+        hist_method=params.histogram_method)
+
+    has_val = val_bins is not None and val_metric is not None
+    if has_val:
+        val_bins_d = jnp.asarray(val_bins, jnp.int32)
+        val_scores = jnp.full(
+            (val_bins.shape[0], K) if K > 1 else (val_bins.shape[0],),
+            init, jnp.float32)
+        best_metric, best_iter = np.inf, -1
+
+    ones = jnp.ones(n, jnp.float32)
+    bag_mask = ones
+    full_fmask = jnp.ones(f, jnp.float32)
+    fmask = full_fmask
+
+    trees: List[HostTree] = []
+    stop_iter = params.num_iterations
+    for it in range(params.num_iterations):
+        if params.bagging_freq > 0 and params.bagging_fraction < 1.0 \
+                and it % params.bagging_freq == 0:
+            keep = bag_rng.random(n) < params.bagging_fraction
+            bag_mask = jnp.asarray(keep.astype(np.float32))
+        if params.feature_fraction < 1.0:
+            k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
+            sel = rng.choice(f, size=k_keep, replace=False)
+            m = np.zeros(f, np.float32)
+            m[sel] = 1.0
+            fmask = jnp.asarray(m)
+
+        grew_any = False
+        for k in range(K):
+            if grad_fn_override is not None:
+                g, h = grad_fn_override(scores)
+                gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
+                tree, row_leaf = grow_tree(bins_d, gh, fmask, cfg)
+                scores = scores + params.learning_rate * \
+                    tree.leaf_value[row_leaf]
+                tree = apply_shrinkage(tree, params.learning_rate)
+            elif K > 1:
+                tree, scores = _boost_step_class_k(
+                    bins_d, scores, labels_d, weights_d, bag_mask, fmask,
+                    objective, cfg, params.learning_rate, k)
+            else:
+                tree, scores = _boost_step(
+                    bins_d, scores, labels_d, weights_d, bag_mask, fmask,
+                    objective, cfg, params.learning_rate)
+            nl = int(tree.num_leaves)
+            if nl > 1:
+                grew_any = True
+            trees.append(host_tree_from_arrays(tree, mapper,
+                                               mapper.missing_bin))
+            if has_val:
+                if K == 1:
+                    val_scores = _update_val_scores(
+                        tree, val_bins_d, val_scores,
+                        params.learning_rate, params.num_leaves)
+                else:
+                    val_scores = val_scores.at[:, k].set(_update_val_scores(
+                        tree, val_bins_d, val_scores[:, k],
+                        params.learning_rate, params.num_leaves))
+
+        if not grew_any:
+            if params.verbosity > 0:
+                log.info("No further splits with positive gain; stopping at "
+                         "iteration %d", it)
+            stop_iter = it
+            break
+
+        if has_val:
+            metric = float(val_metric(np.asarray(val_scores),
+                                      np.asarray(val_labels), val_weights))
+            if metric < best_metric - 1e-12:
+                best_metric, best_iter = metric, it
+            elif params.early_stopping_round > 0 and \
+                    it - best_iter >= params.early_stopping_round:
+                if params.verbosity > 0:
+                    log.info("Early stopping at iteration %d "
+                             "(best %d, metric %.6f)", it, best_iter,
+                             best_metric)
+                stop_iter = best_iter + 1
+                trees = trees[:stop_iter * K]
+                break
+        if callbacks:
+            for cb in callbacks:
+                cb(it, trees)
+
+    if trees and params.boost_from_average and init != 0.0:
+        # Bake the init score into the first tree per class so the exported
+        # model is self-contained, as LightGBM does for boost_from_average.
+        for k in range(K):
+            t = trees[k]
+            t.leaf_value = t.leaf_value + init
+            t.internal_value = t.internal_value + init
+
+    engine_params = {
+        "boosting": "gbdt",
+        "objective": objective.model_str,
+        "num_iterations": str(stop_iter),
+        "learning_rate": f"{params.learning_rate:g}",
+        "num_leaves": str(params.num_leaves),
+        "max_depth": str(params.max_depth),
+        "max_bin": str(params.max_bin),
+        **params.pass_through,
+    }
+    booster = Booster(
+        trees, num_class=K, objective_str=objective.model_str,
+        init_score=0.0, feature_names=feature_names,
+        feature_infos=mapper.feature_infos(),
+        max_feature_idx=f - 1, params=engine_params)
+    return booster

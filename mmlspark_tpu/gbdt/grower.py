@@ -1,0 +1,324 @@
+"""Leaf-wise histogram tree grower, fully jit-compatible.
+
+TPU-native replacement for LightGBM's ``SerialTreeLearner``/
+``DataParallelTreeLearner`` (driven by the reference through
+``LGBM_BoosterUpdateOneIter``; SURVEY.md §3.1 hot loop).  Design notes:
+
+* **Static shapes.**  A tree has a fixed budget of ``num_leaves`` leaves and
+  ``num_leaves - 1`` internal nodes; growth is a ``fori_loop`` of
+  ``num_leaves - 1`` split steps with inactive steps masked out via
+  ``lax.cond`` — XLA's answer to LightGBM's dynamic leaf queue.
+* **Leaf membership as a vector.**  Instead of partitioned row indices, a
+  ``row_leaf`` (n,) assignment vector selects the split leaf's rows by mask;
+  leaf-conditional histograms are built from *masked* gradient triples so
+  every step has identical shape and cost.
+* **Histogram subtraction.**  Each split builds one child histogram and
+  derives the sibling by subtraction, exactly like LightGBM.
+* **Leaf numbering parity.**  Splitting leaf ``l`` at step ``i`` creates
+  internal node ``i``; the left child keeps leaf id ``l`` and the right
+  child becomes leaf ``i + 1`` — the same numbering LightGBM uses, so model
+  export is a direct array dump.
+* **Distributed.**  Pass ``axis_name`` when running under ``shard_map`` with
+  rows sharded across the mesh: local histograms are ``psum``-reduced — the
+  ICI-collective replacement for LightGBM's socket ``Network::Allreduce``
+  (SURVEY.md §5.8).  Feature-axis sharding is layered on in
+  :mod:`mmlspark_tpu.gbdt.distributed`.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.histogram import compute_histogram
+
+EPS_GAIN = 1e-10
+
+
+@dataclass(frozen=True)
+class GrowerConfig:
+    """Static hyper-parameters (hashable → usable as a jit static arg)."""
+    num_leaves: int = 31
+    max_depth: int = -1
+    num_bins: int = 256
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    hist_method: str = "auto"
+    axis_name: Optional[str] = None          # data-parallel psum axis
+    feature_axis_name: Optional[str] = None  # feature-parallel axis
+
+
+class TreeArrays(NamedTuple):
+    """One grown tree.  Children encoding matches LightGBM: a child value
+    ``c >= 0`` is an internal node index, ``c < 0`` is leaf ``~c``."""
+    node_feat: jnp.ndarray    # (L-1,) i32
+    node_bin: jnp.ndarray     # (L-1,) i32 threshold bin (<= goes left)
+    node_left: jnp.ndarray    # (L-1,) i32
+    node_right: jnp.ndarray   # (L-1,) i32
+    node_gain: jnp.ndarray    # (L-1,) f32
+    node_value: jnp.ndarray   # (L-1,) f32 internal output (shrinkage applied)
+    node_weight: jnp.ndarray  # (L-1,) f32 sum of hessians
+    node_count: jnp.ndarray   # (L-1,) f32 row count
+    leaf_value: jnp.ndarray   # (L,) f32 (shrinkage applied)
+    leaf_weight: jnp.ndarray  # (L,) f32
+    leaf_count: jnp.ndarray   # (L,) f32
+    num_leaves: jnp.ndarray   # () i32 actual leaves grown
+
+
+class _GrowState(NamedTuple):
+    row_leaf: jnp.ndarray     # (n,) i32
+    leaf_hist: jnp.ndarray    # (L, f, B, 3)
+    leaf_g: jnp.ndarray       # (L,)
+    leaf_h: jnp.ndarray       # (L,)
+    leaf_c: jnp.ndarray       # (L,)
+    leaf_depth: jnp.ndarray   # (L,) i32
+    leaf_parent: jnp.ndarray  # (L,) i32 (-1 for root)
+    leaf_is_right: jnp.ndarray  # (L,) bool
+    best_gain: jnp.ndarray    # (L,) f32 (-inf when leaf can't split)
+    best_feat: jnp.ndarray    # (L,) i32
+    best_bin: jnp.ndarray     # (L,) i32
+    tree: TreeArrays
+
+
+def _threshold_l1(g, l1):
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+
+def _leaf_gain(g, h, cfg: GrowerConfig):
+    t = _threshold_l1(g, cfg.lambda_l1)
+    return jnp.square(t) / (h + cfg.lambda_l2)
+
+
+def _leaf_output(g, h, cfg: GrowerConfig):
+    t = _threshold_l1(g, cfg.lambda_l1)
+    return -t / (h + cfg.lambda_l2)
+
+
+def find_best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
+                    feature_mask: jnp.ndarray, depth_ok,
+                    cfg: GrowerConfig) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                                jnp.ndarray]:
+    """Best (gain, feature, bin) over a (f, B, 3) histogram.
+
+    Mirrors LightGBM's FindBestThreshold: left = bins <= b, validity by
+    min_data_in_leaf / min_sum_hessian, gain = ΔL over the parent leaf.
+    First-occurrence argmax reproduces LightGBM's ascending scan tie-break.
+    """
+    cum = jnp.cumsum(hist, axis=1)           # (f, B, 3)
+    gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
+    gr = parent_g - gl
+    hr = parent_h - hl
+    cr = parent_c - cl
+    valid = ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
+             & (hl >= cfg.min_sum_hessian_in_leaf)
+             & (hr >= cfg.min_sum_hessian_in_leaf))
+    # cannot split on the last bin (nothing to the right)
+    valid = valid & (jnp.arange(hist.shape[1]) < hist.shape[1] - 1)[None, :]
+    parent_gain = _leaf_gain(parent_g, parent_h, cfg)
+    gains = (_leaf_gain(gl, hl, cfg) + _leaf_gain(gr, hr, cfg) - parent_gain)
+    gains = jnp.where(valid & (feature_mask[:, None] > 0) & depth_ok,
+                      gains, -jnp.inf)
+    flat = gains.reshape(-1)
+    idx = jnp.argmax(flat)
+    best_gain = flat[idx]
+    feat = (idx // hist.shape[1]).astype(jnp.int32)
+    b = (idx % hist.shape[1]).astype(jnp.int32)
+    if cfg.feature_axis_name is not None:
+        # feature-parallel learner: each shard scanned its feature slice;
+        # allgather candidate splits and pick the global winner
+        # (LightGBM tree_learner=feature analog, SURVEY.md §2.3).
+        ax = cfg.feature_axis_name
+        gains_all = jax.lax.all_gather(best_gain, ax)       # (S,)
+        feats_all = jax.lax.all_gather(feat, ax)
+        bins_all = jax.lax.all_gather(b, ax)
+        shard = jnp.argmax(gains_all)
+        n_local = jnp.asarray(hist.shape[0], jnp.int32)
+        best_gain = gains_all[shard]
+        feat = feats_all[shard] + shard.astype(jnp.int32) * n_local
+        b = bins_all[shard]
+    gain_ok = best_gain > jnp.maximum(cfg.min_gain_to_split, EPS_GAIN)
+    return jnp.where(gain_ok, best_gain, -jnp.inf), feat, b
+
+
+def _hist(bins, gh, cfg: GrowerConfig):
+    h = compute_histogram(bins, gh, cfg.num_bins, method=cfg.hist_method)
+    if cfg.axis_name is not None:
+        h = jax.lax.psum(h, cfg.axis_name)
+    return h
+
+
+def _totals_from_hist(hist):
+    """Leaf totals via any one feature's bins (they partition the rows)."""
+    s = jnp.sum(hist[0], axis=0)             # (3,)
+    return s[0], s[1], s[2]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def grow_tree(bins: jnp.ndarray, gh: jnp.ndarray,
+              feature_mask: jnp.ndarray,
+              cfg: GrowerConfig) -> Tuple[TreeArrays, jnp.ndarray]:
+    """Grow one tree.  ``gh``: (n, 3) masked (grad, hess, count)."""
+    return _grow_tree_impl(bins, gh, feature_mask, cfg)
+
+
+def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
+    n, f = bins.shape
+    L = cfg.num_leaves
+    neg_inf = jnp.float32(-jnp.inf)
+
+    hist0 = _hist(bins, gh, cfg)
+    g0, h0, c0 = _totals_from_hist(hist0)
+    depth0_ok = (cfg.max_depth <= 0) | (0 < cfg.max_depth)
+    bg0, bf0, bb0 = find_best_split(hist0, g0, h0, c0, feature_mask,
+                                    jnp.asarray(depth0_ok), cfg)
+
+    tree = TreeArrays(
+        node_feat=jnp.zeros(L - 1, jnp.int32),
+        node_bin=jnp.zeros(L - 1, jnp.int32),
+        node_left=jnp.zeros(L - 1, jnp.int32),
+        node_right=jnp.zeros(L - 1, jnp.int32),
+        node_gain=jnp.zeros(L - 1, jnp.float32),
+        node_value=jnp.zeros(L - 1, jnp.float32),
+        node_weight=jnp.zeros(L - 1, jnp.float32),
+        node_count=jnp.zeros(L - 1, jnp.float32),
+        leaf_value=jnp.zeros(L, jnp.float32).at[0].set(
+            _leaf_output(g0, h0, cfg)),
+        leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(h0),
+        leaf_count=jnp.zeros(L, jnp.float32).at[0].set(c0),
+        num_leaves=jnp.asarray(1, jnp.int32),
+    )
+    state = _GrowState(
+        row_leaf=jnp.zeros(n, jnp.int32),
+        leaf_hist=jnp.zeros((L, f, cfg.num_bins, 3), jnp.float32
+                            ).at[0].set(hist0),
+        leaf_g=jnp.zeros(L, jnp.float32).at[0].set(g0),
+        leaf_h=jnp.zeros(L, jnp.float32).at[0].set(h0),
+        leaf_c=jnp.zeros(L, jnp.float32).at[0].set(c0),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        leaf_is_right=jnp.zeros(L, bool),
+        best_gain=jnp.full(L, neg_inf).at[0].set(bg0),
+        best_feat=jnp.zeros(L, jnp.int32).at[0].set(bf0),
+        best_bin=jnp.zeros(L, jnp.int32).at[0].set(bb0),
+        tree=tree,
+    )
+
+    def split_step(i, state: _GrowState) -> _GrowState:
+        l = jnp.argmax(state.best_gain).astype(jnp.int32)
+        gain = state.best_gain[l]
+        do_split = gain > neg_inf
+
+        def do(state: _GrowState) -> _GrowState:
+            feat = state.best_feat[l]
+            thr = state.best_bin[l]
+            new_id = (i + 1).astype(jnp.int32)
+            col = jnp.take(bins, feat, axis=1)
+            in_leaf = state.row_leaf == l
+            go_right = in_leaf & (col > thr)
+            row_leaf = jnp.where(go_right, new_id, state.row_leaf)
+
+            hist_r = _hist(bins, gh * go_right[:, None], cfg)
+            hist_l = state.leaf_hist[l] - hist_r
+            g_r, h_r, c_r = _totals_from_hist(hist_r)
+            g_l = state.leaf_g[l] - g_r
+            h_l = state.leaf_h[l] - h_r
+            c_l = state.leaf_c[l] - c_r
+
+            child_depth = state.leaf_depth[l] + 1
+            depth_ok = jnp.asarray(
+                (cfg.max_depth <= 0), bool) | (child_depth < cfg.max_depth)
+            bg_l, bf_l, bb_l = find_best_split(
+                hist_l, g_l, h_l, c_l, feature_mask, depth_ok, cfg)
+            bg_r, bf_r, bb_r = find_best_split(
+                hist_r, g_r, h_r, c_r, feature_mask, depth_ok, cfg)
+
+            t = state.tree
+            # link the new internal node into its parent
+            p = state.leaf_parent[l]
+            has_parent = p >= 0
+            p_safe = jnp.maximum(p, 0)
+            was_right = state.leaf_is_right[l]
+            node_left = t.node_left.at[p_safe].set(
+                jnp.where(has_parent & ~was_right, i, t.node_left[p_safe]))
+            node_right = t.node_right.at[p_safe].set(
+                jnp.where(has_parent & was_right, i, t.node_right[p_safe]))
+            tree = t._replace(
+                node_feat=t.node_feat.at[i].set(feat),
+                node_bin=t.node_bin.at[i].set(thr),
+                node_left=node_left.at[i].set(-(l + 1)),
+                node_right=node_right.at[i].set(-(new_id + 1)),
+                node_gain=t.node_gain.at[i].set(gain),
+                node_value=t.node_value.at[i].set(
+                    _leaf_output(state.leaf_g[l], state.leaf_h[l], cfg)),
+                node_weight=t.node_weight.at[i].set(state.leaf_h[l]),
+                node_count=t.node_count.at[i].set(state.leaf_c[l]),
+                leaf_value=t.leaf_value
+                    .at[l].set(_leaf_output(g_l, h_l, cfg))
+                    .at[new_id].set(_leaf_output(g_r, h_r, cfg)),
+                leaf_weight=t.leaf_weight.at[l].set(h_l).at[new_id].set(h_r),
+                leaf_count=t.leaf_count.at[l].set(c_l).at[new_id].set(c_r),
+                num_leaves=t.num_leaves + 1,
+            )
+            return _GrowState(
+                row_leaf=row_leaf,
+                leaf_hist=state.leaf_hist.at[l].set(hist_l)
+                                         .at[new_id].set(hist_r),
+                leaf_g=state.leaf_g.at[l].set(g_l).at[new_id].set(g_r),
+                leaf_h=state.leaf_h.at[l].set(h_l).at[new_id].set(h_r),
+                leaf_c=state.leaf_c.at[l].set(c_l).at[new_id].set(c_r),
+                leaf_depth=state.leaf_depth.at[l].set(child_depth)
+                                           .at[new_id].set(child_depth),
+                leaf_parent=state.leaf_parent.at[l].set(i)
+                                             .at[new_id].set(i),
+                leaf_is_right=state.leaf_is_right.at[l].set(False)
+                                                 .at[new_id].set(True),
+                best_gain=state.best_gain.at[l].set(bg_l)
+                                         .at[new_id].set(bg_r),
+                best_feat=state.best_feat.at[l].set(bf_l)
+                                         .at[new_id].set(bf_r),
+                best_bin=state.best_bin.at[l].set(bb_l)
+                                       .at[new_id].set(bb_r),
+                tree=tree,
+            )
+
+        return jax.lax.cond(do_split, do, lambda s: s, state)
+
+    state = jax.lax.fori_loop(0, L - 1, split_step, state)
+    return state.tree, state.row_leaf
+
+
+def apply_shrinkage(tree: TreeArrays, learning_rate: float) -> TreeArrays:
+    return tree._replace(
+        leaf_value=tree.leaf_value * learning_rate,
+        node_value=tree.node_value * learning_rate)
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def predict_tree_binned(tree: TreeArrays, bins: jnp.ndarray,
+                        max_steps: int) -> jnp.ndarray:
+    """Score binned rows through one tree (used for validation sets)."""
+    n = bins.shape[0]
+
+    def body(_, node):
+        is_leaf = node < 0
+        safe = jnp.maximum(node, 0)
+        feat = tree.node_feat[safe]
+        thr = tree.node_bin[safe]
+        val = jnp.take_along_axis(
+            bins, feat[:, None], axis=1)[:, 0]
+        nxt = jnp.where(val <= thr, tree.node_left[safe],
+                        tree.node_right[safe])
+        return jnp.where(is_leaf, node, nxt)
+
+    start = jnp.where(tree.num_leaves > 1,
+                      jnp.zeros(n, jnp.int32), jnp.full(n, -1, jnp.int32))
+    node = jax.lax.fori_loop(0, max_steps, body, start)
+    leaf = -(node + 1)
+    return tree.leaf_value[leaf]

@@ -1,0 +1,270 @@
+"""Training objectives: gradient/hessian functions.
+
+TPU-native analogs of LightGBM's ``ObjectiveFunction`` subclasses, which the
+reference selects via its ``objective`` param and passes to the native engine
+(SURVEY.md §2.1 LightGBM params; §3.1 hot loop computes grad/hess natively).
+Each objective is a pure jax function ``(scores, labels, weights) → (g, h)``
+so it fuses into the jitted training step.
+
+Semantics track LightGBM:
+
+* ``binary``: logistic loss with ``sigmoid`` scaling and optional
+  ``is_unbalance``/``scale_pos_weight`` label weighting;
+  ``boost_from_average`` init score = log(p/(1-p))/sigmoid.
+* ``regression`` (l2), ``regression_l1`` (gradient = sign, hessian = 1),
+  ``huber``, ``fair``, ``poisson``, ``quantile``, ``mape``.
+* ``multiclass``: one-vs-all softmax, K trees per iteration,
+  hessian = 2·p·(1-p) · factor (K/(K-1)) as in LightGBM.
+* ``lambdarank``: in :mod:`mmlspark_tpu.gbdt.ranking` (pairwise ΔNDCG).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+GradFn = Callable[[Array, Array, Array], Tuple[Array, Array]]
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+class Objective:
+    """Base: subclasses define grad/hess and the boost-from-average init."""
+
+    name = "base"
+    num_model_per_iteration = 1
+    #: substring written into the LightGBM model file objective line
+    model_str = "custom"
+
+    def prepare(self, labels: np.ndarray, weights: np.ndarray) -> None:
+        """Resolve label statistics (class weights etc.); always called once
+        before training, independent of boost_from_average."""
+
+    def init_score(self, labels: np.ndarray, weights: np.ndarray) -> float:
+        return 0.0
+
+    def grad_hess(self, scores: Array, labels: Array,
+                  weights: Array) -> Tuple[Array, Array]:
+        raise NotImplementedError
+
+    def transform_prediction(self, scores: Array) -> Array:
+        """Raw margin → output space (e.g. sigmoid for binary)."""
+        return scores
+
+
+class BinaryObjective(Objective):
+    name = "binary"
+    model_str = "binary sigmoid:1"
+
+    def __init__(self, sigmoid_coef: float = 1.0, is_unbalance: bool = False,
+                 scale_pos_weight: float = 1.0):
+        self.sigma = float(sigmoid_coef)
+        self.is_unbalance = is_unbalance
+        self.scale_pos_weight = float(scale_pos_weight)
+        self.model_str = f"binary sigmoid:{self.sigma:g}"
+        self._pos_w = 1.0  # resolved by prepare() from label stats
+        self._neg_w = 1.0
+
+    def prepare(self, labels, weights):
+        pos = float(np.sum(weights * (labels > 0)))
+        neg = float(np.sum(weights)) - pos
+        if self.is_unbalance and pos > 0 and neg > 0:
+            # up-weight whichever class is rarer, as LightGBM does
+            if pos < neg:
+                self._pos_w = neg / pos
+            else:
+                self._neg_w = pos / neg
+        elif self.scale_pos_weight != 1.0:
+            self._pos_w = self.scale_pos_weight
+
+    def init_score(self, labels, weights):
+        pos = float(np.sum(weights * (labels > 0)))
+        neg = float(np.sum(weights)) - pos
+        if pos <= 0 or neg <= 0:
+            return 0.0
+        p = pos / (pos + neg)
+        return float(np.log(p / (1.0 - p)) / self.sigma)
+
+    def grad_hess(self, scores, labels, weights):
+        p = sigmoid(self.sigma * scores)
+        w = weights * jnp.where(labels > 0, self._pos_w, self._neg_w)
+        g = self.sigma * (p - labels) * w
+        h = self.sigma * self.sigma * p * (1.0 - p) * w
+        return g, h
+
+    def transform_prediction(self, scores):
+        return sigmoid(self.sigma * scores)
+
+
+class RegressionL2(Objective):
+    name = "regression"
+    model_str = "regression"
+
+    def init_score(self, labels, weights):
+        s = float(np.sum(weights))
+        return float(np.sum(weights * labels) / s) if s > 0 else 0.0
+
+    def grad_hess(self, scores, labels, weights):
+        return (scores - labels) * weights, weights
+
+
+class RegressionL1(Objective):
+    name = "regression_l1"
+    model_str = "regression_l1"
+
+    def init_score(self, labels, weights):
+        return float(np.median(labels))
+
+    def grad_hess(self, scores, labels, weights):
+        g = jnp.sign(scores - labels) * weights
+        return g, weights
+
+
+class HuberObjective(Objective):
+    name = "huber"
+    model_str = "huber"
+
+    def __init__(self, alpha: float = 0.9):
+        self.alpha = float(alpha)
+
+    def init_score(self, labels, weights):
+        s = float(np.sum(weights))
+        return float(np.sum(weights * labels) / s) if s > 0 else 0.0
+
+    def grad_hess(self, scores, labels, weights):
+        d = scores - labels
+        g = jnp.where(jnp.abs(d) <= self.alpha, d,
+                      self.alpha * jnp.sign(d)) * weights
+        return g, weights
+
+
+class FairObjective(Objective):
+    name = "fair"
+    model_str = "fair"
+
+    def __init__(self, c: float = 1.0):
+        self.c = float(c)
+
+    def init_score(self, labels, weights):
+        return 0.0
+
+    def grad_hess(self, scores, labels, weights):
+        d = scores - labels
+        g = self.c * d / (jnp.abs(d) + self.c) * weights
+        h = self.c * self.c / jnp.square(jnp.abs(d) + self.c) * weights
+        return g, h
+
+
+class PoissonObjective(Objective):
+    name = "poisson"
+    model_str = "poisson"
+
+    def __init__(self, max_delta_step: float = 0.7):
+        self.max_delta_step = float(max_delta_step)
+
+    def init_score(self, labels, weights):
+        s = float(np.sum(weights))
+        mean = float(np.sum(weights * labels) / s) if s > 0 else 1.0
+        return float(np.log(max(mean, 1e-12)))
+
+    def grad_hess(self, scores, labels, weights):
+        mu = jnp.exp(scores)
+        g = (mu - labels) * weights
+        h = mu * jnp.exp(self.max_delta_step) * weights
+        return g, h
+
+    def transform_prediction(self, scores):
+        return jnp.exp(scores)
+
+
+class QuantileObjective(Objective):
+    name = "quantile"
+    model_str = "quantile"
+
+    def __init__(self, alpha: float = 0.9):
+        self.alpha = float(alpha)
+
+    def init_score(self, labels, weights):
+        return float(np.quantile(labels, self.alpha))
+
+    def grad_hess(self, scores, labels, weights):
+        d = scores - labels
+        g = jnp.where(d >= 0, 1.0 - self.alpha, -self.alpha) * weights
+        return g, weights
+
+
+class MapeObjective(Objective):
+    name = "mape"
+    model_str = "mape"
+
+    def init_score(self, labels, weights):
+        return float(np.median(labels))
+
+    def grad_hess(self, scores, labels, weights):
+        denom = jnp.maximum(jnp.abs(labels), 1.0)
+        g = jnp.sign(scores - labels) / denom * weights
+        h = weights / denom
+        return g, h
+
+
+class MulticlassObjective(Objective):
+    """Softmax over K per-class score columns; K trees per iteration."""
+
+    name = "multiclass"
+
+    def __init__(self, num_class: int):
+        if num_class < 2:
+            raise ValueError("multiclass requires num_class >= 2")
+        self.num_class = int(num_class)
+        self.num_model_per_iteration = self.num_class
+        self.model_str = f"multiclass num_class:{self.num_class}"
+        self.factor = self.num_class / (self.num_class - 1.0)
+
+    def init_score(self, labels, weights):
+        return 0.0
+
+    def grad_hess(self, scores, labels, weights):
+        """scores: (n, K); labels: (n,) int class ids → (n, K) g/h."""
+        p = jax.nn.softmax(scores, axis=-1)
+        y = jax.nn.one_hot(labels.astype(jnp.int32), self.num_class,
+                           dtype=p.dtype)
+        w = weights[:, None]
+        g = (p - y) * w
+        h = self.factor * p * (1.0 - p) * w
+        return g, h
+
+    def transform_prediction(self, scores):
+        return jax.nn.softmax(scores, axis=-1)
+
+
+def get_objective(name: str, num_class: int = 1, **kwargs) -> Objective:
+    name = name.lower()
+    aliases = {
+        "binary": lambda: BinaryObjective(
+            sigmoid_coef=kwargs.get("sigmoid", 1.0),
+            is_unbalance=kwargs.get("is_unbalance", False),
+            scale_pos_weight=kwargs.get("scale_pos_weight", 1.0)),
+        "regression": RegressionL2, "regression_l2": RegressionL2,
+        "l2": RegressionL2, "mean_squared_error": RegressionL2,
+        "mse": RegressionL2,
+        "regression_l1": RegressionL1, "l1": RegressionL1,
+        "mae": RegressionL1,
+        "huber": lambda: HuberObjective(alpha=kwargs.get("alpha", 0.9)),
+        "fair": lambda: FairObjective(c=kwargs.get("fair_c", 1.0)),
+        "poisson": lambda: PoissonObjective(
+            max_delta_step=kwargs.get("poisson_max_delta_step", 0.7)),
+        "quantile": lambda: QuantileObjective(alpha=kwargs.get("alpha", 0.9)),
+        "mape": MapeObjective,
+        "multiclass": lambda: MulticlassObjective(num_class),
+        "softmax": lambda: MulticlassObjective(num_class),
+    }
+    if name not in aliases:
+        raise ValueError(f"Unknown objective {name!r}; "
+                         f"supported: {sorted(aliases)}")
+    return aliases[name]()

@@ -1,0 +1,3 @@
+from .histogram import compute_histogram
+
+__all__ = ["compute_histogram"]

@@ -1,0 +1,228 @@
+"""GBDT engine: grower invariants, end-to-end quality, persistence."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mmlspark_tpu.gbdt import (LightGBMClassifier, LightGBMClassificationModel,
+                               LightGBMRegressor, LightGBMRegressionModel,
+                               Booster, fit_bin_mapper)
+from mmlspark_tpu.gbdt.binning import BinMapper
+
+
+def _as_table(d):
+    return {"features": d["features"], "label": d["label"]}
+
+
+class TestBinning:
+    def test_exact_bins_for_few_distinct(self):
+        X = np.array([[0.0], [1.0], [1.0], [2.0], [3.0]])
+        m = fit_bin_mapper(X, max_bin=255, min_data_in_bin=1)
+        b = m.transform(X)
+        # 4 distinct values -> 4 distinct bins, order-preserving
+        assert len(np.unique(b)) == 4
+        assert (np.diff(b[:, 0][np.argsort(X[:, 0], kind="stable")]) >= 0).all()
+
+    def test_nan_goes_to_missing_bin(self):
+        X = np.array([[0.0], [np.nan], [2.0]])
+        m = fit_bin_mapper(X, max_bin=255, min_data_in_bin=1)
+        b = m.transform(X)
+        assert b[1, 0] == m.missing_bin
+
+    def test_quantile_binning_large(self, rng):
+        X = rng.normal(size=(10000, 1))
+        m = fit_bin_mapper(X, max_bin=63)
+        b = m.transform(X)
+        assert b.max() < m.num_total_bins
+        # roughly equal mass per bin
+        counts = np.bincount(b[:, 0], minlength=64)
+        used = counts[counts > 0]
+        assert used.min() > 10000 / 63 * 0.3
+
+    def test_threshold_value_monotone(self, rng):
+        X = rng.normal(size=(1000, 1))
+        m = fit_bin_mapper(X, max_bin=15)
+        ts = [m.bin_threshold_value(0, i) for i in range(14)]
+        assert ts == sorted(ts)
+
+
+class TestClassifier:
+    def test_binary_auc_beats_sklearn_stump(self, binary_table):
+        from sklearn.metrics import roc_auc_score
+        clf = LightGBMClassifier(numIterations=50, numLeaves=15,
+                                 learningRate=0.2, minDataInLeaf=5)
+        model = clf.fit(_as_table(binary_table))
+        out = model.transform(_as_table(binary_table))
+        auc = roc_auc_score(binary_table["label"], out["probability"][:, 1])
+        assert auc > 0.93, f"train AUC too low: {auc}"
+
+    def test_binary_close_to_sklearn_histgbt(self, binary_table):
+        """Holdout AUC within 0.02 of sklearn's histogram GBDT."""
+        from sklearn.ensemble import HistGradientBoostingClassifier
+        from sklearn.metrics import roc_auc_score
+        from sklearn.model_selection import train_test_split
+        X, y = binary_table["features"], binary_table["label"]
+        Xtr, Xte, ytr, yte = train_test_split(X, y, random_state=0)
+
+        sk = HistGradientBoostingClassifier(
+            max_iter=60, learning_rate=0.2, max_leaf_nodes=31,
+            min_samples_leaf=20, early_stopping=False).fit(Xtr, ytr)
+        sk_auc = roc_auc_score(yte, sk.predict_proba(Xte)[:, 1])
+
+        model = LightGBMClassifier(
+            numIterations=60, learningRate=0.2, numLeaves=31,
+            minDataInLeaf=20).fit({"features": Xtr, "label": ytr})
+        out = model.transform({"features": Xte, "label": yte})
+        our_auc = roc_auc_score(yte, out["probability"][:, 1])
+        assert our_auc > sk_auc - 0.02, (our_auc, sk_auc)
+
+    def test_output_columns_and_shapes(self, binary_table):
+        model = LightGBMClassifier(numIterations=5).fit(
+            _as_table(binary_table))
+        df = pd.DataFrame({
+            "features": list(binary_table["features"][:10]),
+            "label": binary_table["label"][:10]})
+        out = model.transform(df)
+        assert isinstance(out, pd.DataFrame)
+        assert set(["rawPrediction", "probability", "prediction"]) <= set(
+            out.columns)
+        prob = np.stack(out["probability"].to_numpy())
+        assert prob.shape == (10, 2)
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-5)
+        pred = out["prediction"].to_numpy()
+        assert set(np.unique(pred)) <= {0.0, 1.0}
+
+    def test_multiclass_auto_promotion(self, rng):
+        from sklearn.datasets import make_classification
+        X, y = make_classification(n_samples=1500, n_features=10,
+                                   n_informative=8, n_classes=3,
+                                   random_state=1)
+        model = LightGBMClassifier(numIterations=30, numLeaves=15,
+                                   minDataInLeaf=5).fit(
+            {"features": X, "label": y.astype(float)})
+        out = model.transform({"features": X, "label": y})
+        acc = np.mean(out["prediction"] == y)
+        assert out["probability"].shape == (1500, 3)
+        assert acc > 0.8, acc
+
+    def test_sample_weights_respected(self, rng):
+        # duplicate-class data where weights flip the majority
+        X = np.concatenate([np.zeros((100, 2)), np.zeros((50, 2))])
+        y = np.concatenate([np.zeros(100), np.ones(50)])
+        w = np.concatenate([np.ones(100), np.full(50, 10.0)])
+        model = LightGBMClassifier(
+            numIterations=5, minDataInLeaf=1, weightCol="w").fit(
+            {"features": X, "label": y, "w": w})
+        out = model.transform({"features": X[:1], "label": y[:1]})
+        # weighted positive mass dominates -> p1 > 0.5 despite fewer rows
+        assert out["probability"][0, 1] > 0.5
+
+    def test_early_stopping(self, binary_table):
+        X, y = binary_table["features"], binary_table["label"]
+        val = np.zeros(len(y), bool)
+        val[::4] = True
+        model = LightGBMClassifier(
+            numIterations=200, learningRate=0.5, numLeaves=31,
+            earlyStoppingRound=5, validationIndicatorCol="isVal").fit(
+            {"features": X, "label": y, "isVal": val})
+        assert len(model.getModel().trees) < 200
+
+
+class TestRegressor:
+    def test_r2_reasonable(self, regression_table):
+        from sklearn.metrics import r2_score
+        model = LightGBMRegressor(numIterations=80, learningRate=0.1,
+                                  numLeaves=31, minDataInLeaf=5).fit(
+            _as_table(regression_table))
+        out = model.transform(_as_table(regression_table))
+        r2 = r2_score(regression_table["label"], out["prediction"])
+        assert r2 > 0.8, r2
+
+    def test_l1_objective_runs(self, regression_table):
+        model = LightGBMRegressor(objective="regression_l1",
+                                  numIterations=10).fit(
+            _as_table(regression_table))
+        out = model.transform(_as_table(regression_table))
+        assert np.isfinite(out["prediction"]).all()
+
+    def test_constant_labels_yield_constant_prediction(self):
+        X = np.random.default_rng(0).normal(size=(100, 3))
+        y = np.full(100, 7.0)
+        model = LightGBMRegressor(numIterations=10).fit(
+            {"features": X, "label": y})
+        out = model.transform({"features": X, "label": y})
+        np.testing.assert_allclose(out["prediction"], 7.0, atol=1e-5)
+
+
+class TestPersistence:
+    def test_native_model_roundtrip(self, binary_table, tmp_path):
+        model = LightGBMClassifier(numIterations=10).fit(
+            _as_table(binary_table))
+        p = str(tmp_path / "model.txt")
+        model.saveNativeModel(p)
+        loaded = LightGBMClassificationModel.loadNativeModel(p)
+        loaded.setFeaturesCol("features")
+        a = model.transform(_as_table(binary_table))["probability"]
+        b = loaded.transform(_as_table(binary_table))["probability"]
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_native_model_text_structure(self, binary_table):
+        model = LightGBMClassifier(numIterations=3).fit(
+            _as_table(binary_table))
+        txt = model.getNativeModel()
+        for key in ["tree\n", "version=v3", "num_class=1", "objective=binary",
+                    "Tree=0", "split_feature=", "threshold=", "leaf_value=",
+                    "end of trees", "tree_sizes="]:
+            assert key in txt, f"missing {key!r}"
+        # tree_sizes must match actual block byte lengths
+        sizes = [int(s) for s in
+                 txt.split("tree_sizes=")[1].splitlines()[0].split()]
+        assert len(sizes) == 3
+
+    def test_stage_persistence_roundtrip(self, binary_table, tmp_path):
+        model = LightGBMClassifier(numIterations=5).fit(
+            _as_table(binary_table))
+        model.save(str(tmp_path / "m"))
+        loaded = LightGBMClassificationModel.load(str(tmp_path / "m"))
+        a = model.transform(_as_table(binary_table))["prediction"]
+        b = loaded.transform(_as_table(binary_table))["prediction"]
+        np.testing.assert_array_equal(a, b)
+
+    def test_estimator_persistence(self, tmp_path):
+        est = LightGBMClassifier(numIterations=7, numLeaves=5,
+                                 learningRate=0.3)
+        est.save(str(tmp_path / "est"))
+        est2 = LightGBMClassifier.load(str(tmp_path / "est"))
+        assert est2.getNumIterations() == 7
+        assert est2.getNumLeaves() == 5
+
+
+class TestReviewRegressions:
+    def test_is_unbalance_without_boost_from_average(self):
+        """prepare() must resolve class weights even when init is skipped."""
+        from mmlspark_tpu.gbdt.objectives import BinaryObjective
+        import jax.numpy as jnp
+        y = np.array([1.0] * 90 + [0.0] * 10)
+        w = np.ones(100)
+        obj = BinaryObjective(is_unbalance=True)
+        obj.prepare(y, w)
+        # negatives are rarer -> negative class up-weighted
+        g, h = obj.grad_hess(jnp.zeros(100), jnp.asarray(y), jnp.asarray(w))
+        g = np.asarray(g)
+        assert abs(g[99]) > abs(g[0]) * 5  # neg grad ~9x pos grad
+
+    def test_threshold_isolating_missing_bin_exports_inf(self):
+        from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+        X = np.array([[0.0], [1.0], [2.0], [np.nan]])
+        m = fit_bin_mapper(X, max_bin=255, min_data_in_bin=1)
+        assert m.bin_threshold_value(0, 250) == np.inf
+
+    def test_bagging_seed_independent_of_seed(self, binary_table):
+        t = {"features": binary_table["features"][:500],
+             "label": binary_table["label"][:500]}
+        kw = dict(numIterations=5, baggingFraction=0.5, baggingFreq=1)
+        m1 = LightGBMClassifier(seed=1, baggingSeed=9, **kw).fit(t)
+        m2 = LightGBMClassifier(seed=1, baggingSeed=10, **kw).fit(t)
+        a = m1.getModel().save_native_model_string()
+        b = m2.getModel().save_native_model_string()
+        assert a != b  # different bagging seeds -> different forests
