@@ -1,0 +1,103 @@
+"""Benchmark: GBDT training throughput vs sklearn HistGradientBoosting (CPU).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The headline metric is boosted rows/second for LightGBMClassifier training
+(n_rows x n_iterations / wall_clock), on whatever accelerator jax selects
+(the real TPU chip under the driver).  The baseline is sklearn's
+HistGradientBoostingClassifier — the same histogram-GBDT algorithm family,
+measured live on this machine's CPU with matched hyper-parameters —
+standing in for the reference's CPU LightGBM executor engine until real
+reference numbers exist (BASELINE.md: "published": {}).
+
+vs_baseline = sklearn_wall_clock / our_wall_clock  (>1 means faster).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for a quick sanity check")
+    ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument("--features", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=None)
+    args = ap.parse_args()
+
+    n = args.rows or (20_000 if args.smoke else 400_000)
+    f = args.features or (20 if args.smoke else 50)
+    iters = args.iters or (5 if args.smoke else 50)
+    leaves = 31
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    log(f"generating data: {n}x{f}, {iters} iters")
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logits = (X[:, 0] * 1.5 + X[:, 1] * X[:, 2] + np.sin(X[:, 3] * 2)
+              + rng.normal(size=n) * 0.5)
+    y = (logits > 0).astype(np.float64)
+
+    # --- baseline: sklearn HistGradientBoosting on CPU -----------------
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+    t0 = time.perf_counter()
+    sk = HistGradientBoostingClassifier(
+        max_iter=iters, learning_rate=0.1, max_leaf_nodes=leaves,
+        max_bins=255, early_stopping=False, validation_fraction=None)
+    sk.fit(X, y)
+    sk_time = time.perf_counter() - t0
+    sk_auc = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+    log(f"sklearn: {sk_time:.2f}s  AUC={sk_auc:.4f}")
+
+    # --- ours ----------------------------------------------------------
+    import jax
+    log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+
+    kw = dict(learningRate=0.1, numLeaves=leaves, maxBin=255,
+              minDataInLeaf=20, verbosity=0)
+    # warm-up: compile the boost step on a slice (same static shapes except
+    # n; grower compiles per (n, f) so use the full array with 2 iters)
+    log("warm-up / compile...")
+    t0 = time.perf_counter()
+    LightGBMClassifier(numIterations=2, **kw).fit(
+        {"features": X, "label": y})
+    log(f"warm-up (incl compile): {time.perf_counter() - t0:.2f}s")
+
+    t0 = time.perf_counter()
+    model = LightGBMClassifier(numIterations=iters, **kw).fit(
+        {"features": X, "label": y})
+    our_time = time.perf_counter() - t0
+    out = model.transform({"features": X, "label": y})
+    our_auc = roc_auc_score(y, np.asarray(out["probability"])[:, 1])
+    log(f"ours: {our_time:.2f}s  AUC={our_auc:.4f}")
+
+    value = n * iters / our_time
+    print(json.dumps({
+        "metric": "lightgbm_train_boosted_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows*iters/s",
+        "vs_baseline": round(sk_time / our_time, 4),
+        "detail": {
+            "rows": n, "features": f, "iterations": iters,
+            "num_leaves": leaves,
+            "our_wall_s": round(our_time, 3),
+            "sklearn_wall_s": round(sk_time, 3),
+            "our_train_auc": round(float(our_auc), 5),
+            "sklearn_train_auc": round(float(sk_auc), 5),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -145,6 +145,12 @@ class LightGBMBase(Estimator, LightGBMParams):
     __abstractstage__ = True
 
     _default_objective = "regression"
+    _mesh = None
+
+    def setMesh(self, mesh) -> "LightGBMBase":
+        """Pin an explicit ``(data, feature)`` device mesh for training."""
+        self._mesh = mesh
+        return self
 
     def _objective_kwargs(self) -> Dict:
         return {}
@@ -189,6 +195,9 @@ class LightGBMBase(Estimator, LightGBMParams):
         bins = mapper.transform(X[train_idx])
         y_train = y[train_idx]
         w_train = w[train_idx] if w is not None else None
+        iscol = self.getInitScoreCol()
+        init_scores = (np.asarray(table[iscol], np.float64)[train_idx]
+                       if iscol else None)
 
         val_kwargs = {}
         if val_mask is not None and val_mask.any():
@@ -202,11 +211,23 @@ class LightGBMBase(Estimator, LightGBMParams):
         params = self._train_params()
         feature_names = list(
             getattr(table[self.getFeaturesCol()], "columns", [])) or None
+        grad_override = self._grad_fn_override(table, train_idx, y_train,
+                                               w_train)
+        # Distributed by default when a mesh is available, like the
+        # reference trains across all executors (SURVEY.md §3.1); the
+        # parallelism param picks the axis layout.
+        mesh = getattr(self, "_mesh", None)
+        if mesh is None and grad_override is None and not val_kwargs:
+            import jax
+            if jax.device_count() > 1:
+                from .distributed import resolve_mesh
+                mesh = resolve_mesh(self.getParallelism())
         booster = train(
             bins, y_train, w_train, mapper, objective, params,
             feature_names=feature_names,
-            grad_fn_override=self._grad_fn_override(
-                table, train_idx, y_train, w_train),
+            grad_fn_override=grad_override,
+            mesh=mesh,
+            init_scores=init_scores,
             **val_kwargs)
         model = self._make_model(booster)
         model.setParams(**{k: v for k, v in self._iterSetParams()

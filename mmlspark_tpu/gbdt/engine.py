@@ -69,13 +69,21 @@ def _boost_step(bins, scores, labels, weights, bag_mask, feature_mask,
     return tree, scores
 
 
-@functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr", "k"),
+@functools.partial(jax.jit, static_argnames=("obj",))
+def _grad_hess_jit(scores, labels, weights, obj: Objective):
+    return obj.grad_hess(scores, labels, weights)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "lr", "k"),
                    donate_argnums=(1,))
-def _boost_step_class_k(bins, scores, labels, weights, bag_mask, feature_mask,
-                        obj: MulticlassObjective, cfg: GrowerConfig,
-                        lr: float, k: int):
-    """One boosting iteration for class k of a multiclass model."""
-    g, h = obj.grad_hess(scores, labels, weights)
+def _boost_step_class_k(bins, scores, g, h, bag_mask, feature_mask,
+                        cfg: GrowerConfig, lr: float, k: int):
+    """Grow class k's tree from grad/hess computed ONCE per iteration.
+
+    LightGBM computes softmax gradients once per iteration for all K trees;
+    taking precomputed (g, h) here preserves that semantics instead of
+    re-deriving gradients after earlier classes' score updates.
+    """
     gh = jnp.stack([g[:, k] * bag_mask, h[:, k] * bag_mask, bag_mask], axis=1)
     tree, row_leaf = _grow_tree_impl(bins, gh, feature_mask, cfg)
     scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
@@ -97,12 +105,18 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
           val_weights: Optional[np.ndarray] = None,
           val_metric: Optional[Callable] = None,
           grad_fn_override=None,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
+          callbacks: Optional[List[Callable]] = None,
+          mesh=None,
+          init_scores: Optional[np.ndarray] = None) -> Booster:
     """Train a forest.  ``bins``: (n, f) int32 pre-binned features.
 
     ``grad_fn_override``: optional ``(scores) -> (g, h)`` replacing the
     objective's grad/hess (used by the ranking objective which closes over
     query structure).
+
+    ``mesh``: a ``(data, feature)`` Mesh for distributed training; rows and
+    features are padded to the mesh shape and the boost step runs under
+    ``shard_map`` with psum histogram allreduce (SURVEY.md §5.8 swap).
     """
     n, f = bins.shape
     K = objective.num_model_per_iteration
@@ -111,14 +125,10 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
 
     w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
     objective.prepare(np.asarray(labels), w)
+    # Per-row init scores (initScoreCol) replace boost_from_average, as in
+    # LightGBM; they are a training-time offset not baked into the model.
     init = objective.init_score(np.asarray(labels), w) \
-        if params.boost_from_average else 0.0
-
-    bins_d = jnp.asarray(bins, jnp.int32)
-    labels_d = jnp.asarray(labels,
-                           jnp.int32 if K > 1 else jnp.float32)
-    weights_d = jnp.asarray(w, jnp.float32)
-    scores = jnp.full((n, K) if K > 1 else (n,), init, jnp.float32)
+        if params.boost_from_average and init_scores is None else 0.0
 
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
@@ -127,6 +137,33 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
         hist_method=params.histogram_method)
+
+    use_mesh = mesh is not None and int(np.prod(
+        [mesh.shape[a] for a in mesh.axis_names])) > 1
+    if use_mesh:
+        if grad_fn_override is not None:
+            raise NotImplementedError(
+                "ranking objectives are single-mesh-axis for now; train "
+                "the ranker without a mesh")
+        if val_bins is not None or callbacks:
+            raise NotImplementedError(
+                "validation/early stopping and callbacks are not yet "
+                "supported with an explicit mesh; drop setMesh(...) or the "
+                "validationIndicatorCol")
+        return _train_distributed(
+            bins, labels, w, mapper, objective, params, cfg, mesh,
+            feature_names, init, rng, bag_rng, init_scores)
+
+    bins_d = jnp.asarray(bins, jnp.int32)
+    labels_d = jnp.asarray(labels,
+                           jnp.int32 if K > 1 else jnp.float32)
+    weights_d = jnp.asarray(w, jnp.float32)
+    scores0 = np.full((n, K) if K > 1 else (n,), init, np.float32)
+    if init_scores is not None:
+        iscores = np.asarray(init_scores, np.float32)
+        scores0 = scores0 + (iscores if scores0.ndim == iscores.ndim
+                             else iscores[:, None])
+    scores = jnp.asarray(scores0)
 
     has_val = val_bins is not None and val_metric is not None
     if has_val:
@@ -156,6 +193,9 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             fmask = jnp.asarray(m)
 
         grew_any = False
+        if K > 1 and grad_fn_override is None:
+            g_iter, h_iter = _grad_hess_jit(scores, labels_d, weights_d,
+                                            objective)
         for k in range(K):
             if grad_fn_override is not None:
                 g, h = grad_fn_override(scores)
@@ -166,8 +206,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 tree = apply_shrinkage(tree, params.learning_rate)
             elif K > 1:
                 tree, scores = _boost_step_class_k(
-                    bins_d, scores, labels_d, weights_d, bag_mask, fmask,
-                    objective, cfg, params.learning_rate, k)
+                    bins_d, scores, g_iter, h_iter, bag_mask, fmask,
+                    cfg, params.learning_rate, k)
             else:
                 tree, scores = _boost_step(
                     bins_d, scores, labels_d, weights_d, bag_mask, fmask,
@@ -212,6 +252,12 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             for cb in callbacks:
                 cb(it, trees)
 
+    return _finalize_booster(trees, K, init, params, objective, mapper,
+                             feature_names, f, stop_iter)
+
+
+def _finalize_booster(trees, K, init, params, objective, mapper,
+                      feature_names, f, stop_iter) -> Booster:
     if trees and params.boost_from_average and init != 0.0:
         # Bake the init score into the first tree per class so the exported
         # model is self-contained, as LightGBM does for boost_from_average.
@@ -230,9 +276,72 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         "max_bin": str(params.max_bin),
         **params.pass_through,
     }
-    booster = Booster(
+    return Booster(
         trees, num_class=K, objective_str=objective.model_str,
         init_score=0.0, feature_names=feature_names,
         feature_infos=mapper.feature_infos(),
         max_feature_idx=f - 1, params=engine_params)
-    return booster
+
+
+def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
+                       feature_names, init, rng, bag_rng,
+                       init_scores=None) -> Booster:
+    """Distributed boosting loop: one shard_mapped jit step per tree."""
+    from .distributed import (make_boost_step, make_multiclass_steps,
+                              prepare_arrays)
+
+    n, f = bins.shape
+    K = objective.num_model_per_iteration
+    if K > 1:
+        grads_fn, step = make_multiclass_steps(
+            mesh, objective, cfg, params.learning_rate, K)
+    else:
+        grads_fn = None
+        step = make_boost_step(mesh, objective, cfg, params.learning_rate)
+    bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
+        np.asarray(bins, np.int32), np.asarray(labels),
+        np.asarray(w, np.float32), mesh, K, init, init_scores)
+    f_padded = f + fp
+
+    fmask_full = np.zeros(f_padded, np.float32)
+    fmask_full[:f] = 1.0
+    fmask = jnp.asarray(fmask_full)
+
+    trees: List[HostTree] = []
+    stop_iter = params.num_iterations
+    bag = real
+    for it in range(params.num_iterations):
+        if params.bagging_freq > 0 and params.bagging_fraction < 1.0 \
+                and it % params.bagging_freq == 0:
+            # draw exactly n randoms so the stream matches a serial run
+            # with the same baggingSeed, then pad
+            keep = (bag_rng.random(n) < params.bagging_fraction)
+            keep = np.concatenate([keep, np.zeros(rp, bool)])
+            bag = real * jnp.asarray(keep.astype(np.float32))
+        if params.feature_fraction < 1.0:
+            k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
+            sel = rng.choice(f, size=k_keep, replace=False)
+            m = np.zeros(f_padded, np.float32)
+            m[sel] = 1.0
+            fmask = jnp.asarray(m)
+
+        grew_any = False
+        if K > 1:
+            g_iter, h_iter = grads_fn(scores, labels_d, w_d)
+        for k in range(K):
+            if K > 1:
+                tree, scores = step(bins_d, scores, g_iter, h_iter, bag,
+                                    fmask, jnp.asarray(k, jnp.int32))
+            else:
+                tree, scores = step(bins_d, scores, labels_d, w_d, bag,
+                                    fmask, jnp.asarray(k, jnp.int32))
+            if int(tree.num_leaves) > 1:
+                grew_any = True
+            trees.append(host_tree_from_arrays(tree, mapper,
+                                               mapper.missing_bin))
+        if not grew_any:
+            stop_iter = it
+            break
+
+    return _finalize_booster(trees, K, init, params, objective, mapper,
+                             feature_names, f, stop_iter)

@@ -219,7 +219,22 @@ def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
             feat = state.best_feat[l]
             thr = state.best_bin[l]
             new_id = (i + 1).astype(jnp.int32)
-            col = jnp.take(bins, feat, axis=1)
+            if cfg.feature_axis_name is not None:
+                # feat is a GLOBAL index but bins holds this shard's feature
+                # slice: the owning shard contributes the split column, the
+                # psum broadcasts it (LightGBM feature-parallel's bitmap
+                # broadcast, as an ICI collective).
+                f_local = bins.shape[1]
+                shard = jax.lax.axis_index(cfg.feature_axis_name)
+                owner = feat // f_local
+                lidx = feat - owner * f_local
+                col_local = jnp.where(
+                    owner == shard,
+                    jnp.take(bins, jnp.minimum(lidx, f_local - 1), axis=1),
+                    0)
+                col = jax.lax.psum(col_local, cfg.feature_axis_name)
+            else:
+                col = jnp.take(bins, feat, axis=1)
             in_leaf = state.row_leaf == l
             go_right = in_leaf & (col > thr)
             row_leaf = jnp.where(go_right, new_id, state.row_leaf)
