@@ -33,17 +33,19 @@ except ImportError:  # pragma: no cover
     pa = None
 
 
-ColumnLike = np.ndarray  # 1-D scalar column or 2-D vector column
+ColumnLike = np.ndarray  # rows on axis 0: 1-D scalar, 2-D vector, N-D tensor
 TableLike = Union["DataTable", "pd.DataFrame", "pa.Table", Dict[str, Any]]
 
 
 class DataTable:
     """An ordered, column-oriented table backed by numpy arrays.
 
-    Columns are 1-D numpy arrays (scalar columns) or 2-D numpy arrays
-    (fixed-width vector columns — the analog of Spark ML vector columns).
-    Object-dtype 1-D columns may hold arbitrary python payloads (e.g. image
-    structs, HTTP responses) just as Spark rows may hold structs.
+    Columns are 1-D numpy arrays (scalar columns), 2-D numpy arrays
+    (fixed-width vector columns — the analog of Spark ML vector columns),
+    or higher-rank arrays whose leading axis is the row axis (e.g. NHWC
+    image batches).  Object-dtype 1-D columns may hold arbitrary python
+    payloads (image structs, HTTP responses) just as Spark rows may hold
+    structs.
     """
 
     def __init__(self, columns: Dict[str, Any]):
@@ -126,8 +128,8 @@ class DataTable:
             raise ImportError("pandas is not available")
         data = {}
         for k, v in self._cols.items():
-            if v.ndim == 2:
-                data[k] = list(v)  # vector column -> object column of rows
+            if v.ndim >= 2:
+                data[k] = list(v)  # vector/tensor column -> object column
             else:
                 data[k] = v
         return pd.DataFrame(data)
@@ -141,6 +143,11 @@ class DataTable:
             if v.ndim == 2:
                 arrays.append(pa.FixedSizeListArray.from_arrays(
                     pa.array(v.reshape(-1)), v.shape[1]))
+            elif v.ndim > 2:
+                raise ValueError(
+                    f"Column {k!r} has shape {v.shape}; tensor columns "
+                    "(rank > 2) cannot round-trip Arrow without losing their "
+                    "shape — reshape to 2-D or keep the DataTable flavor")
             else:
                 arrays.append(pa.array(v))
         return pa.Table.from_arrays(arrays, names=names)
@@ -156,11 +163,11 @@ class DataTable:
 
 
 def _as_column(col: Any) -> np.ndarray:
-    """Normalize a column to a 1-D or 2-D numpy array."""
+    """Normalize a column to a numpy array with rows on axis 0."""
     if isinstance(col, np.ndarray):
-        if col.ndim in (1, 2):
+        if col.ndim >= 1:
             return col
-        raise ValueError(f"Columns must be 1-D or 2-D, got shape {col.shape}")
+        raise ValueError("Columns must have at least one axis")
     if pd is not None and isinstance(col, pd.Series):
         return _series_to_column(col)
     if pa is not None and isinstance(col, (pa.Array, pa.ChunkedArray)):
@@ -174,9 +181,9 @@ def _as_column(col: Any) -> np.ndarray:
                 return np.stack([np.asarray(x, dtype=np.float64) for x in arr])
             except (ValueError, TypeError):
                 return arr  # ragged or non-numeric payloads stay object
-    if arr.ndim in (1, 2):
+    if arr.ndim >= 1:
         return arr
-    raise ValueError(f"Columns must be 1-D or 2-D, got shape {arr.shape}")
+    raise ValueError("Columns must have at least one axis")
 
 
 def _series_to_column(s: "pd.Series") -> np.ndarray:

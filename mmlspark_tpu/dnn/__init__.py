@@ -1,0 +1,5 @@
+from .model import DNNModel, ResNetFeaturizerModel, CNTKModel
+from .resnet import ResNet, build_resnet, init_params, load_torch_state_dict
+
+__all__ = ["DNNModel", "ResNetFeaturizerModel", "CNTKModel", "ResNet",
+           "build_resnet", "init_params", "load_torch_state_dict"]

@@ -1,0 +1,137 @@
+"""DNN inference transformers: the CNTKModel/TorchModel analog.
+
+TPU-native re-design of the reference's ``CNTKModel`` (cntk/CNTKModel.scala,
+expected path, UNVERIFIED; SURVEY.md §3.3): the reference broadcasts CNTK
+model bytes and evals minibatches over JNI per executor; here a flax/jax
+apply function is jitted once per input shape and minibatches stream through
+it on the TPU.  Fixed-size minibatches with tail padding keep a single
+compiled program (no per-batch recompiles) — the moral equivalent of the
+reference pairing ``MiniBatchTransformer`` with its JNI eval loop.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import Param, TypeConverters, HasInputCol, HasOutputCol
+from ..core.pipeline import Transformer
+from ..core.schema import DataTable
+
+
+class DNNModel(Transformer, HasInputCol, HasOutputCol):
+    """Runs a jitted apply function over minibatches of a column.
+
+    ``apply_fn(variables, batch) -> outputs``; set via constructor or
+    :meth:`setModel`.  Subclasses provide architecture-specific loading.
+    """
+
+    miniBatchSize = Param("miniBatchSize", "Rows per device minibatch",
+                          default=64, typeConverter=TypeConverters.toInt)
+
+    def __init__(self, apply_fn: Optional[Callable] = None,
+                 variables: Any = None, **kwargs):
+        super().__init__(**kwargs)
+        self._apply_fn = apply_fn
+        self._variables = variables
+        self._jitted = None
+
+    def setModel(self, apply_fn: Callable, variables: Any) -> "DNNModel":
+        self._apply_fn = apply_fn
+        self._variables = variables
+        self._jitted = None
+        return self
+
+    def _get_jitted(self):
+        if self._jitted is None:
+            if self._apply_fn is None:
+                raise ValueError(
+                    f"{type(self).__name__} has no model; call setModel() or "
+                    "construct with apply_fn/variables")
+            self._jitted = jax.jit(self._apply_fn)
+        return self._jitted
+
+    def _batch_input(self, col: np.ndarray) -> np.ndarray:
+        if col.dtype == object:
+            col = np.stack([np.asarray(x, np.float32) for x in col])
+        return np.asarray(col, np.float32)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        col = self._batch_input(table[self.getInputCol()])
+        n = col.shape[0]
+        bs = self.getMiniBatchSize()
+        fn = self._get_jitted()
+        outs = []
+        for start in range(0, n, bs):
+            batch = col[start:start + bs]
+            pad = bs - batch.shape[0]
+            if pad:  # pad the tail so every minibatch hits the same program
+                batch = np.concatenate(
+                    [batch, np.zeros((pad,) + batch.shape[1:], batch.dtype)])
+            out = np.asarray(fn(self._variables, jnp.asarray(batch)))
+            outs.append(out[:bs - pad] if pad else out)
+        result = np.concatenate(outs, axis=0) if outs else \
+            np.zeros((0, 0), np.float32)
+        return table.withColumn(self.getOutputCol(),
+                                result.astype(np.float64))
+
+    # persistence: pickle the variable pytree; the apply_fn is rebuilt by
+    # subclasses (generic DNNModel can't serialize arbitrary callables)
+    def _save_extra(self, path: str) -> None:
+        with open(os.path.join(path, "variables.pkl"), "wb") as f:
+            pickle.dump(jax.device_get(self._variables), f)
+
+    def _load_extra(self, path: str) -> None:
+        p = os.path.join(path, "variables.pkl")
+        self._jitted = None
+        self._apply_fn = None
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                self._variables = pickle.load(f)
+        self._rebuild_apply_fn()
+
+    def _rebuild_apply_fn(self) -> None:
+        """Subclasses restore self._apply_fn after load."""
+
+
+class ResNetFeaturizerModel(DNNModel):
+    """Headless/classifier ResNet forward (the ImageFeaturizer engine)."""
+
+    modelName = Param("modelName", "ResNet variant", default="resnet50",
+                      typeConverter=TypeConverters.toString)
+    cutOutputLayers = Param("cutOutputLayers",
+                            "1 -> pooled features (headless), 0 -> logits",
+                            default=1, typeConverter=TypeConverters.toInt)
+
+    def __init__(self, variables: Any = None, **kwargs):
+        super().__init__(**kwargs)
+        self._variables = variables
+        self._rebuild_apply_fn()
+
+    def _rebuild_apply_fn(self) -> None:
+        from .resnet import build_resnet
+        model = build_resnet(self.getModelName())
+        headless = self.getCutOutputLayers() >= 1
+
+        def apply_fn(variables, batch):
+            return model.apply(variables, batch, train=False,
+                               features_only=headless)
+
+        self._apply_fn = apply_fn
+        self._jitted = None
+
+
+class CNTKModel(DNNModel):
+    """Legacy-name shim for ported pipelines (reference cntk/CNTKModel.scala).
+
+    The reference evaluates serialized CNTK graphs; CNTK's format is not
+    re-implemented — load converted weights via :class:`ResNetFeaturizerModel`
+    or :class:`mmlspark_tpu.onnx.ONNXModel` and use this class only as an
+    API-compatible alias.
+    """

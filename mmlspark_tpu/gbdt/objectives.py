@@ -243,6 +243,24 @@ class MulticlassObjective(Objective):
         return jax.nn.softmax(scores, axis=-1)
 
 
+class _LambdarankStub(Objective):
+    """Metadata-only objective: the ranker supplies grad/hess via its
+    query-structured override (gbdt/ranking.py); init score is 0."""
+
+    name = "lambdarank"
+    model_str = "lambdarank"
+
+    def grad_hess(self, scores, labels, weights):
+        raise ValueError(
+            "objective='lambdarank' needs query structure; use "
+            "LightGBMRanker (with groupCol) instead of "
+            "LightGBMClassifier/Regressor")
+
+
+def _lambdarank_stub() -> Objective:
+    return _LambdarankStub()
+
+
 def get_objective(name: str, num_class: int = 1, **kwargs) -> Objective:
     name = name.lower()
     aliases = {
@@ -263,6 +281,7 @@ def get_objective(name: str, num_class: int = 1, **kwargs) -> Objective:
         "mape": MapeObjective,
         "multiclass": lambda: MulticlassObjective(num_class),
         "softmax": lambda: MulticlassObjective(num_class),
+        "lambdarank": _lambdarank_stub,
     }
     if name not in aliases:
         raise ValueError(f"Unknown objective {name!r}; "

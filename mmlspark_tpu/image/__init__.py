@@ -1,0 +1,5 @@
+from .transformer import ImageTransformer, UnrollImage, ImageSetAugmenter
+from .featurizer import ImageFeaturizer
+
+__all__ = ["ImageTransformer", "UnrollImage", "ImageSetAugmenter",
+           "ImageFeaturizer"]

@@ -1,0 +1,120 @@
+"""ImageFeaturizer: headless-DNN image featurization.
+
+TPU-native re-implementation of the reference's flagship inference pipeline
+(image/ImageFeaturizer.scala, expected path, UNVERIFIED; SURVEY.md §3.3):
+``ImageTransformer`` (resize/crop) → ``UnrollImage`` → headless ``CNTKModel``
+becomes resize/normalize (batched jax ops) → jitted flax ResNet forward with
+the classifier head cut.  One XLA program per minibatch instead of per-row
+JNI; this is the BASELINE.md "ImageFeaturizer ResNet-50 imgs/sec/chip"
+config.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.params import Param, TypeConverters, HasInputCol, HasOutputCol
+from ..core.pipeline import Transformer
+from ..core.schema import DataTable
+from ..dnn.model import ResNetFeaturizerModel
+from ..dnn.resnet import build_resnet, init_params
+from .transformer import ImageTransformer
+
+# torchvision ImageNet normalization, in 0-255 space
+_IMAGENET_MEAN = [123.675, 116.28, 103.53]
+_IMAGENET_STD = [58.395, 57.12, 57.375]
+
+
+class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
+    """resize → normalize → headless ResNet forward, all on device."""
+
+    modelName = Param("modelName", "DNN to featurize with",
+                      default="resnet50", typeConverter=TypeConverters.toString)
+    cutOutputLayers = Param("cutOutputLayers",
+                            "Layers to cut from the head: 1 -> pooled "
+                            "features, 0 -> logits", default=1,
+                            typeConverter=TypeConverters.toInt)
+    imageHeight = Param("imageHeight", "Input height", default=224,
+                        typeConverter=TypeConverters.toInt)
+    imageWidth = Param("imageWidth", "Input width", default=224,
+                       typeConverter=TypeConverters.toInt)
+    miniBatchSize = Param("miniBatchSize", "Rows per device minibatch",
+                          default=64, typeConverter=TypeConverters.toInt)
+    channelsBGR = Param("channelsBGR",
+                        "Input images are BGR (OpenCV order) and will be "
+                        "converted to RGB", default=False,
+                        typeConverter=TypeConverters.toBool)
+
+    def __init__(self, variables: Any = None, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "features")
+        super().__init__(**kwargs)
+        self._variables = variables
+
+    # -- weights -------------------------------------------------------------
+
+    def setWeights(self, variables: Any) -> "ImageFeaturizer":
+        self._variables = variables
+        return self
+
+    def loadTorchCheckpoint(self, path: str) -> "ImageFeaturizer":
+        """Load a torchvision-layout ResNet checkpoint (.pt/.pth)."""
+        import torch
+        from ..dnn.resnet import load_torch_state_dict
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        if hasattr(sd, "state_dict"):
+            sd = sd.state_dict()
+        model = build_resnet(self.getModelName())
+        self._variables = load_torch_state_dict(model, sd)
+        return self
+
+    def _ensure_variables(self):
+        if self._variables is None:
+            from ..downloader import ModelDownloader
+            path = ModelDownloader().find_local_checkpoint(
+                self.getModelName())
+            if path is not None:
+                self.loadTorchCheckpoint(path)
+            else:
+                import logging
+                logging.getLogger("mmlspark_tpu").warning(
+                    "ImageFeaturizer: no checkpoint for %s found; using "
+                    "RANDOM weights (features are untrained). Provide one "
+                    "via loadTorchCheckpoint()/setWeights().",
+                    self.getModelName())
+                self._variables = init_params(
+                    build_resnet(self.getModelName()),
+                    self.getImageHeight())
+        return self._variables
+
+    # -- execution -----------------------------------------------------------
+
+    def _transform(self, table: DataTable) -> DataTable:
+        prep = ImageTransformer(inputCol=self.getInputCol(),
+                                outputCol="__prepped__")
+        prep.resize(self.getImageHeight(), self.getImageWidth())
+        if self.getChannelsBGR():
+            prep.colorFormat("rgb")
+        prep.normalize(_IMAGENET_MEAN, _IMAGENET_STD)
+        prepped = prep._transform(table)
+
+        dnn = ResNetFeaturizerModel(
+            variables=self._ensure_variables(),
+            inputCol="__prepped__", outputCol=self.getOutputCol(),
+            modelName=self.getModelName(),
+            cutOutputLayers=self.getCutOutputLayers(),
+            miniBatchSize=self.getMiniBatchSize())
+        out = dnn._transform(prepped)
+        return out.drop("__prepped__")
+
+    def _save_extra(self, path: str) -> None:
+        import jax, os, pickle
+        with open(os.path.join(path, "variables.pkl"), "wb") as f:
+            pickle.dump(jax.device_get(self._ensure_variables()), f)
+
+    def _load_extra(self, path: str) -> None:
+        import os, pickle
+        with open(os.path.join(path, "variables.pkl"), "rb") as f:
+            self._variables = pickle.load(f)

@@ -1,0 +1,225 @@
+"""ImageTransformer / UnrollImage / ImageSetAugmenter.
+
+TPU-native re-implementation of the reference's image pipeline stages
+(opencv/ImageTransformer.scala, image/UnrollImage.scala,
+image/ImageSetAugmenter.scala — expected paths, UNVERIFIED; SURVEY.md §2.1).
+The reference exposes an OpenCV-stage DSL (``.resize(h, w).crop(...)``)
+executed per row over JNI; here the same DSL builds a list of batched tensor
+ops (ops/image.py) executed as ONE jitted program per image-shape group:
+
+* ragged input images are grouped by (H, W, C) so each distinct shape
+  compiles once and runs batched;
+* after a ``resize`` stage shapes are uniform, so downstream stages fuse
+  into the same XLA program — the TPU answer to per-row JNI calls.
+
+Image columns are object columns of HWC uint8/float arrays, or a uniform
+``(N, H, W, C)`` numeric array.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import Param, TypeConverters, HasInputCol, HasOutputCol
+from ..core.pipeline import Transformer
+from ..core.schema import DataTable
+from ..core import serialize
+from ..ops import image as imops
+
+
+def _to_batches(col: np.ndarray) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Group an image column into (row_indices, NHWC float32 batch) groups."""
+    if isinstance(col, np.ndarray) and col.ndim == 4:
+        return [(np.arange(col.shape[0]), np.asarray(col, np.float32))]
+    groups: Dict[Tuple[int, ...], List[int]] = {}
+    imgs = []
+    for i, im in enumerate(col):
+        im = np.asarray(im)
+        if im.ndim == 2:
+            im = im[:, :, None]
+        imgs.append(im)
+        groups.setdefault(im.shape, []).append(i)
+    return [(np.asarray(idx),
+             np.stack([imgs[i] for i in idx]).astype(np.float32))
+            for idx in (np.asarray(v) for v in groups.values())]
+
+
+def _apply_stages(batch: jnp.ndarray, stages: List[Dict[str, Any]]
+                  ) -> jnp.ndarray:
+    for st in stages:
+        op = st["op"]
+        if op == "resize":
+            batch = imops.resize(batch, st["height"], st["width"])
+        elif op == "centerCrop":
+            batch = imops.center_crop(batch, st["height"], st["width"])
+        elif op == "crop":
+            batch = imops.crop(batch, st["y"], st["x"], st["height"],
+                               st["width"])
+        elif op == "colorFormat":
+            fmt = st["format"]
+            if fmt in ("gray", "grayscale"):
+                batch = imops.to_grayscale(batch)
+            elif fmt in ("rgb", "bgr"):  # swap channel order
+                batch = imops.bgr_to_rgb(batch)
+            else:
+                raise ValueError(f"Unknown color format {fmt!r}")
+        elif op == "flip":
+            batch = imops.flip(batch, horizontal=st.get("horizontal", True))
+        elif op == "blur":
+            batch = imops.gaussian_blur(batch, size=int(st.get("size", 3)),
+                                        sigma=float(st.get("sigma", 0.0)))
+        elif op == "threshold":
+            batch = imops.threshold(batch, st["threshold"],
+                                    st.get("maxVal", 255.0),
+                                    st.get("kind", "binary"))
+        elif op == "normalize":
+            batch = imops.normalize(batch, st["mean"], st["std"],
+                                    st.get("scale", 1.0))
+        else:
+            raise ValueError(f"Unknown image stage {op!r}")
+    return batch
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_pipeline(stages_json: str):
+    """One jitted program per distinct stage list (shared across calls)."""
+    stages = json.loads(stages_json)
+    return jax.jit(lambda b: _apply_stages(b, stages))
+
+
+class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """OpenCV-style stage DSL compiled to batched jitted tensor ops."""
+
+    stages = Param("stages", "Ordered list of image op descriptors",
+                   default=None, typeConverter=TypeConverters.toList)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "image")
+        super().__init__(**kwargs)
+        if self.getStages() is None:
+            self.setStages([])
+
+    # -- DSL (mirrors the reference's ImageTransformer builder API) ---------
+
+    def _add(self, **st) -> "ImageTransformer":
+        self.setStages(list(self.getStages()) + [st])
+        return self
+
+    def resize(self, height: int, width: int) -> "ImageTransformer":
+        return self._add(op="resize", height=int(height), width=int(width))
+
+    def centerCrop(self, height: int, width: int) -> "ImageTransformer":
+        return self._add(op="centerCrop", height=int(height),
+                         width=int(width))
+
+    def crop(self, x: int, y: int, height: int, width: int):
+        return self._add(op="crop", x=int(x), y=int(y), height=int(height),
+                         width=int(width))
+
+    def colorFormat(self, fmt: str) -> "ImageTransformer":
+        return self._add(op="colorFormat", format=fmt)
+
+    def flip(self, horizontal: bool = True) -> "ImageTransformer":
+        return self._add(op="flip", horizontal=bool(horizontal))
+
+    def blur(self, size: int = 3, sigma: float = 0.0) -> "ImageTransformer":
+        return self._add(op="blur", size=int(size), sigma=float(sigma))
+
+    def threshold(self, threshold: float, maxVal: float = 255.0,
+                  kind: str = "binary") -> "ImageTransformer":
+        return self._add(op="threshold", threshold=float(threshold),
+                         maxVal=float(maxVal), kind=kind)
+
+    def normalize(self, mean, std, scale: float = 1.0):
+        return self._add(op="normalize", mean=list(mean), std=list(std),
+                         scale=float(scale))
+
+    # -- execution -----------------------------------------------------------
+
+    def _transform(self, table: DataTable) -> DataTable:
+        col = table[self.getInputCol()]
+        stages = self.getStages()
+        batches = _to_batches(col)
+        fn = _compiled_pipeline(json.dumps(stages))
+        n = len(table)
+        outs: Optional[np.ndarray] = None
+        results = []
+        for idx, batch in batches:
+            out = np.asarray(fn(jnp.asarray(batch)))
+            results.append((idx, out))
+        shapes = {r.shape[1:] for _, r in results}
+        if len(shapes) == 1:
+            shape = shapes.pop()
+            outs = np.empty((n,) + shape, np.float32)
+            for idx, r in results:
+                outs[idx] = r
+        else:  # still ragged: object column
+            outs = np.empty(n, object)
+            for idx, r in results:
+                for i, row in zip(idx, r):
+                    outs[i] = row
+        return table.withColumn(self.getOutputCol(), outs)
+
+
+class UnrollImage(Transformer, HasInputCol, HasOutputCol):
+    """HWC image column → flat numeric vector column (reference UnrollImage)."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "unrolled")
+        super().__init__(**kwargs)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        col = table[self.getInputCol()]
+        if isinstance(col, np.ndarray) and col.ndim == 4:
+            flat = col.reshape(col.shape[0], -1).astype(np.float64)
+        else:
+            rows = [np.asarray(im, np.float64).reshape(-1) for im in col]
+            widths = {len(r) for r in rows}
+            if len(widths) != 1:
+                raise ValueError(
+                    "UnrollImage requires uniformly-sized images; add an "
+                    "ImageTransformer().resize(...) stage first")
+            flat = np.stack(rows)
+        return table.withColumn(self.getOutputCol(), flat)
+
+
+class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
+    """Dataset augmentation by flips: emits 2x (or 4x) rows per input
+    (reference image/ImageSetAugmenter.scala, expected path, UNVERIFIED)."""
+
+    flipLeftRight = Param("flipLeftRight", "Emit horizontally flipped copies",
+                          default=True, typeConverter=TypeConverters.toBool)
+    flipUpDown = Param("flipUpDown", "Emit vertically flipped copies",
+                       default=False, typeConverter=TypeConverters.toBool)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "image")
+        kwargs.setdefault("outputCol", "image")
+        super().__init__(**kwargs)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        col = table[self.getInputCol()]
+        tables = [table.withColumn(self.getOutputCol(), col)]
+        def flipped(axis):
+            if isinstance(col, np.ndarray) and col.ndim == 4:
+                return np.flip(col, axis=axis)
+            out = np.empty(len(col), object)
+            for i, im in enumerate(col):
+                out[i] = np.flip(np.asarray(im), axis=axis - 1)
+            return out
+        if self.getFlipLeftRight():
+            tables.append(table.withColumn(self.getOutputCol(), flipped(2)))
+        if self.getFlipUpDown():
+            tables.append(table.withColumn(self.getOutputCol(), flipped(1)))
+        out = tables[0]
+        for t in tables[1:]:
+            out = out.concat(t)
+        return out

@@ -1,0 +1,4 @@
+from .model import ONNXModel, OnnxGraph
+from . import proto
+
+__all__ = ["ONNXModel", "OnnxGraph", "proto"]

@@ -1,0 +1,342 @@
+"""ONNXModel: score ONNX graphs with jax/XLA on TPU.
+
+TPU-native replacement for the reference's onnxruntime-JNI transformer
+(onnx/ONNXModel.scala, expected path, UNVERIFIED; SURVEY.md §2.1): the graph
+is parsed (mmlspark_tpu/onnx/proto.py), converted node-by-node to jax ops,
+and the whole forward is one jitted XLA program — operator fusion comes from
+the compiler rather than onnxruntime's executor.  Supports the core
+CNN/MLP operator set (Conv, Gemm/MatMul, BatchNorm, pooling, activations,
+elementwise, Reshape/Flatten/Concat/Transpose, Softmax, LRN, Dropout-as-
+identity); unsupported ops raise with the op name.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import Param, TypeConverters, HasInputCol, HasOutputCol
+from ..core.pipeline import Transformer
+from ..core.schema import DataTable
+from . import proto
+
+
+def _pads_to_lax(pads: List[int], spatial: int):
+    if not pads:
+        return [(0, 0)] * spatial
+    half = len(pads) // 2
+    return [(int(pads[i]), int(pads[i + half])) for i in range(half)]
+
+
+def _same_pads(in_shape, kernel, strides, lower: bool):
+    """Explicit ONNX SAME_UPPER/SAME_LOWER padding pairs."""
+    out = []
+    for size, k, s in zip(in_shape, kernel, strides):
+        total = max((-(-size // s) - 1) * s + k - size, 0)
+        small, big = total // 2, total - total // 2
+        out.append((big, small) if lower else (small, big))
+    return out
+
+
+def _conv(x, w, b, attrs):
+    spatial = w.ndim - 2
+    strides = tuple(attrs.get("strides", [1] * spatial))
+    dil = tuple(attrs.get("dilations", [1] * spatial))
+    groups = int(attrs.get("group", 1))
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        eff_k = [(w.shape[2 + i] - 1) * dil[i] + 1 for i in range(spatial)]
+        pads = _same_pads(x.shape[2:], eff_k, strides,
+                          lower=(auto == "SAME_LOWER"))
+    else:
+        pads = _pads_to_lax(attrs.get("pads", []), spatial)
+    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCW", "OIW", "NCW")
+    out = jax.lax.conv_general_dilated(
+        x, w, strides, pads, rhs_dilation=dil,
+        dimension_numbers=dn, feature_group_count=groups)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+def _pool(x, attrs, reducer, init, avg=False):
+    k = attrs["kernel_shape"]
+    spatial = len(k)
+    strides = tuple(attrs.get("strides", [1] * spatial))
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        pads = _same_pads(x.shape[2:], k, strides,
+                          lower=(auto == "SAME_LOWER"))
+    else:
+        pads = _pads_to_lax(attrs.get("pads", []), spatial)
+    window = (1, 1) + tuple(k)
+    strides_full = (1, 1) + strides
+    pads_full = [(0, 0), (0, 0)] + pads
+    out = jax.lax.reduce_window(x, init, reducer, window, strides_full,
+                                pads_full)
+    if avg:
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       strides_full, pads_full)
+        out = out / counts
+    return out
+
+
+def _gemm(env, node, attrs):
+    a = env[node["inputs"][0]]
+    b = env[node["inputs"][1]]
+    if attrs.get("transA", 0):
+        a = a.T
+    if attrs.get("transB", 0):
+        b = b.T
+    out = attrs.get("alpha", 1.0) * (a @ b)
+    if len(node["inputs"]) > 2:
+        out = out + attrs.get("beta", 1.0) * env[node["inputs"][2]]
+    return out
+
+
+def _batchnorm(x, scale, bias, mean, var, attrs):
+    eps = attrs.get("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean.reshape(shape)) / jnp.sqrt(
+        var.reshape(shape) + eps) * scale.reshape(shape) + bias.reshape(shape)
+
+
+_UNARY = {
+    "Relu": jax.nn.relu, "Sigmoid": jax.nn.sigmoid, "Tanh": jnp.tanh,
+    "Exp": jnp.exp, "Log": jnp.log, "Neg": jnp.negative, "Sqrt": jnp.sqrt,
+    "Abs": jnp.abs, "Erf": jax.lax.erf, "Floor": jnp.floor,
+    "Ceil": jnp.ceil, "Identity": lambda x: x, "Softplus": jax.nn.softplus,
+}
+
+_BINARY = {
+    "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+    "Div": jnp.divide, "Pow": jnp.power, "Max": jnp.maximum,
+    "Min": jnp.minimum,
+}
+
+
+def _eval_node(node: Dict[str, Any], env: Dict[str, Any]):
+    op = node["op_type"]
+    attrs = node["attrs"]
+    ins = node["inputs"]
+
+    if op in _UNARY:
+        return _UNARY[op](env[ins[0]])
+    if op in _BINARY:
+        return _BINARY[op](env[ins[0]], env[ins[1]])
+    if op == "Conv":
+        b = env[ins[2]] if len(ins) > 2 else None
+        return _conv(env[ins[0]], env[ins[1]], b, attrs)
+    if op == "Gemm":
+        return _gemm(env, node, attrs)
+    if op == "MatMul":
+        return env[ins[0]] @ env[ins[1]]
+    if op == "BatchNormalization":
+        return _batchnorm(env[ins[0]], env[ins[1]], env[ins[2]],
+                          env[ins[3]], env[ins[4]], attrs)
+    if op == "MaxPool":
+        return _pool(env[ins[0]], attrs, jax.lax.max, -jnp.inf)
+    if op == "AveragePool":
+        return _pool(env[ins[0]], attrs, jax.lax.add, 0.0, avg=True)
+    if op == "GlobalAveragePool":
+        x = env[ins[0]]
+        return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+    if op == "GlobalMaxPool":
+        x = env[ins[0]]
+        return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+    if op == "Flatten":
+        ax = attrs.get("axis", 1)
+        x = env[ins[0]]
+        lead = int(np.prod(x.shape[:ax])) if ax else 1
+        return x.reshape(lead, -1)
+    if op == "Reshape":
+        shape = np.asarray(env[ins[1]]).tolist()
+        x = env[ins[0]]
+        shape = [x.shape[i] if s == 0 else int(s)
+                 for i, s in enumerate(shape)]
+        return x.reshape(shape)
+    if op == "Transpose":
+        perm = attrs.get("perm")
+        return jnp.transpose(env[ins[0]], perm)
+    if op == "Concat":
+        return jnp.concatenate([env[i] for i in ins],
+                               axis=attrs.get("axis", 0))
+    if op == "Softmax":
+        return jax.nn.softmax(env[ins[0]], axis=attrs.get("axis", -1))
+    if op == "LogSoftmax":
+        return jax.nn.log_softmax(env[ins[0]], axis=attrs.get("axis", -1))
+    if op == "LeakyRelu":
+        return jax.nn.leaky_relu(env[ins[0]], attrs.get("alpha", 0.01))
+    if op == "Clip":
+        lo = env[ins[1]] if len(ins) > 1 and ins[1] else attrs.get(
+            "min", -jnp.inf)
+        hi = env[ins[2]] if len(ins) > 2 and ins[2] else attrs.get(
+            "max", jnp.inf)
+        return jnp.clip(env[ins[0]], lo, hi)
+    if op == "Dropout":
+        return env[ins[0]]   # inference mode
+    if op == "Constant":
+        for key in ("value", "value_float", "value_int"):
+            if key in attrs:
+                return jnp.asarray(attrs[key])
+        raise ValueError("Constant node without value")
+    if op == "ReduceMean":
+        axes = attrs.get("axes")
+        return jnp.mean(env[ins[0]],
+                        axis=tuple(axes) if axes else None,
+                        keepdims=bool(attrs.get("keepdims", 1)))
+    if op == "Squeeze":
+        axes = attrs.get("axes") or (
+            np.asarray(env[ins[1]]).tolist() if len(ins) > 1 else None)
+        return jnp.squeeze(env[ins[0]],
+                           axis=tuple(axes) if axes else None)
+    if op == "Unsqueeze":
+        axes = attrs.get("axes") or np.asarray(env[ins[1]]).tolist()
+        x = env[ins[0]]
+        for ax in sorted(axes):
+            x = jnp.expand_dims(x, ax)
+        return x
+    if op == "Cast":
+        to = proto.ONNX_DTYPES.get(attrs.get("to", 1), np.float32)
+        return env[ins[0]].astype(to)
+    if op == "LRN":
+        # local response norm across channels (NCHW axis 1)
+        x = env[ins[0]]
+        size = attrs.get("size", 5)
+        alpha, beta, bias = (attrs.get("alpha", 1e-4),
+                             attrs.get("beta", 0.75), attrs.get("bias", 1.0))
+        sq = x * x
+        half = size // 2
+        pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+        den = jax.lax.reduce_window(sq, 0.0, jax.lax.add,
+                                    (1, size) + (1,) * (x.ndim - 2),
+                                    (1,) * x.ndim, pads)
+        return x / (bias + alpha / size * den) ** beta
+    raise NotImplementedError(
+        f"ONNX op {op!r} is not supported yet "
+        f"(node {node['name'] or '<unnamed>'})")
+
+
+class OnnxGraph:
+    """Parsed + converted ONNX graph, callable as a jax function."""
+
+    def __init__(self, model_bytes: bytes):
+        parsed = proto.parse_model(model_bytes)
+        self.graph = parsed["graph"]
+        self.weights = {k: jnp.asarray(v)
+                        for k, v in self.graph["initializers"].items()}
+        init_names = set(self.graph["initializers"])
+        self.input_names = [v["name"] for v in self.graph["inputs"]
+                            if v["name"] not in init_names]
+        self.output_names = [v["name"] for v in self.graph["outputs"]]
+        self.input_shapes = {v["name"]: v["shape"]
+                             for v in self.graph["inputs"]}
+
+    def __call__(self, *inputs):
+        env: Dict[str, Any] = dict(self.weights)
+        env[""] = None
+        for name, val in zip(self.input_names, inputs):
+            env[name] = val
+        for node in self.graph["nodes"]:
+            outs = node["outputs"]
+            result = _eval_node(node, env)
+            if len(outs) == 1:
+                env[outs[0]] = result
+            else:  # e.g. Dropout with mask output
+                env[outs[0]] = result
+                for o in outs[1:]:
+                    env[o] = None
+        results = [env[o] for o in self.output_names]
+        return results[0] if len(results) == 1 else tuple(results)
+
+
+class ONNXModel(Transformer, HasInputCol, HasOutputCol):
+    """DataFrame transformer scoring an ONNX model on the TPU.
+
+    API parity with the reference: setModelLocation/setModelPayload,
+    miniBatchSize, softMaxDict-style post-ops are left to pipeline stages.
+    """
+
+    miniBatchSize = Param("miniBatchSize", "Rows per device minibatch",
+                          default=64, typeConverter=TypeConverters.toInt)
+    modelLocation = Param("modelLocation", "Path to the .onnx file",
+                          default=None, typeConverter=TypeConverters.toString)
+
+    def __init__(self, model_bytes: Optional[bytes] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._graph: Optional[OnnxGraph] = None
+        self._jitted = None
+        if model_bytes is not None:
+            self.setModelPayload(model_bytes)
+        elif self.getModelLocation():
+            self._load_location()
+
+    def setModelPayload(self, model_bytes: bytes) -> "ONNXModel":
+        self._model_bytes = model_bytes
+        self._graph = OnnxGraph(model_bytes)
+        self._jitted = jax.jit(self._graph)
+        return self
+
+    def setModelLocation(self, path: str) -> "ONNXModel":
+        self.set("modelLocation", path)
+        self._load_location()
+        return self
+
+    def _load_location(self):
+        with open(self.getModelLocation(), "rb") as fh:
+            self.setModelPayload(fh.read())
+
+    def getModelInputs(self):
+        return {n: self._graph.input_shapes.get(n)
+                for n in self._graph.input_names}
+
+    def getModelOutputs(self):
+        return list(self._graph.output_names)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        if self._graph is None:
+            raise ValueError("ONNXModel has no model; call "
+                             "setModelLocation() or setModelPayload()")
+        col = table[self.getInputCol()]
+        if col.dtype == object:
+            col = np.stack([np.asarray(r, np.float32) for r in col])
+        col = np.asarray(col, np.float32)
+        # reshape flat vectors to the model's input shape when known
+        shape = self._graph.input_shapes.get(self._graph.input_names[0])
+        if shape and len(shape) > 2 and col.ndim == 2:
+            tail = [d for d in shape[1:]]
+            if all(d > 0 for d in tail) and int(np.prod(tail)) == col.shape[1]:
+                col = col.reshape((-1, *tail))
+        bs = self.getMiniBatchSize()
+        outs = []
+        for start in range(0, col.shape[0], bs):
+            batch = col[start:start + bs]
+            pad = bs - batch.shape[0]
+            if pad:
+                batch = np.concatenate(
+                    [batch, np.zeros((pad,) + batch.shape[1:], batch.dtype)])
+            out = self._jitted(jnp.asarray(batch))
+            if isinstance(out, tuple):
+                out = out[0]
+            out = np.asarray(out)
+            outs.append(out[:bs - pad] if pad else out)
+        result = np.concatenate(outs, axis=0)
+        if result.ndim > 2:
+            result = result.reshape(result.shape[0], -1)
+        return table.withColumn(self.getOutputCol(),
+                                result.astype(np.float64))
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        with open(os.path.join(path, "model.onnx"), "wb") as f:
+            f.write(self._model_bytes)
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        with open(os.path.join(path, "model.onnx"), "rb") as f:
+            self.setModelPayload(f.read())

@@ -1,0 +1,293 @@
+"""Minimal protobuf wire-format codec for ONNX model files.
+
+The reference scores ONNX models through onnxruntime JNI
+(onnx/ONNXModel.scala, expected path, UNVERIFIED; SURVEY.md §2.1).  This
+environment has neither onnxruntime nor the ``onnx`` python package, so this
+module implements the small slice of protobuf needed to read (and write)
+ONNX ``ModelProto`` files directly: varints, length-delimited fields, packed
+repeated scalars — nothing more.  The decoder is schema-driven over the ONNX
+message layout; the encoder exists to build test fixtures and to export
+simple graphs.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterator, List, Tuple
+
+import numpy as np
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(data: memoryview) -> Iterator[Tuple[int, int, Any]]:
+    pos, end = 0, len(data)
+    while pos < end:
+        key, pos = _read_varint(data, pos)
+        field, wt = key >> 3, key & 7
+        if wt == _VARINT:
+            val, pos = _read_varint(data, pos)
+        elif wt == _I64:
+            val = bytes(data[pos:pos + 8])
+            pos += 8
+        elif wt == _LEN:
+            ln, pos = _read_varint(data, pos)
+            val = data[pos:pos + ln]
+            pos += ln
+        elif wt == _I32:
+            val = bytes(data[pos:pos + 4])
+            pos += 4
+        else:
+            raise ValueError(f"Unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def parse(data) -> Dict[int, List[Any]]:
+    """Parse one message into {field_number: [raw values...]}."""
+    out: Dict[int, List[Any]] = {}
+    for field, _, val in _iter_fields(memoryview(data)):
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def as_str(v) -> str:
+    return bytes(v).decode("utf-8")
+
+
+def packed_varints(vals: List[Any]) -> List[int]:
+    """Repeated int64 field: packed bytes and/or individual varints."""
+    out: List[int] = []
+    for v in vals:
+        if isinstance(v, int):
+            out.append(v)
+        else:
+            mv = memoryview(v)
+            pos = 0
+            while pos < len(mv):
+                x, pos = _read_varint(mv, pos)
+                out.append(x)
+    return [_signed64(x) for x in out]
+
+
+def _signed64(x: int) -> int:
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def packed_floats(vals: List[Any]) -> np.ndarray:
+    parts = []
+    for v in vals:
+        if isinstance(v, bytes) and len(v) == 4:
+            parts.append(np.frombuffer(v, "<f4"))
+        else:
+            parts.append(np.frombuffer(bytes(v), "<f4"))
+    return np.concatenate(parts) if parts else np.zeros(0, np.float32)
+
+
+# -- ONNX message readers ----------------------------------------------------
+
+ONNX_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+               7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+def tensor_to_array(raw) -> Tuple[str, np.ndarray]:
+    """TensorProto -> (name, ndarray)."""
+    f = parse(raw)
+    dims = packed_varints(f.get(1, []))
+    dtype = ONNX_DTYPES.get(f.get(2, [1])[0], np.float32)
+    name = as_str(f[8][0]) if 8 in f else ""
+    if 9 in f:  # raw_data
+        arr = np.frombuffer(bytes(f[9][0]), dtype=dtype)
+    elif 4 in f:  # float_data
+        arr = packed_floats(f[4])
+    elif 7 in f:  # int64_data
+        arr = np.asarray(packed_varints(f[7]), np.int64)
+    elif 5 in f:  # int32_data
+        arr = np.asarray(packed_varints(f[5]), np.int32).astype(dtype)
+    else:
+        arr = np.zeros(0, dtype)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def parse_attribute(raw) -> Tuple[str, Any]:
+    f = parse(raw)
+    name = as_str(f[1][0])
+    atype = f.get(20, [0])[0]
+    if atype == 1:    # FLOAT
+        return name, struct.unpack("<f", bytes(f[2][0]))[0]
+    if atype == 2:    # INT
+        return name, _signed64(f[3][0])
+    if atype == 3:    # STRING
+        return name, as_str(f[4][0])
+    if atype == 4:    # TENSOR
+        return name, tensor_to_array(f[5][0])[1]
+    if atype == 6:    # FLOATS
+        return name, list(packed_floats(f.get(7, [])))
+    if atype == 7:    # INTS
+        return name, packed_varints(f.get(8, []))
+    if atype == 8:    # STRINGS
+        return name, [as_str(s) for s in f.get(9, [])]
+    # fall back on whichever single field is present
+    for fid, conv in ((3, lambda v: _signed64(v[0])),
+                      (2, lambda v: struct.unpack("<f", bytes(v[0]))[0]),
+                      (4, lambda v: as_str(v[0]))):
+        if fid in f:
+            return name, conv(f[fid])
+    return name, None
+
+
+def parse_node(raw) -> Dict[str, Any]:
+    f = parse(raw)
+    return {
+        "inputs": [as_str(v) for v in f.get(1, [])],
+        "outputs": [as_str(v) for v in f.get(2, [])],
+        "name": as_str(f[3][0]) if 3 in f else "",
+        "op_type": as_str(f[4][0]) if 4 in f else "",
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def parse_value_info(raw) -> Dict[str, Any]:
+    f = parse(raw)
+    name = as_str(f[1][0]) if 1 in f else ""
+    shape, elem = [], 1
+    if 2 in f:
+        t = parse(f[2][0])
+        if 1 in t:  # tensor_type
+            tt = parse(t[1][0])
+            elem = tt.get(1, [1])[0]
+            if 2 in tt:
+                sh = parse(tt[2][0])
+                for d in sh.get(1, []):
+                    dd = parse(d)
+                    shape.append(dd[1][0] if 1 in dd else -1)
+    return {"name": name, "shape": shape, "elem_type": elem}
+
+
+def parse_model(data: bytes) -> Dict[str, Any]:
+    """ModelProto -> {graph: {nodes, initializers, inputs, outputs}}."""
+    m = parse(data)
+    if 7 not in m:
+        raise ValueError("Not an ONNX ModelProto (no graph field)")
+    g = parse(m[7][0])
+    initializers = dict(tensor_to_array(t) for t in g.get(5, []))
+    return {
+        "ir_version": m.get(1, [0])[0],
+        "graph": {
+            "name": as_str(g[2][0]) if 2 in g else "",
+            "nodes": [parse_node(n) for n in g.get(1, [])],
+            "initializers": initializers,
+            "inputs": [parse_value_info(v) for v in g.get(11, [])],
+            "outputs": [parse_value_info(v) for v in g.get(12, [])],
+        },
+    }
+
+
+# -- minimal encoder (test fixtures + simple graph export) -------------------
+
+def _varint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(num: int, wt: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | wt) + payload
+
+
+def _len_field(num: int, payload: bytes) -> bytes:
+    return _field(num, _LEN, _varint(len(payload)) + payload)
+
+
+def encode_tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr)
+    dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
+          np.dtype(np.float64): 11, np.dtype(np.int32): 6}[arr.dtype]
+    out = b""
+    for d in arr.shape:
+        out += _field(1, _VARINT, _varint(d))
+    out += _field(2, _VARINT, _varint(dt))
+    out += _len_field(8, name.encode())
+    out += _len_field(9, arr.tobytes())
+    return out
+
+
+def encode_attr(name: str, value) -> bytes:
+    out = _len_field(1, name.encode())
+    if isinstance(value, float):
+        out += _field(2, _I32, struct.pack("<f", value))
+        out += _field(20, _VARINT, _varint(1))
+    elif isinstance(value, (bool, int, np.integer)):
+        out += _field(3, _VARINT, _varint(int(value) & ((1 << 64) - 1)))
+        out += _field(20, _VARINT, _varint(2))
+    elif isinstance(value, str):
+        out += _len_field(4, value.encode())
+        out += _field(20, _VARINT, _varint(3))
+    elif isinstance(value, np.ndarray):
+        out += _len_field(5, encode_tensor("", value))
+        out += _field(20, _VARINT, _varint(4))
+    elif isinstance(value, (list, tuple)) and value and isinstance(
+            value[0], float):
+        out += _len_field(7, b"".join(struct.pack("<f", v) for v in value))
+        out += _field(20, _VARINT, _varint(6))
+    elif isinstance(value, (list, tuple)):
+        out += _len_field(8, b"".join(
+            _varint(int(v) & ((1 << 64) - 1)) for v in value))
+        out += _field(20, _VARINT, _varint(7))
+    else:
+        raise TypeError(f"Unsupported attribute {name}={value!r}")
+    return out
+
+
+def encode_node(op_type: str, inputs, outputs, **attrs) -> bytes:
+    out = b"".join(_len_field(1, i.encode()) for i in inputs)
+    out += b"".join(_len_field(2, o.encode()) for o in outputs)
+    out += _len_field(4, op_type.encode())
+    out += b"".join(_len_field(5, encode_attr(k, v))
+                    for k, v in attrs.items())
+    return out
+
+
+def encode_value_info(name: str, shape, elem_type: int = 1) -> bytes:
+    dims = b"".join(_len_field(1, _field(1, _VARINT, _varint(d)))
+                    for d in shape)
+    tensor_type = _field(1, _VARINT, _varint(elem_type)) + _len_field(2, dims)
+    type_proto = _len_field(1, tensor_type)
+    return _len_field(1, name.encode()) + _len_field(2, type_proto)
+
+
+def encode_model(nodes: List[bytes], initializers: Dict[str, np.ndarray],
+                 inputs: List[Tuple[str, List[int]]],
+                 outputs: List[Tuple[str, List[int]]],
+                 name: str = "g") -> bytes:
+    g = b"".join(_len_field(1, n) for n in nodes)
+    g += _len_field(2, name.encode())
+    g += b"".join(_len_field(5, encode_tensor(k, v))
+                  for k, v in initializers.items())
+    g += b"".join(_len_field(11, encode_value_info(n, s))
+                  for n, s in inputs)
+    g += b"".join(_len_field(12, encode_value_info(n, s))
+                  for n, s in outputs)
+    model = _field(1, _VARINT, _varint(8))        # ir_version
+    model += _len_field(7, g)
+    # opset_import { version = 17 }
+    model += _len_field(8, _len_field(1, b"") + _field(2, _VARINT,
+                                                      _varint(17)))
+    return model

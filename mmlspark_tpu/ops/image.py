@@ -1,0 +1,116 @@
+"""Batched image ops on NHWC tensors.
+
+TPU-native replacement for the reference's OpenCV JNI image operations
+(opencv/ImageTransformer.scala → OpenCV Imgproc, expected path, UNVERIFIED;
+SURVEY.md §2.1-2.2).  Where the reference calls per-row JNI into OpenCV, a
+TPU wants *batched* tensor ops: every op here takes/returns a float32
+``(N, H, W, C)`` batch and is jit-friendly, so whole pipelines fuse into one
+XLA program.  Gaussian blur is a separable depthwise convolution (MXU/VPU
+work), resize is ``jax.image.resize`` (XLA gather/dot lowering).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def resize(batch: jnp.ndarray, height: int, width: int,
+           method: str = "linear") -> jnp.ndarray:
+    n, _, _, c = batch.shape
+    return jax.image.resize(batch, (n, height, width, c), method=method)
+
+
+def center_crop(batch: jnp.ndarray, height: int, width: int) -> jnp.ndarray:
+    _, h, w, _ = batch.shape
+    top = max((h - height) // 2, 0)
+    left = max((w - width) // 2, 0)
+    return batch[:, top:top + height, left:left + width, :]
+
+
+def crop(batch: jnp.ndarray, top: int, left: int, height: int,
+         width: int) -> jnp.ndarray:
+    return batch[:, top:top + height, left:left + width, :]
+
+
+def flip(batch: jnp.ndarray, horizontal: bool = True) -> jnp.ndarray:
+    axis = 2 if horizontal else 1
+    return jnp.flip(batch, axis=axis)
+
+
+def bgr_to_rgb(batch: jnp.ndarray) -> jnp.ndarray:
+    return batch[..., ::-1]
+
+
+def to_grayscale(batch: jnp.ndarray, bgr: bool = True) -> jnp.ndarray:
+    """ITU-R BT.601 luma; keeps a single channel."""
+    if batch.shape[-1] == 1:
+        return batch
+    w = jnp.asarray([0.114, 0.587, 0.299] if bgr else [0.299, 0.587, 0.114],
+                    batch.dtype)
+    gray = jnp.tensordot(batch[..., :3], w, axes=[[-1], [0]])
+    return gray[..., None]
+
+
+def threshold(batch: jnp.ndarray, thresh: float, max_val: float = 255.0,
+              kind: str = "binary") -> jnp.ndarray:
+    if kind == "binary":
+        return jnp.where(batch > thresh, max_val, 0.0)
+    if kind == "binary_inv":
+        return jnp.where(batch > thresh, 0.0, max_val)
+    if kind == "trunc":
+        return jnp.minimum(batch, thresh)
+    if kind == "tozero":
+        return jnp.where(batch > thresh, batch, 0.0)
+    raise ValueError(f"Unknown threshold kind {kind!r}")
+
+
+def _gaussian_kernel1d(size: int, sigma: float) -> jnp.ndarray:
+    if sigma <= 0:  # OpenCV convention
+        sigma = 0.3 * ((size - 1) * 0.5 - 1) + 0.8
+    x = jnp.arange(size, dtype=jnp.float32) - (size - 1) / 2.0
+    k = jnp.exp(-0.5 * (x / sigma) ** 2)
+    return k / jnp.sum(k)
+
+
+def gaussian_blur(batch: jnp.ndarray, size: int = 3,
+                  sigma: float = 0.0) -> jnp.ndarray:
+    """Separable depthwise Gaussian: two 1-D convs instead of one 2-D.
+
+    Borders are reflected (OpenCV's BORDER_REFLECT_101 default), so the
+    image mean is preserved at the edges.
+    """
+    k = _gaussian_kernel1d(size, sigma)
+    c = batch.shape[-1]
+    lo, hi = size // 2, (size - 1) // 2
+    padded = jnp.pad(batch, ((0, 0), (lo, hi), (lo, hi), (0, 0)),
+                     mode="reflect")
+    kh = jnp.tile(k.reshape(1, size, 1, 1), (1, 1, 1, c))  # W conv
+    kw = jnp.tile(k.reshape(size, 1, 1, 1), (1, 1, 1, c))  # H conv
+    dn = jax.lax.conv_dimension_numbers(padded.shape, kh.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    out = jax.lax.conv_general_dilated(
+        padded, kh, (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=dn, feature_group_count=c)
+    out = jax.lax.conv_general_dilated(
+        out, kw, (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=dn, feature_group_count=c)
+    return out
+
+
+def normalize(batch: jnp.ndarray, mean: Sequence[float],
+              std: Sequence[float], scale: float = 1.0) -> jnp.ndarray:
+    m = jnp.asarray(mean, batch.dtype)
+    s = jnp.asarray(std, batch.dtype)
+    return (batch * scale - m) / s
+
+
+def unroll(batch: jnp.ndarray) -> jnp.ndarray:
+    """HWC image batch → flat (N, H*W*C) vectors, reference UnrollImage
+    layout (row-major HWC, matching the CNTK ingestion order)."""
+    n = batch.shape[0]
+    return batch.reshape(n, -1)

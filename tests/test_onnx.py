@@ -1,0 +1,145 @@
+"""ONNX codec + executor: encode fixtures, decode, run, check vs numpy."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.onnx import ONNXModel, OnnxGraph, proto
+
+
+def _mlp_model(rng):
+    """x(1,4) -> Gemm -> Relu -> Gemm -> Softmax."""
+    W1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    W2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    nodes = [
+        proto.encode_node("Gemm", ["x", "W1", "b1"], ["h"]),
+        proto.encode_node("Relu", ["h"], ["a"]),
+        proto.encode_node("Gemm", ["a", "W2", "b2"], ["logits"]),
+        proto.encode_node("Softmax", ["logits"], ["probs"], axis=-1),
+    ]
+    blob = proto.encode_model(
+        nodes, {"W1": W1, "b1": b1, "W2": W2, "b2": b2},
+        inputs=[("x", [1, 4])], outputs=[("probs", [1, 3])])
+    return blob, (W1, b1, W2, b2)
+
+
+def _np_softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+class TestProtoCodec:
+    def test_roundtrip_tensor(self, rng):
+        a = rng.normal(size=(3, 5)).astype(np.float32)
+        raw = proto.encode_tensor("t", a)
+        name, back = proto.tensor_to_array(raw)
+        assert name == "t"
+        np.testing.assert_array_equal(back, a)
+
+    def test_known_bytes_varint(self):
+        # field 2 (data_type), varint 7 -> key byte 0x10, value 0x07
+        raw = proto.encode_tensor("", np.zeros(0, np.int64))
+        assert b"\x10\x07" in raw
+
+    def test_parse_model_structure(self, rng):
+        blob, _ = _mlp_model(rng)
+        m = proto.parse_model(blob)
+        g = m["graph"]
+        assert [n["op_type"] for n in g["nodes"]] == [
+            "Gemm", "Relu", "Gemm", "Softmax"]
+        assert set(g["initializers"]) == {"W1", "b1", "W2", "b2"}
+        assert g["nodes"][3]["attrs"]["axis"] == -1
+
+    def test_not_a_model_errors(self):
+        with pytest.raises(ValueError):
+            proto.parse_model(b"\x08\x01")  # varint field only, no graph
+
+
+class TestOnnxExecution:
+    def test_mlp_matches_numpy(self, rng):
+        blob, (W1, b1, W2, b2) = _mlp_model(rng)
+        g = OnnxGraph(blob)
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        got = np.asarray(g(x))
+        want = _np_softmax(np.maximum(x @ W1 + b1, 0) @ W2 + b2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_conv_graph_matches_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        W = rng.normal(size=(6, 3, 3, 3)).astype(np.float32)
+        b = rng.normal(size=(6,)).astype(np.float32)
+        nodes = [
+            proto.encode_node("Conv", ["x", "W", "b"], ["c"],
+                              kernel_shape=[3, 3], pads=[1, 1, 1, 1],
+                              strides=[2, 2]),
+            proto.encode_node("Relu", ["c"], ["r"]),
+            proto.encode_node("GlobalAveragePool", ["r"], ["p"]),
+            proto.encode_node("Flatten", ["p"], ["y"], axis=1),
+        ]
+        blob = proto.encode_model(nodes, {"W": W, "b": b},
+                                  inputs=[("x", [1, 3, 16, 16])],
+                                  outputs=[("y", [1, 6])])
+        g = OnnxGraph(blob)
+        x = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+        got = np.asarray(g(x))
+        with torch.no_grad():
+            tc = torch.nn.functional.conv2d(
+                torch.from_numpy(x), torch.from_numpy(W),
+                torch.from_numpy(b), stride=2, padding=1)
+            want = torch.relu(tc).mean(dim=(2, 3)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_unsupported_op_raises_with_name(self, rng):
+        nodes = [proto.encode_node("FancyNewOp", ["x"], ["y"])]
+        blob = proto.encode_model(nodes, {}, [("x", [1, 4])],
+                                  [("y", [1, 4])])
+        g = OnnxGraph(blob)
+        with pytest.raises(NotImplementedError, match="FancyNewOp"):
+            g(np.zeros((1, 4), np.float32))
+
+
+class TestONNXModelTransformer:
+    def test_transform_vector_column(self, rng):
+        blob, (W1, b1, W2, b2) = _mlp_model(rng)
+        m = ONNXModel(model_bytes=blob, inputCol="features",
+                      outputCol="probs", miniBatchSize=3)
+        X = rng.normal(size=(7, 4))
+        out = m.transform({"features": X, "label": np.zeros(7)})
+        assert out["probs"].shape == (7, 3)
+        want = _np_softmax(
+            np.maximum(X.astype(np.float32) @ W1 + b1, 0) @ W2 + b2)
+        np.testing.assert_allclose(out["probs"], want, rtol=1e-4, atol=1e-5)
+
+    def test_model_io_introspection(self, rng):
+        blob, _ = _mlp_model(rng)
+        m = ONNXModel(model_bytes=blob)
+        assert list(m.getModelInputs()) == ["x"]
+        assert m.getModelOutputs() == ["probs"]
+
+    def test_persistence_roundtrip(self, rng, tmp_path):
+        blob, _ = _mlp_model(rng)
+        m = ONNXModel(model_bytes=blob, inputCol="features",
+                      outputCol="out")
+        m.save(str(tmp_path / "onnx"))
+        m2 = ONNXModel.load(str(tmp_path / "onnx"))
+        X = rng.normal(size=(3, 4))
+        a = m.transform({"features": X})["out"]
+        b = m2.transform({"features": X})["out"]
+        np.testing.assert_allclose(a, b)
+
+    def test_image_shape_reshape(self, rng):
+        # flat vectors reshaped to NCHW when the model expects images
+        W = rng.normal(size=(2, 3, 1, 1)).astype(np.float32)
+        nodes = [proto.encode_node("Conv", ["x", "W"], ["c"],
+                                   kernel_shape=[1, 1]),
+                 proto.encode_node("GlobalAveragePool", ["c"], ["p"]),
+                 proto.encode_node("Flatten", ["p"], ["y"], axis=1)]
+        blob = proto.encode_model(nodes, {"W": W},
+                                  inputs=[("x", [1, 3, 4, 4])],
+                                  outputs=[("y", [1, 2])])
+        m = ONNXModel(model_bytes=blob, inputCol="features",
+                      outputCol="out", miniBatchSize=2)
+        X = rng.normal(size=(3, 48))
+        out = m.transform({"features": X})
+        assert out["out"].shape == (3, 2)

@@ -1,0 +1,132 @@
+"""LightGBMRanker (lambdarank) tests: gradient structure + ranking quality."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.gbdt import LightGBMRanker, LightGBMRankerModel, ndcg_at_k
+from mmlspark_tpu.gbdt.ranking import make_lambdarank_grad_fn, pack_queries
+
+
+def _synthetic_ranking(n_queries=120, group=12, f=10, seed=0):
+    """Relevance driven by a linear utility; labels are graded 0-4."""
+    rng = np.random.default_rng(seed)
+    n = n_queries * group
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    utility = X @ w + rng.normal(size=n) * 0.5
+    q = np.repeat(np.arange(n_queries), group)
+    labels = np.zeros(n)
+    for qq in range(n_queries):
+        m = q == qq
+        labels[m] = np.clip(
+            np.digitize(utility[m], np.quantile(utility[m],
+                                                [0.5, 0.75, 0.9, 0.97])), 0, 4)
+    return {"features": X, "label": labels, "query": q}
+
+
+class TestPackQueries:
+    def test_pack_shapes_and_masks(self):
+        q = np.array([3, 1, 3, 2, 1, 3])
+        order, qidx, qmask = pack_queries(q)
+        assert qidx.shape == qmask.shape == (3, 3)
+        # each row of qidx indexes a contiguous run of the sorted order
+        assert qmask.sum() == 6
+
+
+class TestLambdarankGradients:
+    def test_gradients_push_relevant_up(self):
+        # one query, clear ordering: higher label should get negative grad
+        labels = np.array([0.0, 1.0, 2.0])
+        q = np.zeros(3, np.int64)
+        fn = make_lambdarank_grad_fn(labels, q)
+        g, h = fn(np.zeros(3, np.float32))
+        g = np.asarray(g)
+        assert g[2] < 0 < g[0]  # most relevant pushed up (negative grad)
+        assert np.asarray(h).min() > 0
+        assert abs(g.sum()) < 1e-5  # lambdas are antisymmetric
+
+    def test_no_pairs_no_gradient(self):
+        labels = np.array([1.0, 1.0, 1.0])
+        fn = make_lambdarank_grad_fn(labels, np.zeros(3, np.int64))
+        g, _ = fn(np.zeros(3, np.float32))
+        np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-7)
+
+    def test_cross_query_pairs_excluded(self):
+        # two queries with opposite labels; only within-query pairs count
+        labels = np.array([0.0, 2.0, 2.0, 0.0])
+        q = np.array([0, 0, 1, 1])
+        fn = make_lambdarank_grad_fn(labels, q)
+        g, _ = fn(np.asarray([0.0, 0.0, 0.0, 0.0], np.float32))
+        g = np.asarray(g)
+        assert g[1] < 0 and g[2] < 0 and g[0] > 0 and g[3] > 0
+
+    def test_ragged_query_sizes(self):
+        labels = np.array([0, 1, 0, 1, 2, 3, 0.0])
+        q = np.array([0, 0, 1, 1, 1, 1, 2])
+        fn = make_lambdarank_grad_fn(labels, q)
+        g, h = fn(np.zeros(7, np.float32))
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.asarray(g)[6] == 0  # single-item query has no pairs
+
+
+class TestRankerEndToEnd:
+    def test_ndcg_improves_over_random(self):
+        data = _synthetic_ranking()
+        model = LightGBMRanker(numIterations=30, numLeaves=15,
+                               minDataInLeaf=5, groupCol="query").fit(data)
+        out = model.transform(data)
+        scores = np.asarray(out["prediction"])
+        ndcg = ndcg_at_k(scores, data["label"], data["query"], k=10)
+        rand = ndcg_at_k(np.random.default_rng(0).normal(size=len(scores)),
+                         data["label"], data["query"], k=10)
+        assert ndcg > rand + 0.15, (ndcg, rand)
+        assert ndcg > 0.75, ndcg
+
+    def test_model_exports_lambdarank_objective(self):
+        data = _synthetic_ranking(n_queries=20)
+        model = LightGBMRanker(numIterations=3, numLeaves=5,
+                               groupCol="query").fit(data)
+        txt = model.getNativeModel()
+        assert "objective=lambdarank" in txt
+
+    def test_persistence_roundtrip(self, tmp_path):
+        data = _synthetic_ranking(n_queries=20)
+        model = LightGBMRanker(numIterations=3, numLeaves=5,
+                               groupCol="query").fit(data)
+        model.save(str(tmp_path / "rk"))
+        loaded = LightGBMRankerModel.load(str(tmp_path / "rk"))
+        a = model.transform(data)["prediction"]
+        b = loaded.transform(data)["prediction"]
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+class TestRankerReviewRegressions:
+    def test_early_stopping_with_ndcg(self):
+        data = _synthetic_ranking(n_queries=60)
+        val = np.zeros(len(data["label"]), bool)
+        val[::5] = True
+        data["isVal"] = val
+        model = LightGBMRanker(numIterations=100, numLeaves=15,
+                               learningRate=0.5, minDataInLeaf=5,
+                               groupCol="query", earlyStoppingRound=3,
+                               validationIndicatorCol="isVal").fit(data)
+        assert len(model.getModel().trees) < 100
+
+    def test_weights_affect_training(self):
+        data = _synthetic_ranking(n_queries=30)
+        w = np.ones(len(data["label"]))
+        data["w"] = w
+        m1 = LightGBMRanker(numIterations=3, numLeaves=5, groupCol="query",
+                            weightCol="w").fit(data)
+        data["w"] = np.linspace(0.1, 5.0, len(w))
+        m2 = LightGBMRanker(numIterations=3, numLeaves=5, groupCol="query",
+                            weightCol="w").fit(data)
+        assert m1.getModel().save_native_model_string() != \
+            m2.getModel().save_native_model_string()
+
+    def test_lambdarank_on_classifier_clear_error(self, binary_table):
+        from mmlspark_tpu.gbdt import LightGBMRegressor
+        with pytest.raises(ValueError, match="LightGBMRanker"):
+            LightGBMRegressor(objective="lambdarank", numIterations=2).fit(
+                {"features": binary_table["features"],
+                 "label": binary_table["label"]})
