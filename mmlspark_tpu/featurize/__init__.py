@@ -1,0 +1,39 @@
+"""Automated feature engineering (reference ``featurize/`` package).
+
+Reference: src/main/scala/com/microsoft/ml/spark/featurize/ (expected path,
+UNVERIFIED — SURVEY.md §2.1).  Auto-vectorization of mixed-type columns,
+missing-data cleaning, value indexing, type conversion, and the text
+featurization pipeline-in-a-box.
+"""
+
+from .featurize import (
+    AssembleFeatures,
+    AssembleFeaturesModel,
+    CleanMissingData,
+    CleanMissingDataModel,
+    CountSelector,
+    CountSelectorModel,
+    DataConversion,
+    Featurize,
+    FeaturizeModel,
+    IndexToValue,
+    ValueIndexer,
+    ValueIndexerModel,
+)
+from .text import (
+    MultiNGram,
+    PageSplitter,
+    TextFeaturizer,
+    TextFeaturizerModel,
+)
+
+__all__ = [
+    "AssembleFeatures", "AssembleFeaturesModel",
+    "CleanMissingData", "CleanMissingDataModel",
+    "CountSelector", "CountSelectorModel",
+    "DataConversion",
+    "Featurize", "FeaturizeModel",
+    "IndexToValue", "ValueIndexer", "ValueIndexerModel",
+    "MultiNGram", "PageSplitter",
+    "TextFeaturizer", "TextFeaturizerModel",
+]

@@ -1,0 +1,71 @@
+"""MurmurHash3 (x86 32-bit) feature hashing.
+
+Spark's ``HashingTF`` and VW both hash terms with murmur3-32; the reference
+inherits that through Spark ML and the VW JNI featurizer
+(featurize/text/TextFeaturizer.scala and vw/VowpalWabbitFeaturizer.scala,
+expected paths, UNVERIFIED — SURVEY.md §2.1).  This implementation matches
+Spark's ``Murmur3_x86_32`` on UTF-8 bytes with the default seed 42, so hashed
+feature indices are bit-compatible with the reference's — a model trained
+there scores identically here.
+
+A C++ fast path (``mmlspark_tpu.native``) is used automatically when the
+native library is built; this pure-python fallback keeps CI hermetic.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+_MASK = 0xFFFFFFFF
+
+
+def murmur3_32(data: bytes, seed: int = 42) -> int:
+    """murmur3 x86 32-bit of ``data``; returns a *signed* int32 like the JVM."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & _MASK
+    n4 = len(data) // 4 * 4
+    for i in range(0, n4, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & _MASK
+        k = ((k << 15) | (k >> 17)) & _MASK
+        k = (k * c2) & _MASK
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _MASK
+        h = (h * 5 + 0xE6546B64) & _MASK
+    tail = data[n4:]
+    if tail:
+        k = int.from_bytes(tail.ljust(4, b"\0"), "little")
+        k = (k * c1) & _MASK
+        k = ((k << 15) | (k >> 17)) & _MASK
+        k = (k * c2) & _MASK
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _MASK
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _MASK
+    h ^= h >> 16
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def _native_hasher():
+    try:
+        from mmlspark_tpu import native
+        if native.available():
+            return native.murmur3_batch
+    except ImportError:  # pragma: no cover
+        pass
+    return None
+
+
+def hash_term(term: str, num_features: int, seed: int = 42) -> int:
+    """Non-negative bucket index of ``term`` (Spark HashingTF semantics)."""
+    return murmur3_32(term.encode("utf-8"), seed) % num_features
+
+
+def hash_terms(terms: Iterable[str], num_features: int,
+               seed: int = 42) -> List[int]:
+    native = _native_hasher()
+    if native is not None:
+        return [h % num_features for h in native(list(terms), seed)]
+    return [hash_term(t, num_features, seed) for t in terms]

@@ -1,0 +1,195 @@
+"""Text featurization stages.
+
+Reference: featurize/text/TextFeaturizer.scala, MultiNGram.scala,
+PageSplitter.scala (expected paths, UNVERIFIED — SURVEY.md §2.1).
+
+``TextFeaturizer`` is the reference's pipeline-in-a-box: tokenize →
+(stopwords) → (n-grams) → hashingTF → IDF, collapsed here into one
+estimator whose model applies the whole chain.  Hashing is murmur3-32 with
+Spark's seed so indices match the reference bit-for-bit
+(:mod:`mmlspark_tpu.featurize.hashing`).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.params import HasInputCol, HasOutputCol, Param, TypeConverters
+from ..core.pipeline import Estimator, Model, Transformer
+from ..core.schema import DataTable
+from ..core import serialize
+from .hashing import hash_terms
+
+# english stop words (scikit-learn/Spark common subset, frozen here so the
+# behavior never shifts under us)
+_STOP_WORDS = frozenset("""
+a about above after again against all am an and any are as at be because been
+before being below between both but by could did do does doing down during
+each few for from further had has have having he her here hers herself him
+himself his how i if in into is it its itself just me more most my myself no
+nor not now of off on once only or other our ours ourselves out over own same
+she should so some such than that the their theirs them themselves then there
+these they this those through to too under until up very was we were what when
+where which while who whom why will with you your yours yourself yourselves
+""".split())
+
+
+class _TextParams(HasInputCol, HasOutputCol):
+    tokenizerPattern = Param(
+        "tokenizerPattern", "Regex the tokenizer splits on (gaps)",
+        default=r"\s+", typeConverter=TypeConverters.toString)
+    toLowercase = Param("toLowercase", "Lowercase before tokenizing",
+                        default=True, typeConverter=TypeConverters.toBool)
+    useStopWordsRemover = Param("useStopWordsRemover",
+                                "Remove english stop words",
+                                default=False,
+                                typeConverter=TypeConverters.toBool)
+    useNGram = Param("useNGram", "Emit n-grams instead of unigrams",
+                     default=False, typeConverter=TypeConverters.toBool)
+    nGramLength = Param("nGramLength", "n-gram length", default=2,
+                        typeConverter=TypeConverters.toInt)
+    numFeatures = Param("numFeatures", "Hashing dimension",
+                        default=1 << 18, typeConverter=TypeConverters.toInt)
+    binary = Param("binary", "Binary term counts", default=False,
+                   typeConverter=TypeConverters.toBool)
+    useIDF = Param("useIDF", "Rescale by inverse document frequency",
+                   default=True, typeConverter=TypeConverters.toBool)
+    minDocFreq = Param("minDocFreq", "Minimum document frequency for IDF",
+                       default=1, typeConverter=TypeConverters.toInt)
+
+
+def _tokenize(text: str, pattern: str, lower: bool) -> List[str]:
+    if lower:
+        text = text.lower()
+    return [t for t in re.split(pattern, text.strip()) if t]
+
+
+def _ngrams(tokens: List[str], n: int) -> List[str]:
+    return [" ".join(tokens[i:i + n]) for i in range(len(tokens) - n + 1)]
+
+
+class TextFeaturizer(_TextParams, Estimator):
+    """Tokenize → stopwords → n-grams → hashingTF → IDF in one estimator."""
+
+    def _terms(self, text: str) -> List[str]:
+        toks = _tokenize(str(text), self.getTokenizerPattern(),
+                         self.getToLowercase())
+        if self.getUseStopWordsRemover():
+            toks = [t for t in toks if t not in _STOP_WORDS]
+        if self.getUseNGram():
+            toks = _ngrams(toks, self.getNGramLength())
+        return toks
+
+    def _counts(self, text: str) -> np.ndarray:
+        dim = self.getNumFeatures()
+        vec = np.zeros(dim)
+        idxs = hash_terms(self._terms(text), dim)
+        for i in idxs:
+            vec[i] += 1.0
+        if self.getBinary():
+            vec = (vec > 0).astype(np.float64)
+        return vec
+
+    def _fit(self, table: DataTable) -> "TextFeaturizerModel":
+        texts = table[self.getInputCol()]
+        dim = self.getNumFeatures()
+        idf = None
+        if self.getUseIDF():
+            df = np.zeros(dim)
+            for t in texts:
+                df += self._counts(t) > 0
+            n_docs = len(texts)
+            df = np.where(df >= self.getMinDocFreq(), df, 0.0)
+            # Spark IDF formula: log((m+1)/(df+1))
+            idf = np.log((n_docs + 1.0) / (df + 1.0))
+            idf = np.where(df > 0, idf, 0.0)
+        model = TextFeaturizerModel(idf=idf)
+        model.setParams(**{k: v for k, v in self._iterSetParams()})
+        return model
+
+
+class TextFeaturizerModel(_TextParams, Model):
+    def __init__(self, idf: Optional[np.ndarray] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._idf = None if idf is None else np.asarray(idf)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        helper = TextFeaturizer()
+        helper._paramMap = dict(self._paramMap)
+        texts = table[self.getInputCol()]
+        rows = np.stack([helper._counts(t) for t in texts]) if len(texts) \
+            else np.zeros((0, self.getNumFeatures()))
+        if self._idf is not None:
+            rows = rows * self._idf[None, :]
+        return table.withColumn(self.getOutputCol(), rows)
+
+    def _save_extra(self, path: str) -> None:
+        if self._idf is not None:
+            serialize.save_arrays(path, idf=self._idf)
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        self._idf = None
+        if os.path.exists(os.path.join(path, "arrays.npz")):
+            self._idf = serialize.load_arrays(path)["idf"]
+
+
+class MultiNGram(HasInputCol, HasOutputCol, Transformer):
+    """Emits the concatenation of n-grams for several lengths at once
+    (reference featurize/text/MultiNGram.scala)."""
+
+    lengths = Param("lengths", "The n-gram lengths to extract",
+                    default=[1, 2, 3], typeConverter=TypeConverters.toListInt)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        col = table[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        for r, tokens in enumerate(col):
+            toks = list(tokens)
+            grams: List[str] = []
+            for n in self.getLengths():
+                grams.extend(_ngrams(toks, n))
+            out[r] = grams
+        return table.withColumn(self.getOutputCol(), out)
+
+
+class PageSplitter(HasInputCol, HasOutputCol, Transformer):
+    """Splits long strings into pages within [min,max] character bounds,
+    preferring whitespace boundaries (reference featurize/text/PageSplitter
+    .scala — used to chunk documents for per-page cognitive calls)."""
+
+    maximumPageLength = Param("maximumPageLength",
+                              "Maximum number of characters per page",
+                              default=5000, typeConverter=TypeConverters.toInt)
+    minimumPageLength = Param(
+        "minimumPageLength",
+        "Minimum characters before a whitespace split is taken",
+        default=4500, typeConverter=TypeConverters.toInt)
+    boundaryRegex = Param("boundaryRegex", "Regex marking preferred breaks",
+                          default=r"\s", typeConverter=TypeConverters.toString)
+
+    def _split(self, text: str) -> List[str]:
+        lo, hi = self.getMinimumPageLength(), self.getMaximumPageLength()
+        pat = re.compile(self.getBoundaryRegex())
+        pages = []
+        s = str(text)
+        while len(s) > hi:
+            cut = hi
+            for i in range(hi, lo - 1, -1):
+                if pat.fullmatch(s[i - 1]):
+                    cut = i
+                    break
+            pages.append(s[:cut])
+            s = s[cut:]
+        pages.append(s)
+        return pages
+
+    def _transform(self, table: DataTable) -> DataTable:
+        col = table[self.getInputCol()]
+        out = np.empty(len(col), dtype=object)
+        for r, text in enumerate(col):
+            out[r] = self._split(text)
+        return table.withColumn(self.getOutputCol(), out)

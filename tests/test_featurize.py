@@ -1,0 +1,182 @@
+"""Featurize package tests (SURVEY.md §2.1 featurize/)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import DataTable
+from mmlspark_tpu.featurize import (
+    AssembleFeatures, CleanMissingData, CleanMissingDataModel, CountSelector,
+    DataConversion, Featurize, FeaturizeModel, IndexToValue, MultiNGram,
+    PageSplitter, TextFeaturizer, TextFeaturizerModel, ValueIndexer,
+    ValueIndexerModel)
+from mmlspark_tpu.featurize.hashing import hash_term, murmur3_32
+
+
+def test_murmur3_reference_values():
+    # canonical murmur3_x86_32 test vectors (seed 0)
+    assert murmur3_32(b"hello", seed=0) == 613153351
+    assert murmur3_32(b"", seed=0) == 0
+    # bucket index is always non-negative
+    for t in ["a", "bb", "ccc", "dddd", "the quick brown fox"]:
+        assert 0 <= hash_term(t, 1024) < 1024
+
+
+def test_clean_missing_data(tmp_path):
+    t = DataTable({
+        "a": np.array([1.0, np.nan, 3.0]),
+        "b": np.array([np.nan, 2.0, 4.0]),
+    })
+    model = CleanMissingData(inputCols=["a", "b"],
+                             cleaningMode="Mean").fit(t)
+    out = model.transform(t)
+    assert out["a"][1] == pytest.approx(2.0)
+    assert out["b"][0] == pytest.approx(3.0)
+
+    median = CleanMissingData(inputCols=["a"], cleaningMode="Median").fit(t)
+    assert median.fillValues == [pytest.approx(2.0)]
+    custom = CleanMissingData(inputCols=["a"], cleaningMode="Custom",
+                              customValue=-1).fit(t)
+    assert custom.transform(t)["a"][1] == -1.0
+
+    p = str(tmp_path / "cmd")
+    model.save(p)
+    loaded = CleanMissingDataModel.load(p)
+    out2 = loaded.transform(t)
+    np.testing.assert_allclose(out2["a"], out["a"])
+
+
+def test_value_indexer_roundtrip(tmp_path):
+    t = DataTable({"cat": np.array(["b", "a", "c", "a"], dtype=object)})
+    model = ValueIndexer(inputCol="cat", outputCol="idx").fit(t)
+    out = model.transform(t)
+    assert model.levels == ["a", "b", "c"]
+    np.testing.assert_array_equal(out["idx"], [1, 0, 2, 0])
+
+    # unseen value maps to -1
+    t2 = DataTable({"cat": np.array(["z"], dtype=object)})
+    assert model.transform(t2)["idx"][0] == -1
+
+    inv = IndexToValue(inputCol="idx", outputCol="back",
+                       levels=model.levels)
+    back = inv.transform(out)
+    assert list(back["back"]) == ["b", "a", "c", "a"]
+
+    p = str(tmp_path / "vi")
+    model.save(p)
+    loaded = ValueIndexerModel.load(p)
+    assert loaded.levels == model.levels
+
+
+def test_data_conversion():
+    t = DataTable({"x": np.array([1.7, 2.2]), "y": np.array([1, 0])})
+    out = DataConversion(cols=["x"], convertTo="integer").transform(t)
+    assert out["x"].dtype == np.int32
+    out = DataConversion(cols=["y"], convertTo="boolean").transform(t)
+    assert out["y"].dtype == np.bool_
+    out = DataConversion(cols=["x"], convertTo="string").transform(t)
+    assert out["x"].dtype == object
+
+
+def test_count_selector(tmp_path):
+    mat = np.array([[1.0, 0.0, 2.0], [3.0, 0.0, 0.0]])
+    t = DataTable({"features": mat})
+    model = CountSelector(inputCol="features", outputCol="out").fit(t)
+    out = model.transform(t)
+    assert out["out"].shape == (2, 2)
+    np.testing.assert_array_equal(model.indices, [0, 2])
+
+    p = str(tmp_path / "cs")
+    model.save(p)
+    from mmlspark_tpu.featurize import CountSelectorModel
+    loaded = CountSelectorModel.load(p)
+    np.testing.assert_array_equal(loaded.indices, model.indices)
+
+
+def test_featurize_mixed_types(tmp_path):
+    n = 50
+    rng = np.random.default_rng(0)
+    t = DataTable({
+        "num": rng.normal(size=n),
+        "num_nan": np.where(rng.random(n) < 0.2, np.nan, rng.normal(size=n)),
+        "cat": np.array(rng.choice(["x", "y", "z"], size=n), dtype=object),
+        "vec": rng.normal(size=(n, 4)),
+    })
+    model = Featurize(inputCols=["num", "num_nan", "cat", "vec"]).fit(t)
+    out = model.transform(t)
+    feats = out["features"]
+    # 1 + 1 + 3 (one-hot) + 4 = 9 slots
+    assert feats.shape == (n, 9)
+    assert np.isfinite(feats).all()
+
+    p = str(tmp_path / "fz")
+    model.save(p)
+    loaded = FeaturizeModel.load(p)
+    np.testing.assert_allclose(loaded.transform(t)["features"], feats)
+
+
+def test_featurize_no_onehot_indexes():
+    t = DataTable({"cat": np.array(["a", "b", "a"], dtype=object)})
+    model = Featurize(inputCols=["cat"], oneHotEncodeCategoricals=False).fit(t)
+    out = model.transform(t)
+    assert out["features"].shape == (3, 1)
+    np.testing.assert_array_equal(out["features"][:, 0], [0, 1, 0])
+
+
+def test_assemble_features_alias():
+    t = DataTable({"a": np.array([1.0, 2.0]), "b": np.array([3.0, 4.0])})
+    model = AssembleFeatures(columnsToFeaturize=["a", "b"]).fit(t)
+    out = model.transform(t)
+    np.testing.assert_allclose(out["features"],
+                               [[1.0, 3.0], [2.0, 4.0]])
+
+
+def test_text_featurizer(tmp_path):
+    texts = np.array([
+        "the cat sat on the mat",
+        "the dog sat on the log",
+        "cats and dogs",
+    ], dtype=object)
+    t = DataTable({"text": texts})
+    tf = TextFeaturizer(inputCol="text", outputCol="features",
+                        numFeatures=256)
+    model = tf.fit(t)
+    out = model.transform(t)
+    assert out["features"].shape == (3, 256)
+    # idf downweights "the"/"sat" terms shared by docs but output is nonzero
+    assert (out["features"] != 0).any(axis=1).all()
+
+    p = str(tmp_path / "tf")
+    model.save(p)
+    loaded = TextFeaturizerModel.load(p)
+    np.testing.assert_allclose(loaded.transform(t)["features"],
+                               out["features"])
+
+
+def test_text_featurizer_ngram_stopwords():
+    t = DataTable({"text": np.array(["the cat sat"], dtype=object)})
+    model = TextFeaturizer(inputCol="text", outputCol="f", numFeatures=64,
+                           useStopWordsRemover=True, useNGram=True,
+                           nGramLength=2, useIDF=False).fit(t)
+    out = model.transform(t)
+    # "the" removed -> tokens [cat, sat] -> one bigram "cat sat"
+    assert out["f"].sum() == 1.0
+
+
+def test_multi_ngram():
+    t = DataTable({"tokens": np.array([["a", "b", "c"]], dtype=object)})
+    out = MultiNGram(inputCol="tokens", outputCol="grams",
+                     lengths=[1, 2]).transform(t)
+    assert out["grams"][0] == ["a", "b", "c", "a b", "b c"]
+
+
+def test_page_splitter():
+    text = "word " * 100  # 500 chars
+    t = DataTable({"text": np.array([text], dtype=object)})
+    out = PageSplitter(inputCol="text", outputCol="pages",
+                       maximumPageLength=120,
+                       minimumPageLength=80).transform(t)
+    pages = out["pages"][0]
+    assert all(len(p) <= 120 for p in pages)
+    assert "".join(pages) == text
+    # splits land on whitespace
+    assert all(p.endswith(" ") for p in pages[:-1])
