@@ -1,0 +1,24 @@
+"""AutoML (reference ``automl/`` package).
+
+Reference: automl/FindBestModel.scala, automl/TuneHyperparameters.scala,
+automl/HyperparamBuilder.scala (expected paths, UNVERIFIED — SURVEY.md
+§2.1).
+"""
+
+from .automl import (
+    BestModel,
+    DiscreteHyperParam,
+    FindBestModel,
+    GridSpace,
+    HyperparamBuilder,
+    RandomSpace,
+    RangeHyperParam,
+    TuneHyperparameters,
+    TuneHyperparametersModel,
+)
+
+__all__ = [
+    "BestModel", "DiscreteHyperParam", "FindBestModel", "GridSpace",
+    "HyperparamBuilder", "RandomSpace", "RangeHyperParam",
+    "TuneHyperparameters", "TuneHyperparametersModel",
+]

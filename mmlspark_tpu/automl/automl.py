@@ -1,0 +1,328 @@
+"""FindBestModel / TuneHyperparameters / HyperparamBuilder.
+
+Reference: automl/*.scala (expected paths, UNVERIFIED — SURVEY.md §2.1).
+Task-parallel candidate evaluation (SURVEY.md §2.3 "task parallelism") maps
+to a thread pool here: each candidate fit is itself jax-jitted compute, so
+threads overlap host-side orchestration while XLA serializes device work.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.params import HasLabelCol, Param, TypeConverters, HasSeed
+from ..core.pipeline import Estimator, Model, Transformer
+from ..core.schema import DataTable
+from ..core import serialize
+from ..train.metrics import ComputeModelStatistics
+
+_MAXIMIZE = {"AUC", "accuracy", "precision", "recall", "R^2"}
+_METRIC_COL = {"auc": "AUC", "accuracy": "accuracy",
+               "precision": "precision", "recall": "recall",
+               "mse": "mean_squared_error",
+               "rmse": "root_mean_squared_error",
+               "mae": "mean_absolute_error", "r2": "R^2"}
+
+
+def _evaluate(model: Transformer, table: DataTable, metric: str,
+              labelCol: str) -> float:
+    scored = model._transform(table)
+    kind = ("classification"
+            if _METRIC_COL[metric] in ("AUC", "accuracy", "precision",
+                                       "recall") else "regression")
+    stats = ComputeModelStatistics(
+        evaluationMetric=kind, labelCol=labelCol)._transform(scored)
+    return float(stats[_METRIC_COL[metric]][0])
+
+
+class _EvalParams(HasLabelCol):
+    evaluationMetric = Param("evaluationMetric",
+                             "Metric to optimize: auc|accuracy|precision|"
+                             "recall|mse|rmse|mae|r2",
+                             default="auc",
+                             typeConverter=TypeConverters.toString,
+                             validator=lambda v: v in _METRIC_COL)
+
+
+class FindBestModel(_EvalParams, Estimator):
+    """Fits/evaluates candidate models and keeps the best
+    (automl/FindBestModel.scala)."""
+
+    def __init__(self, models: Optional[Sequence[Estimator]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._models = list(models or [])
+
+    def setModels(self, models: Sequence[Estimator]) -> "FindBestModel":
+        self._models = list(models)
+        return self
+
+    def getModels(self) -> List[Estimator]:
+        return list(self._models)
+
+    def _fit(self, table: DataTable) -> "BestModel":
+        if not self._models:
+            raise ValueError("FindBestModel needs candidate models")
+        metric = self.getEvaluationMetric()
+        maximize = _METRIC_COL[metric] in _MAXIMIZE
+        rows: List[Dict[str, Any]] = []
+        best_val, best_fitted = None, None
+        for est in self._models:
+            fitted = est._fit(table) if isinstance(est, Estimator) else est
+            val = _evaluate(fitted, table, metric, self.getLabelCol())
+            rows.append({"model": type(est).__name__, metric: val})
+            better = (not np.isnan(val)
+                      and (best_val is None
+                           or (val > best_val if maximize else val < best_val)))
+            if better:
+                best_val, best_fitted = val, fitted
+        if best_fitted is None:
+            raise ValueError(
+                "Every candidate produced a NaN metric; check the "
+                "evaluation data")
+        model = BestModel(fitted=best_fitted, metric_value=best_val,
+                          all_results=rows)
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+
+class BestModel(_EvalParams, Model):
+    def __init__(self, fitted: Optional[Transformer] = None,
+                 metric_value: Optional[float] = None,
+                 all_results: Optional[List[Dict[str, Any]]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._fitted = fitted
+        self._metric_value = metric_value
+        self._all_results = list(all_results or [])
+
+    def getBestModel(self) -> Transformer:
+        return self._fitted
+
+    def getBestModelMetrics(self) -> Optional[float]:
+        return self._metric_value
+
+    def getAllModelMetrics(self) -> List[Dict[str, Any]]:
+        return list(self._all_results)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        return self._fitted._transform(table)
+
+    def _save_extra(self, path: str) -> None:
+        serialize.save_stage(self._fitted, os.path.join(path, "best"),
+                             overwrite=True)
+        serialize.save_json(path, "results", {
+            "metric_value": self._metric_value,
+            "all_results": self._all_results})
+
+    def _load_extra(self, path: str) -> None:
+        self._fitted = serialize.load_stage(os.path.join(path, "best"))
+        info = serialize.load_json(path, "results")
+        self._metric_value = info["metric_value"]
+        self._all_results = info["all_results"]
+
+
+# -- hyperparameter spaces ----------------------------------------------------
+
+class DiscreteHyperParam:
+    """A finite set of values (automl/HyperparamBuilder.scala)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        return self.values[int(rng.integers(len(self.values)))]
+
+    def grid(self) -> List[Any]:
+        return list(self.values)
+
+
+class RangeHyperParam:
+    """A [lo, hi) range, float or int (automl/HyperparamBuilder.scala)."""
+
+    def __init__(self, lo, hi, isInt: Optional[bool] = None):
+        self.lo, self.hi = lo, hi
+        self.isInt = (isinstance(lo, (int, np.integer))
+                      and isinstance(hi, (int, np.integer))
+                      if isInt is None else isInt)
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        if self.isInt:
+            return int(rng.integers(self.lo, self.hi))
+        return float(rng.uniform(self.lo, self.hi))
+
+    def grid(self, n: int = 5) -> List[Any]:
+        if self.isInt:
+            vals = np.unique(np.linspace(
+                self.lo, max(self.lo, self.hi - 1), n).astype(int))
+            return [int(v) for v in vals]
+        return [float(v) for v in np.linspace(self.lo, self.hi, n)]
+
+
+class HyperparamBuilder:
+    """Collects (paramName → space) pairs."""
+
+    def __init__(self):
+        self._spaces: Dict[str, Any] = {}
+
+    def addHyperparam(self, name: str, space) -> "HyperparamBuilder":
+        self._spaces[name] = space
+        return self
+
+    def build(self) -> Dict[str, Any]:
+        return dict(self._spaces)
+
+
+class RandomSpace:
+    """Random sampling over a space dict."""
+
+    def __init__(self, spaces: Dict[str, Any], seed: int = 0):
+        self._spaces = spaces
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self) -> Dict[str, Any]:
+        return {k: s.sample(self._rng) for k, s in self._spaces.items()}
+
+
+class GridSpace:
+    """Exhaustive cartesian grid over a space dict."""
+
+    def __init__(self, spaces: Dict[str, Any]):
+        import itertools
+        names = list(spaces)
+        grids = [spaces[n].grid() if hasattr(spaces[n], "grid")
+                 else list(spaces[n]) for n in names]
+        self._points = [dict(zip(names, combo))
+                        for combo in itertools.product(*grids)]
+
+    def __len__(self) -> int:
+        return len(self._points)
+
+    def __iter__(self):
+        return iter(self._points)
+
+
+class TuneHyperparameters(_EvalParams, HasSeed, Estimator):
+    """Random/grid search with parallel fits
+    (automl/TuneHyperparameters.scala)."""
+
+    numRuns = Param("numRuns", "Number of random candidates", default=10,
+                    typeConverter=TypeConverters.toInt)
+    parallelism = Param("parallelism", "Concurrent fits", default=4,
+                        typeConverter=TypeConverters.toInt)
+    numFolds = Param("numFolds", "Cross-validation folds (1 = holdout)",
+                     default=3, typeConverter=TypeConverters.toInt)
+    searchMode = Param("searchMode", "random or grid", default="random",
+                       typeConverter=TypeConverters.toString,
+                       validator=lambda v: v in ("random", "grid"))
+
+    def __init__(self, models: Optional[Sequence[Estimator]] = None,
+                 hyperParams: Optional[Dict[str, Any]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._models = list(models or [])
+        self._hyper = dict(hyperParams or {})
+
+    def setModels(self, models) -> "TuneHyperparameters":
+        self._models = list(models)
+        return self
+
+    def setHyperParams(self, spaces: Dict[str, Any]) -> "TuneHyperparameters":
+        self._hyper = dict(spaces)
+        return self
+
+    def _candidates(self) -> List[Dict[str, Any]]:
+        if self.getSearchMode() == "grid":
+            return list(GridSpace(self._hyper))
+        space = RandomSpace(self._hyper, seed=self.getSeed())
+        return [space.sample() for _ in range(self.getNumRuns())]
+
+    def _fit(self, table: DataTable) -> "TuneHyperparametersModel":
+        if not self._models:
+            raise ValueError("TuneHyperparameters needs base models")
+        metric = self.getEvaluationMetric()
+        maximize = _METRIC_COL[metric] in _MAXIMIZE
+        folds = max(1, self.getNumFolds())
+        n = len(table)
+        rng = np.random.default_rng(self.getSeed())
+        perm = rng.permutation(n)
+        fold_of = np.arange(n) % folds
+
+        def eval_candidate(args):
+            est, params = args
+            cand = est.copy({k: v for k, v in params.items()
+                             if est.hasParam(k)})
+            vals = []
+            for f in range(folds):
+                if folds == 1:
+                    cut = max(1, int(0.8 * n))
+                    train_idx, val_idx = perm[:cut], perm[cut:]
+                else:
+                    train_idx = perm[fold_of != f]
+                    val_idx = perm[fold_of == f]
+                fitted = cand._fit(table.take(train_idx))
+                vals.append(_evaluate(fitted, table.take(val_idx), metric,
+                                      self.getLabelCol()))
+            return float(np.mean(vals)), cand
+
+        jobs = [(est, params) for est in self._models
+                for params in self._candidates()]
+        with ThreadPoolExecutor(max_workers=self.getParallelism()) as pool:
+            results = list(pool.map(eval_candidate, jobs))
+
+        scores = np.asarray([v for v, _ in results])
+        # NaN folds (e.g. single-class validation split) must never win
+        scores = np.where(np.isnan(scores),
+                          -np.inf if maximize else np.inf, scores)
+        if not np.isfinite(scores).any():
+            raise ValueError(
+                "Every candidate produced a NaN metric; check that "
+                "validation folds contain both classes")
+        best_i = int(np.argmax(scores) if maximize else np.argmin(scores))
+        best_val, best_est = results[best_i]
+        fitted = best_est._fit(table)  # refit on all rows
+        model = TuneHyperparametersModel(
+            fitted=fitted, metric_value=best_val,
+            best_params={k: v for k, v in jobs[best_i][1].items()})
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+
+class TuneHyperparametersModel(_EvalParams, Model):
+    def __init__(self, fitted: Optional[Transformer] = None,
+                 metric_value: Optional[float] = None,
+                 best_params: Optional[Dict[str, Any]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._fitted = fitted
+        self._metric_value = metric_value
+        self._best_params = dict(best_params or {})
+
+    def getBestModel(self) -> Transformer:
+        return self._fitted
+
+    def getBestModelMetrics(self) -> Optional[float]:
+        return self._metric_value
+
+    def getBestModelInfo(self) -> Dict[str, Any]:
+        return dict(self._best_params)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        return self._fitted._transform(table)
+
+    def _save_extra(self, path: str) -> None:
+        serialize.save_stage(self._fitted, os.path.join(path, "best"),
+                             overwrite=True)
+        serialize.save_json(path, "results", {
+            "metric_value": self._metric_value,
+            "best_params": self._best_params})
+
+    def _load_extra(self, path: str) -> None:
+        self._fitted = serialize.load_stage(os.path.join(path, "best"))
+        info = serialize.load_json(path, "results")
+        self._metric_value = info["metric_value"]
+        self._best_params = info["best_params"]

@@ -87,13 +87,17 @@ class CleanMissingData(_CleanMissingParams, Estimator):
         fills: List[float] = []
         for name in self.getInputCols():
             col = np.asarray(table[name], dtype=np.float64)
-            if mode == "Mean":
-                fill = float(np.nanmean(col)) if np.isfinite(
-                    np.nanmean(col)) else 0.0
-            elif mode == "Median":
-                fill = float(np.nanmedian(col))
-            else:
+            if mode == "Custom":
                 fill = float(self.getCustomValue())
+            else:
+                with np.errstate(all="ignore"):
+                    import warnings
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore", RuntimeWarning)
+                        fill = float(np.nanmean(col) if mode == "Mean"
+                                     else np.nanmedian(col))
+                if not np.isfinite(fill):  # all-NaN column
+                    fill = 0.0
             fills.append(fill)
         model = CleanMissingDataModel(fills=fills)
         model.setParams(**{k: v for k, v in self._iterSetParams()})
@@ -290,12 +294,14 @@ class Featurize(_FeaturizeParams, Estimator):
             else:
                 values = [str(_scalar(v)) for v in col if not _is_missing(v)]
                 levels = sorted(set(values))
-                if len(levels) <= _MAX_ONE_HOT:
+                num_features = int(self.getNumFeatures())
+                if len(levels) <= _MAX_ONE_HOT or num_features == 0:
+                    # numFeatures=0 opts out of hashing entirely: index
                     kind = ("onehot" if self.getOneHotEncodeCategoricals()
-                            else "index")
+                            and len(levels) <= _MAX_ONE_HOT else "index")
                     specs.append({"col": name, "kind": kind, "levels": levels})
                 else:
-                    dim = min(int(self.getNumFeatures()) or 4096, 4096)
+                    dim = min(num_features, 4096)
                     specs.append({"col": name, "kind": "hash", "dim": dim})
         model = FeaturizeModel(specs=specs)
         model.setParams(**{k: v for k, v in self._iterSetParams()

@@ -100,7 +100,8 @@ class TextFeaturizer(_TextParams, Estimator):
         if self.getUseIDF():
             df = np.zeros(dim)
             for t in texts:
-                df += self._counts(t) > 0
+                idxs = np.unique(hash_terms(self._terms(t), dim))
+                df[idxs] += 1.0
             n_docs = len(texts)
             df = np.where(df >= self.getMinDocFreq(), df, 0.0)
             # Spark IDF formula: log((m+1)/(df+1))

@@ -1,0 +1,36 @@
+"""Utility pipeline stages (reference ``stages/`` package).
+
+Reference: src/main/scala/com/microsoft/ml/spark/stages/*.scala (expected
+paths, UNVERIFIED — SURVEY.md §2.1): ~20 small transformers for column
+manipulation, batching, partitioning, timing, and text cleanup.
+"""
+
+from .stages import (
+    Cacher,
+    DropColumns,
+    EnsembleByKey,
+    Explode,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    Lambda,
+    MultiColumnAdapter,
+    MultiColumnAdapterModel,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    Timer,
+    UDFTransformer,
+    UnicodeNormalize,
+)
+
+__all__ = [
+    "Cacher", "DropColumns", "EnsembleByKey", "Explode",
+    "FixedMiniBatchTransformer", "FlattenBatch", "Lambda",
+    "MultiColumnAdapter", "MultiColumnAdapterModel", "RenameColumn",
+    "Repartition", "SelectColumns",
+    "StratifiedRepartition", "SummarizeData", "TextPreprocessor", "Timer",
+    "UDFTransformer", "UnicodeNormalize",
+]

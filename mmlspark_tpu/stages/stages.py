@@ -1,0 +1,505 @@
+"""Utility transformer implementations.
+
+Reference: stages/*.scala (expected paths, UNVERIFIED — SURVEY.md §2.1).
+Columnar analogs of the reference's DataFrame helpers.  Spark-specific
+notions map as follows: a "partition" here is a contiguous row block (rows
+are host numpy; device sharding happens inside learners), "caching" is
+materialization (numpy is already materialized, so Cacher is a checkpoint
+of the current table).
+"""
+
+from __future__ import annotations
+
+import time
+import unicodedata
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.params import (HasInputCol, HasInputCols, HasOutputCol, Param,
+                           TypeConverters)
+from ..core.pipeline import (Estimator, Model, PipelineStage, Transformer)
+from ..core.schema import DataTable
+from ..core import serialize
+
+
+# -- column selection ---------------------------------------------------------
+
+class DropColumns(Transformer):
+    """Drops columns (stages/DropColumns.scala)."""
+    cols = Param("cols", "Columns to drop",
+                 typeConverter=TypeConverters.toListString)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        return table.drop(*self.getCols())
+
+
+class SelectColumns(Transformer):
+    """Keeps only the listed columns (stages/SelectColumns.scala)."""
+    cols = Param("cols", "Columns to keep",
+                 typeConverter=TypeConverters.toListString)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        return table.select(*self.getCols())
+
+
+class RenameColumn(HasInputCol, HasOutputCol, Transformer):
+    """Renames a column (stages/RenameColumn.scala)."""
+
+    def _transform(self, table: DataTable) -> DataTable:
+        return table.rename({self.getInputCol(): self.getOutputCol()})
+
+
+# -- row manipulation ---------------------------------------------------------
+
+class Repartition(Transformer):
+    """Round-robin reorder of rows into ``n`` contiguous blocks — the
+    columnar analog of Spark's shuffle repartition (stages/Repartition.scala).
+    Block boundaries are what downstream device sharding consumes."""
+
+    n = Param("n", "Number of partitions", typeConverter=TypeConverters.toInt,
+              validator=lambda v: v > 0)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        n = self.getN()
+        rows = len(table)
+        # round-robin: row i goes to block i % n; stable within a block
+        order = np.argsort(np.arange(rows) % n, kind="stable")
+        return table.take(order)
+
+
+class StratifiedRepartition(Transformer):
+    """Reorders rows so every contiguous block sees the full label mix
+    (stages/StratifiedRepartition.scala — used to guarantee each LightGBM
+    worker sees every class)."""
+
+    labelCol = Param("labelCol", "Label column", default="label",
+                     typeConverter=TypeConverters.toString)
+    mode = Param("mode", "Equal, original or mixed ratios", default="mixed",
+                 typeConverter=TypeConverters.toString)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        y = table[self.getLabelCol()]
+        # interleave classes: stable sort by within-class sequence number
+        _, inverse = np.unique(y, return_inverse=True)
+        seq = np.zeros(len(y), dtype=np.int64)
+        counters: Dict[int, int] = {}
+        for i, c in enumerate(inverse):
+            counters[c] = counters.get(c, 0) + 1
+            seq[i] = counters[c]
+        order = np.lexsort((inverse, seq))
+        return table.take(order)
+
+
+class Explode(HasInputCol, HasOutputCol, Transformer):
+    """Replicates each row once per element of a list column
+    (stages/Explode.scala)."""
+
+    def _transform(self, table: DataTable) -> DataTable:
+        col = table[self.getInputCol()]
+        out_col = self._peek("outputCol") or self.getInputCol()
+        lengths = np.asarray([len(v) for v in col], dtype=np.int64)
+        idx = np.repeat(np.arange(len(table)), lengths)
+        exploded = np.empty(int(lengths.sum()), dtype=object)
+        k = 0
+        for v in col:
+            for item in v:
+                exploded[k] = item
+                k += 1
+        out = table.take(idx)
+        return out.withColumn(out_col, exploded)
+
+
+class Cacher(Transformer):
+    """Materialization checkpoint (stages/Cacher.scala).  numpy tables are
+    eager already; this snapshots columns so later in-place mutation by
+    foreign code cannot leak backwards."""
+
+    disable = Param("disable", "Pass through without caching", default=False,
+                    typeConverter=TypeConverters.toBool)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        if self.getDisable():
+            return table
+        return DataTable({k: np.copy(table[k]) for k in table.columns})
+
+
+# -- functional stages --------------------------------------------------------
+
+class UDFTransformer(HasInputCol, HasInputCols, HasOutputCol, Transformer):
+    """Applies a python function to one column (rowwise) or several columns
+    (rowwise over tuples) — stages/UDFTransformer.scala.  The function is
+    user code and does not persist; save/load restores params only."""
+
+    _udf: Optional[Callable] = None  # survives load_stage's __new__ path
+
+    def __init__(self, udf: Optional[Callable] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._udf = udf
+
+    def setUDF(self, udf: Callable) -> "UDFTransformer":
+        self._udf = udf
+        return self
+
+    def getUDF(self) -> Optional[Callable]:
+        return self._udf
+
+    def _transform(self, table: DataTable) -> DataTable:
+        if self._udf is None:
+            raise ValueError("UDFTransformer has no UDF; call setUDF(fn)")
+        if self.isSet("inputCols"):
+            cols = [table[c] for c in self.getInputCols()]
+            out = np.asarray([self._udf(*vals) for vals in zip(*cols)])
+        else:
+            col = table[self.getInputCol()]
+            out = np.asarray([self._udf(v) for v in col])
+        return table.withColumn(self.getOutputCol(), out)
+
+
+class Lambda(Transformer):
+    """Arbitrary table→table function (stages/Lambda.scala).  Not
+    persistable (function state), mirroring the reference where Lambda saves
+    only its SQL-free closure marker."""
+
+    _fn: Optional[Callable] = None  # survives load_stage's __new__ path
+
+    def __init__(self, transformFunc: Optional[Callable] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._fn = transformFunc
+
+    def setTransform(self, fn: Callable) -> "Lambda":
+        self._fn = fn
+        return self
+
+    def _transform(self, table: DataTable) -> DataTable:
+        if self._fn is None:
+            raise ValueError("Lambda has no function; call setTransform(fn)")
+        out = self._fn(table)
+        if not isinstance(out, DataTable):
+            out = DataTable(out)
+        return out
+
+
+class MultiColumnAdapter(Estimator):
+    """Applies a single-column base stage to many columns
+    (stages/MultiColumnAdapter.scala).  Like the reference this is an
+    Estimator: an Estimator base stage is fit ONCE per column at fit time,
+    and the fitted per-column models are frozen in the returned
+    :class:`MultiColumnAdapterModel` — scoring data never refits."""
+
+    inputCols = Param("inputCols", "Input columns",
+                      typeConverter=TypeConverters.toListString)
+    outputCols = Param("outputCols", "Output columns",
+                       typeConverter=TypeConverters.toListString)
+
+    def __init__(self, baseStage: Optional[PipelineStage] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._base = baseStage
+
+    def getBaseStage(self) -> Optional[PipelineStage]:
+        return self._base
+
+    # convenience: transformer-only base stages can skip the explicit fit
+    def transform(self, dataset) -> DataTable:
+        if isinstance(self._base, Estimator):
+            raise TypeError(
+                "baseStage is an Estimator; call fit(...) first so the "
+                "per-column models are frozen before scoring")
+        return self.fit(dataset).transform(dataset)
+
+    def _fit(self, table: DataTable) -> "MultiColumnAdapterModel":
+        ins, outs = self.getInputCols(), self.getOutputCols()
+        if len(ins) != len(outs):
+            raise ValueError("inputCols and outputCols must align")
+        fitted: List[Transformer] = []
+        current = table
+        for i, o in zip(ins, outs):
+            stage = self._base.copy()
+            stage.set("inputCol", i)
+            stage.set("outputCol", o)
+            if isinstance(stage, Estimator):
+                stage = stage._fit(current)
+            fitted.append(stage)
+            current = stage._transform(current)
+        model = MultiColumnAdapterModel(stages=fitted)
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        if self._base is not None:
+            serialize.save_stage(self._base, os.path.join(path, "base"),
+                                 overwrite=True)
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        base = os.path.join(path, "base")
+        self._base = serialize.load_stage(base) if os.path.exists(base) \
+            else None
+
+
+class MultiColumnAdapterModel(Model):
+    """Frozen per-column stages produced by :class:`MultiColumnAdapter`."""
+
+    def __init__(self, stages: Optional[List[Transformer]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._stages = list(stages or [])
+
+    @property
+    def stages(self) -> List[Transformer]:
+        return list(self._stages)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        for stage in self._stages:
+            table = stage._transform(table)
+        return table
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        serialize.save_stage_list(self._stages, os.path.join(path, "stages"))
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        self._stages = serialize.load_stage_list(os.path.join(path, "stages"))
+
+
+class Timer(Transformer):
+    """Wraps a stage and records its wall time (stages/Timer.scala).
+    Timings accumulate in ``.timings`` and log to stdout when logToScala
+    (kept name for parity) is set."""
+
+    logToScala = Param("logToScala", "Print timing lines", default=True,
+                       typeConverter=TypeConverters.toBool)
+
+    def __init__(self, stage: Optional[Transformer] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._stage = stage
+        self.timings: List[float] = []
+
+    def getStage(self) -> Optional[Transformer]:
+        return self._stage
+
+    def _transform(self, table: DataTable) -> DataTable:
+        if self._stage is None:
+            raise ValueError("Timer wraps no stage")
+        t0 = time.perf_counter()
+        out = self._stage._transform(table)
+        dt = time.perf_counter() - t0
+        self.timings.append(dt)
+        if self.getLogToScala():
+            print(f"[Timer] {type(self._stage).__name__}.transform: {dt:.4f}s")
+        return out
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        if self._stage is not None:
+            serialize.save_stage(self._stage, os.path.join(path, "stage"),
+                                 overwrite=True)
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        p = os.path.join(path, "stage")
+        self._stage = serialize.load_stage(p) if os.path.exists(p) else None
+        self.timings = []
+
+
+# -- aggregation --------------------------------------------------------------
+
+class EnsembleByKey(Transformer):
+    """Groups rows by key columns and aggregates value columns
+    (stages/EnsembleByKey.scala — used to merge per-model scores)."""
+
+    keys = Param("keys", "Key columns",
+                 typeConverter=TypeConverters.toListString)
+    cols = Param("cols", "Value columns to aggregate",
+                 typeConverter=TypeConverters.toListString)
+    strategy = Param("strategy", "Aggregation strategy", default="mean",
+                     typeConverter=TypeConverters.toString,
+                     validator=lambda v: v in ("mean", "sum", "max", "min"))
+    collapseGroup = Param("collapseGroup",
+                          "Return one row per group (else broadcast back)",
+                          default=True, typeConverter=TypeConverters.toBool)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        keys, cols = self.getKeys(), self.getCols()
+        key_arrays = [table[k] for k in keys]
+        key_tuples = list(zip(*[a.tolist() for a in key_arrays]))
+        uniq: Dict[Any, int] = {}
+        group_of = np.empty(len(table), dtype=np.int64)
+        for i, kt in enumerate(key_tuples):
+            group_of[i] = uniq.setdefault(kt, len(uniq))
+        n_groups = len(uniq)
+        fn = {"mean": np.mean, "sum": np.sum, "max": np.max,
+              "min": np.min}[self.getStrategy()]
+        agg: Dict[str, np.ndarray] = {}
+        for c in cols:
+            col = np.asarray(table[c], dtype=np.float64)
+            rows = [fn(col[group_of == g], axis=0) for g in range(n_groups)]
+            agg[f"{self.getStrategy()}({c})"] = np.asarray(rows)
+        if self.getCollapseGroup():
+            out_cols: Dict[str, Any] = {}
+            first_idx = np.asarray(
+                [np.flatnonzero(group_of == g)[0] for g in range(n_groups)])
+            for k in keys:
+                out_cols[k] = table[k][first_idx]
+            out_cols.update(agg)
+            return DataTable(out_cols)
+        new = {name: vals[group_of] for name, vals in agg.items()}
+        return table.withColumns(new)
+
+
+class SummarizeData(Transformer):
+    """Dataset statistics as a table (stages/SummarizeData.scala): one row
+    per column with counts/missing/basic stats/percentiles."""
+
+    basic = Param("basic", "Include basic stats", default=True,
+                  typeConverter=TypeConverters.toBool)
+    counts = Param("counts", "Include counts", default=True,
+                   typeConverter=TypeConverters.toBool)
+    percentiles = Param("percentiles", "Include percentiles", default=True,
+                        typeConverter=TypeConverters.toBool)
+    errorThreshold = Param("errorThreshold",
+                           "Percentile accuracy (parity param; exact here)",
+                           default=0.0, typeConverter=TypeConverters.toFloat)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        names, stats = [], {k: [] for k in (
+            "count", "unique_value_count", "missing_value_count", "mean",
+            "stddev", "min", "max", "p25", "median", "p75")}
+        for name in table.columns:
+            col = table[name]
+            if col.ndim != 1:
+                continue
+            names.append(name)
+            numeric = col.dtype.kind in "fiub"
+            colf = col.astype(np.float64) if numeric else None
+            missing = int(np.isnan(colf).sum()) if numeric \
+                else sum(v is None for v in col)
+            stats["count"].append(len(col))
+            stats["unique_value_count"].append(
+                len(np.unique(col[~np.isnan(colf)])) if numeric
+                else len(set(col) - {None}))
+            stats["missing_value_count"].append(missing)
+            for key, fn in (("mean", np.nanmean), ("stddev", np.nanstd),
+                            ("min", np.nanmin), ("max", np.nanmax)):
+                stats[key].append(float(fn(colf)) if numeric else np.nan)
+            for key, q in (("p25", 25), ("median", 50), ("p75", 75)):
+                stats[key].append(
+                    float(np.nanpercentile(colf, q)) if numeric else np.nan)
+        out: Dict[str, Any] = {"column": np.asarray(names, dtype=object)}
+        if self.getCounts():
+            for k in ("count", "unique_value_count", "missing_value_count"):
+                out[k] = np.asarray(stats[k], dtype=np.float64)
+        if self.getBasic():
+            for k in ("mean", "stddev", "min", "max"):
+                out[k] = np.asarray(stats[k], dtype=np.float64)
+        if self.getPercentiles():
+            for k in ("p25", "median", "p75"):
+                out[k] = np.asarray(stats[k], dtype=np.float64)
+        return DataTable(out)
+
+
+# -- text cleanup -------------------------------------------------------------
+
+class TextPreprocessor(HasInputCol, HasOutputCol, Transformer):
+    """Longest-match string replacement via a trie
+    (stages/TextPreprocessor.scala)."""
+
+    map = Param("map", "Replacement mapping {pattern: replacement}",
+                default=None)
+    normFunc = Param("normFunc", "Normalization: identity|lowerCase|trim",
+                     default="identity", typeConverter=TypeConverters.toString,
+                     validator=lambda v: v in ("identity", "lowerCase", "trim"))
+
+    def _apply_norm(self, s: str) -> str:
+        fn = self.getNormFunc()
+        if fn == "lowerCase":
+            return s.lower()
+        if fn == "trim":
+            return s.strip()
+        return s
+
+    def _replace(self, s: str, mapping: Dict[str, str]) -> str:
+        if not mapping:
+            return s
+        # longest-match-first scan (trie semantics without the trie)
+        keys = sorted(mapping, key=len, reverse=True)
+        out, i = [], 0
+        while i < len(s):
+            for k in keys:
+                if s.startswith(k, i):
+                    out.append(mapping[k])
+                    i += len(k)
+                    break
+            else:
+                out.append(s[i])
+                i += 1
+        return "".join(out)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        mapping = self.getMap() or {}
+        col = table[self.getInputCol()]
+        out = np.asarray(
+            [self._replace(self._apply_norm(str(v)), mapping) for v in col],
+            dtype=object)
+        return table.withColumn(self.getOutputCol(), out)
+
+
+class UnicodeNormalize(HasInputCol, HasOutputCol, Transformer):
+    """Unicode normalization (stages/UnicodeNormalize.scala)."""
+
+    form = Param("form", "Normalization form: NFC|NFD|NFKC|NFKD",
+                 default="NFKD", typeConverter=TypeConverters.toString,
+                 validator=lambda v: v in ("NFC", "NFD", "NFKC", "NFKD"))
+    lower = Param("lower", "Lowercase the result", default=True,
+                  typeConverter=TypeConverters.toBool)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        col = table[self.getInputCol()]
+        form = self.getForm()
+        out = []
+        for v in col:
+            s = unicodedata.normalize(form, str(v))
+            out.append(s.lower() if self.getLower() else s)
+        return table.withColumn(self.getOutputCol(),
+                                np.asarray(out, dtype=object))
+
+
+# -- batching -----------------------------------------------------------------
+
+class FixedMiniBatchTransformer(Transformer):
+    """Packs rows into fixed-size batches: every column becomes an object
+    column of per-batch arrays (stages/MiniBatchTransformer.scala).  The
+    device-friendly shape for JNI/HTTP-style stages in the reference; here
+    it feeds jit'd models fixed-size chunks (static shapes → one XLA
+    compile)."""
+
+    batchSize = Param("batchSize", "Rows per batch",
+                      typeConverter=TypeConverters.toInt,
+                      validator=lambda v: v > 0)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        bs = self.getBatchSize()
+        n = len(table)
+        n_batches = (n + bs - 1) // bs
+        cols: Dict[str, Any] = {}
+        for name in table.columns:
+            col = table[name]
+            batched = np.empty(n_batches, dtype=object)
+            for b in range(n_batches):
+                batched[b] = col[b * bs:(b + 1) * bs]
+            cols[name] = batched
+        return DataTable(cols)
+
+
+class FlattenBatch(Transformer):
+    """Inverse of the mini-batchers (stages/FlattenBatch.scala)."""
+
+    def _transform(self, table: DataTable) -> DataTable:
+        cols: Dict[str, Any] = {}
+        for name in table.columns:
+            parts = [np.asarray(p) for p in table[name]]
+            cols[name] = np.concatenate(parts, axis=0) if parts \
+                else np.empty(0)
+        return DataTable(cols)

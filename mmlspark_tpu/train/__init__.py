@@ -1,0 +1,25 @@
+"""Simplified ML training + evaluation (reference ``train/`` package).
+
+Reference: src/main/scala/com/microsoft/ml/spark/train/ (expected path,
+UNVERIFIED — SURVEY.md §2.1): TrainClassifier/TrainRegressor wrap any
+learner together with auto-featurization into one estimator;
+ComputeModelStatistics / ComputePerInstanceStatistics compute evaluation
+metrics *as pipeline transformers* (observability-as-a-stage, SURVEY.md §5.5).
+"""
+
+from .train import (
+    TrainClassifier,
+    TrainedClassifierModel,
+    TrainRegressor,
+    TrainedRegressorModel,
+)
+from .metrics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+)
+
+__all__ = [
+    "TrainClassifier", "TrainedClassifierModel",
+    "TrainRegressor", "TrainedRegressorModel",
+    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+]

@@ -1,0 +1,159 @@
+"""Evaluation metrics as pipeline transformers.
+
+Reference: train/ComputeModelStatistics.scala,
+train/ComputePerInstanceStatistics.scala (expected paths, UNVERIFIED —
+SURVEY.md §2.1, §5.5).  ``ComputeModelStatistics.transform`` returns a
+one-row table of metrics (classification: accuracy/precision/recall/AUC +
+confusion matrix; regression: MSE/RMSE/R²/MAE); the per-instance variant
+appends a per-row loss column.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.params import (HasLabelCol, HasPredictionCol, Param,
+                           TypeConverters)
+from ..core.pipeline import Transformer
+from ..core.schema import DataTable
+
+_METRIC_CHOICES = ("classification", "regression", "all", "auc", "accuracy",
+                   "precision", "recall", "mse", "rmse", "r2", "mae")
+
+
+def roc_auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Trapezoidal AUC via rank statistics (ties handled by midranks)."""
+    labels = np.asarray(labels, dtype=np.float64)
+    scores = np.asarray(scores, dtype=np.float64)
+    pos = labels > 0.5
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores))
+    sorted_scores = scores[order]
+    # midranks for ties
+    i = 0
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0)
+                 / (n_pos * n_neg))
+
+
+class _MetricParams(HasLabelCol, HasPredictionCol):
+    scoresCol = Param("scoresCol",
+                      "Probability/score column for AUC (optional)",
+                      default="probability",
+                      typeConverter=TypeConverters.toString)
+    evaluationMetric = Param("evaluationMetric",
+                             "Metric set: classification|regression|all or a "
+                             "single metric name",
+                             default="all",
+                             typeConverter=TypeConverters.toString,
+                             validator=lambda v: v in _METRIC_CHOICES)
+
+
+class ComputeModelStatistics(_MetricParams, Transformer):
+    """Dataset-level metrics as a one-row output table."""
+
+    def _classification(self, table: DataTable) -> Dict[str, float]:
+        y = np.asarray(table[self.getLabelCol()], dtype=np.float64)
+        pred = np.asarray(table[self.getPredictionCol()], dtype=np.float64)
+        classes = np.unique(np.concatenate([y, pred]))
+        k = len(classes)
+        yi = np.searchsorted(classes, y)
+        pi = np.searchsorted(classes, pred)
+        conf = np.zeros((k, k), dtype=np.int64)
+        for t, p in zip(yi, pi):
+            conf[t, p] += 1
+        out: Dict[str, float] = {
+            "accuracy": float((y == pred).mean()) if len(y) else float("nan")}
+        if k == 2:
+            tp, fp = conf[1, 1], conf[0, 1]
+            fn = conf[1, 0]
+            out["precision"] = float(tp / (tp + fp)) if tp + fp else 0.0
+            out["recall"] = float(tp / (tp + fn)) if tp + fn else 0.0
+        else:  # macro average
+            precisions, recalls = [], []
+            for c in range(k):
+                tp = conf[c, c]
+                fp = conf[:, c].sum() - tp
+                fn = conf[c, :].sum() - tp
+                precisions.append(tp / (tp + fp) if tp + fp else 0.0)
+                recalls.append(tp / (tp + fn) if tp + fn else 0.0)
+            out["precision"] = float(np.mean(precisions)) if k else 0.0
+            out["recall"] = float(np.mean(recalls)) if k else 0.0
+        scores_col = self.getScoresCol()
+        if scores_col in table and k == 2:
+            s = np.asarray(table[scores_col], dtype=np.float64)
+            if s.ndim == 2:
+                s = s[:, -1]
+            out["AUC"] = roc_auc(y, s)
+        self._confusion = conf
+        return out
+
+    def _regression(self, table: DataTable) -> Dict[str, float]:
+        y = np.asarray(table[self.getLabelCol()], dtype=np.float64)
+        pred = np.asarray(table[self.getPredictionCol()], dtype=np.float64)
+        err = y - pred
+        mse = float(np.mean(err ** 2))
+        ss_tot = float(np.sum((y - y.mean()) ** 2))
+        return {
+            "mean_squared_error": mse,
+            "root_mean_squared_error": float(np.sqrt(mse)),
+            "mean_absolute_error": float(np.mean(np.abs(err))),
+            "R^2": float(1.0 - np.sum(err ** 2) / ss_tot) if ss_tot
+            else float("nan"),
+        }
+
+    def _transform(self, table: DataTable) -> DataTable:
+        metric = self.getEvaluationMetric()
+        self._confusion = None
+        if metric in ("classification", "auc", "accuracy", "precision",
+                      "recall"):
+            stats = self._classification(table)
+        elif metric in ("regression", "mse", "rmse", "r2", "mae"):
+            stats = self._regression(table)
+        else:  # "all": sniff — integer-ish labels + prediction => classification
+            y = np.asarray(table[self.getLabelCol()], dtype=np.float64)
+            pred = np.asarray(table[self.getPredictionCol()],
+                              dtype=np.float64)
+            is_cls = (np.allclose(y, np.round(y))
+                      and np.allclose(pred, np.round(pred))
+                      and len(np.unique(y)) <= 100)
+            stats = self._classification(table) if is_cls \
+                else self._regression(table)
+        return DataTable({k: np.asarray([v]) for k, v in stats.items()})
+
+    @property
+    def confusionMatrix(self) -> np.ndarray:
+        """Confusion matrix from the last classification transform."""
+        if getattr(self, "_confusion", None) is None:
+            raise ValueError("No classification transform has run yet")
+        return self._confusion.copy()
+
+
+class ComputePerInstanceStatistics(_MetricParams, Transformer):
+    """Appends a per-row loss column (log-loss / squared error)."""
+
+    def _transform(self, table: DataTable) -> DataTable:
+        y = np.asarray(table[self.getLabelCol()], dtype=np.float64)
+        scores_col = self.getScoresCol()
+        if scores_col in table:
+            p = np.asarray(table[scores_col], dtype=np.float64)
+            eps = 1e-15
+            if p.ndim == 2:  # probability vector: pick the true class
+                idx = np.clip(y.astype(np.int64), 0, p.shape[1] - 1)
+                p_true = p[np.arange(len(y)), idx]
+            else:
+                p_true = np.where(y > 0.5, p, 1.0 - p)
+            loss = -np.log(np.clip(p_true, eps, 1.0))
+            return table.withColumn("log_loss", loss)
+        pred = np.asarray(table[self.getPredictionCol()], dtype=np.float64)
+        return table.withColumn("squared_error", (y - pred) ** 2)

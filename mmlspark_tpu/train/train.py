@@ -1,0 +1,140 @@
+"""TrainClassifier / TrainRegressor.
+
+Reference: train/TrainClassifier.scala, train/TrainRegressor.scala (expected
+paths, UNVERIFIED — SURVEY.md §2.1).  Wraps any learner plus automatic
+featurization (Featurize over every non-label column) into a single
+estimator, so ``TrainClassifier(model=LightGBMClassifier(), labelCol="y")``
+fits on a raw mixed-type table with no manual vector assembly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..core.params import (HasFeaturesCol, HasLabelCol, Param,
+                           TypeConverters)
+from ..core.pipeline import Estimator, Model, PipelineStage, Transformer
+from ..core.schema import DataTable
+from ..core import serialize
+from ..featurize import Featurize, ValueIndexer
+
+
+class _TrainParams(HasLabelCol, HasFeaturesCol):
+    numFeatures = Param("numFeatures",
+                        "Hash dimension for high-cardinality text columns",
+                        default=262144, typeConverter=TypeConverters.toInt)
+
+
+class _TrainBase(_TrainParams, Estimator):
+    __abstractstage__ = True
+    _reindex_label = False
+
+    def __init__(self, model: Optional[Estimator] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._model = model
+
+    def getModel(self) -> Optional[Estimator]:
+        return self._model
+
+    def setModel(self, model: Estimator) -> "_TrainBase":
+        self._model = model
+        return self
+
+    def _fit(self, table: DataTable) -> "_TrainedModel":
+        if self._model is None:
+            raise ValueError(
+                f"{type(self).__name__} needs an inner learner; pass "
+                "model=<estimator> (e.g. LightGBMClassifier())")
+        label = self.getLabelCol()
+        feat_col = self.getFeaturesCol()
+
+        label_model = None
+        if self._reindex_label and table[label].dtype.kind not in "fiub":
+            label_model = ValueIndexer(
+                inputCol=label, outputCol=label).fit(table)
+            table = label_model._transform(table)
+
+        feature_cols = [c for c in table.columns
+                        if c != label and c != feat_col]
+        featurizer = None
+        if feat_col not in table:
+            featurizer = Featurize(
+                inputCols=feature_cols, outputCol=feat_col,
+                numFeatures=self.getNumFeatures()).fit(table)
+            table = featurizer._transform(table)
+
+        inner = self._model.copy()
+        for p, v in (("featuresCol", feat_col), ("labelCol", label)):
+            if inner.hasParam(p):
+                inner.set(p, v)
+        fitted = inner._fit(table)
+
+        model = self._model_cls(featurizer=featurizer,
+                                label_model=label_model, fitted=fitted)
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+
+class _TrainedModel(_TrainParams, Model):
+    __abstractstage__ = True
+
+    def __init__(self, featurizer=None, label_model=None, fitted=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._featurizer = featurizer
+        self._label_model = label_model
+        self._fitted = fitted
+
+    def getLastStage(self) -> Transformer:
+        """The fitted inner model (reference naming for the wrapped stage)."""
+        return self._fitted
+
+    def _transform(self, table: DataTable) -> DataTable:
+        feat_col = self.getFeaturesCol()
+        if self._featurizer is not None and feat_col not in table:
+            table = self._featurizer._transform(table)
+        return self._fitted._transform(table)
+
+    def _save_extra(self, path: str) -> None:
+        parts = {"fitted": self._fitted}
+        if self._featurizer is not None:
+            parts["featurizer"] = self._featurizer
+        if self._label_model is not None:
+            parts["label_model"] = self._label_model
+        serialize.save_json(path, "parts", sorted(parts))
+        for name, stage in parts.items():
+            serialize.save_stage(stage, os.path.join(path, name),
+                                 overwrite=True)
+
+    def _load_extra(self, path: str) -> None:
+        names = serialize.load_json(path, "parts")
+        self._featurizer = self._label_model = self._fitted = None
+        for name in names:
+            stage = serialize.load_stage(os.path.join(path, name))
+            setattr(self, {"fitted": "_fitted",
+                           "featurizer": "_featurizer",
+                           "label_model": "_label_model"}[name], stage)
+
+
+class TrainedClassifierModel(_TrainedModel):
+    def getLevels(self):
+        return self._label_model.levels if self._label_model else None
+
+
+class TrainedRegressorModel(_TrainedModel):
+    pass
+
+
+class TrainClassifier(_TrainBase):
+    """Auto-featurizing classification wrapper (train/TrainClassifier.scala)."""
+    _model_cls = TrainedClassifierModel
+    _reindex_label = True
+
+
+class TrainRegressor(_TrainBase):
+    """Auto-featurizing regression wrapper (train/TrainRegressor.scala)."""
+    _model_cls = TrainedRegressorModel

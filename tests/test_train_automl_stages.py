@@ -1,0 +1,325 @@
+"""Tests for train/, automl/, stages/ packages (SURVEY.md §2.1)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import DataTable
+from mmlspark_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.stages import (
+    Cacher, DropColumns, EnsembleByKey, Explode, FixedMiniBatchTransformer,
+    FlattenBatch, Lambda, MultiColumnAdapter, RenameColumn, Repartition,
+    SelectColumns, StratifiedRepartition, SummarizeData, TextPreprocessor,
+    Timer, UDFTransformer, UnicodeNormalize)
+from mmlspark_tpu.train import (
+    ComputeModelStatistics, ComputePerInstanceStatistics, TrainClassifier,
+    TrainRegressor, TrainedClassifierModel)
+
+
+@pytest.fixture(scope="module")
+def mixed_table():
+    rng = np.random.default_rng(3)
+    n = 300
+    x0 = rng.normal(size=n)
+    x1 = rng.normal(size=n)
+    cat = np.array(rng.choice(["red", "green", "blue"], size=n), dtype=object)
+    cat_effect = np.where(cat == "red", 1.0, np.where(cat == "green", -1.0, 0))
+    y = (x0 + 0.5 * x1 + cat_effect + rng.normal(size=n) * 0.3 > 0)
+    return DataTable({"x0": x0, "x1": x1, "color": cat,
+                      "label": y.astype(np.float64)})
+
+
+# -- train --------------------------------------------------------------------
+
+def test_train_classifier_auto_featurize(mixed_table, tmp_path):
+    tc = TrainClassifier(model=LightGBMClassifier(
+        numIterations=10, numLeaves=7, minDataInLeaf=5), labelCol="label")
+    model = tc.fit(mixed_table)
+    out = model.transform(mixed_table)
+    acc = (np.asarray(out["prediction"]) ==
+           np.asarray(mixed_table["label"])).mean()
+    assert acc > 0.8
+
+    p = str(tmp_path / "tc")
+    model.save(p)
+    loaded = TrainedClassifierModel.load(p)
+    out2 = loaded.transform(mixed_table)
+    np.testing.assert_allclose(np.asarray(out2["prediction"]),
+                               np.asarray(out["prediction"]))
+
+
+def test_train_classifier_string_label():
+    rng = np.random.default_rng(0)
+    n = 200
+    x = rng.normal(size=n)
+    label = np.array(np.where(x > 0, "yes", "no"), dtype=object)
+    t = DataTable({"x": x, "label": label})
+    model = TrainClassifier(
+        model=LightGBMClassifier(numIterations=5, numLeaves=5,
+                                 minDataInLeaf=5),
+        labelCol="label").fit(t)
+    assert model.getLevels() == ["no", "yes"]
+    out = model.transform(t)
+    assert set(np.unique(out["prediction"])) <= {0.0, 1.0}
+
+
+def test_train_regressor(regression_table):
+    t = DataTable(dict(regression_table))
+    model = TrainRegressor(model=LightGBMRegressor(
+        numIterations=20, numLeaves=15), labelCol="label").fit(t)
+    out = model.transform(t)
+    y, pred = np.asarray(t["label"]), np.asarray(out["prediction"])
+    ss_res = np.sum((y - pred) ** 2)
+    ss_tot = np.sum((y - y.mean()) ** 2)
+    assert 1 - ss_res / ss_tot > 0.5
+
+
+def test_compute_model_statistics_classification():
+    t = DataTable({
+        "label": np.array([1, 0, 1, 1, 0], dtype=np.float64),
+        "prediction": np.array([1, 0, 0, 1, 0], dtype=np.float64),
+        "probability": np.array([[.2, .8], [.7, .3], [.6, .4],
+                                 [.1, .9], [.9, .1]]),
+    })
+    cms = ComputeModelStatistics(evaluationMetric="classification")
+    stats = cms.transform(t)
+    assert stats["accuracy"][0] == pytest.approx(0.8)
+    assert stats["precision"][0] == pytest.approx(1.0)
+    assert stats["recall"][0] == pytest.approx(2 / 3)
+    assert stats["AUC"][0] == pytest.approx(1.0)  # probs perfectly ranked
+    np.testing.assert_array_equal(cms.confusionMatrix,
+                                  [[2, 0], [1, 2]])
+
+
+def test_compute_model_statistics_regression():
+    t = DataTable({
+        "label": np.array([1.0, 2.0, 3.0]),
+        "prediction": np.array([1.1, 1.9, 3.2]),
+    })
+    stats = ComputeModelStatistics(evaluationMetric="regression").transform(t)
+    assert stats["mean_squared_error"][0] == pytest.approx(0.02, abs=1e-9)
+    assert stats["R^2"][0] > 0.95
+
+
+def test_compute_per_instance_statistics():
+    t = DataTable({
+        "label": np.array([1.0, 0.0]),
+        "prediction": np.array([1.0, 0.0]),
+        "probability": np.array([[0.1, 0.9], [0.8, 0.2]]),
+    })
+    out = ComputePerInstanceStatistics().transform(t)
+    np.testing.assert_allclose(out["log_loss"],
+                               [-np.log(0.9), -np.log(0.8)])
+
+
+# -- automl -------------------------------------------------------------------
+
+def test_find_best_model(binary_table):
+    from mmlspark_tpu.automl import BestModel, FindBestModel
+    t = DataTable(dict(binary_table))
+    cands = [LightGBMClassifier(numIterations=2, numLeaves=4),
+             LightGBMClassifier(numIterations=15, numLeaves=15)]
+    best = FindBestModel(models=cands, evaluationMetric="auc").fit(t)
+    assert best.getBestModelMetrics() > 0.8
+    assert len(best.getAllModelMetrics()) == 2
+    # the 15-iteration model must win on train AUC
+    vals = [r["auc"] for r in best.getAllModelMetrics()]
+    assert best.getBestModelMetrics() == pytest.approx(max(vals))
+    out = best.transform(t)
+    assert "prediction" in out.columns
+
+
+def test_tune_hyperparameters(binary_table, tmp_path):
+    from mmlspark_tpu.automl import (DiscreteHyperParam, HyperparamBuilder,
+                                     RangeHyperParam, TuneHyperparameters,
+                                     TuneHyperparametersModel)
+    t = DataTable({k: v[:500] for k, v in binary_table.items()})
+    spaces = (HyperparamBuilder()
+              .addHyperparam("numLeaves", DiscreteHyperParam([4, 8]))
+              .addHyperparam("learningRate", RangeHyperParam(0.05, 0.3))
+              .build())
+    tuner = TuneHyperparameters(
+        models=[LightGBMClassifier(numIterations=5, minDataInLeaf=5)],
+        hyperParams=spaces, numRuns=3, numFolds=2, parallelism=2,
+        evaluationMetric="auc", seed=1)
+    model = tuner.fit(t)
+    assert model.getBestModelMetrics() > 0.7
+    assert set(model.getBestModelInfo()) == {"numLeaves", "learningRate"}
+
+    p = str(tmp_path / "tuned")
+    model.save(p)
+    loaded = TuneHyperparametersModel.load(p)
+    out = loaded.transform(t)
+    assert "prediction" in out.columns
+
+
+def test_classification_stats_negative_labels():
+    t = DataTable({
+        "label": np.array([-1.0, 1.0, -1.0, 1.0]),
+        "prediction": np.array([-1.0, 1.0, 1.0, -1.0]),
+    })
+    cms = ComputeModelStatistics(evaluationMetric="classification")
+    stats = cms.transform(t)
+    assert stats["accuracy"][0] == pytest.approx(0.5)
+    assert stats["precision"][0] == pytest.approx(0.5)
+    assert stats["recall"][0] == pytest.approx(0.5)
+
+
+def test_find_best_model_skips_nan(monkeypatch, binary_table):
+    from mmlspark_tpu.automl import automl as automl_mod
+    t = DataTable({k: v[:200] for k, v in binary_table.items()})
+    cands = [LightGBMClassifier(numIterations=2, numLeaves=4),
+             LightGBMClassifier(numIterations=3, numLeaves=4)]
+
+    vals = iter([float("nan"), 0.9])
+    monkeypatch.setattr(automl_mod, "_evaluate",
+                        lambda *a, **k: next(vals))
+    best = automl_mod.FindBestModel(models=cands,
+                                    evaluationMetric="auc").fit(t)
+    assert best.getBestModelMetrics() == pytest.approx(0.9)
+
+    monkeypatch.setattr(automl_mod, "_evaluate",
+                        lambda *a, **k: float("nan"))
+    with pytest.raises(ValueError, match="NaN"):
+        automl_mod.FindBestModel(models=cands,
+                                 evaluationMetric="auc").fit(t)
+
+
+def test_grid_space():
+    from mmlspark_tpu.automl import DiscreteHyperParam, GridSpace
+    grid = GridSpace({"a": DiscreteHyperParam([1, 2]),
+                      "b": DiscreteHyperParam(["x", "y", "z"])})
+    assert len(grid) == 6
+
+
+# -- stages -------------------------------------------------------------------
+
+def test_column_ops():
+    t = DataTable({"a": np.arange(3.0), "b": np.arange(3.0) * 2,
+                   "c": np.arange(3.0) * 3})
+    assert DropColumns(cols=["b"]).transform(t).columns == ["a", "c"]
+    assert SelectColumns(cols=["c", "a"]).transform(t).columns == ["c", "a"]
+    out = RenameColumn(inputCol="a", outputCol="z").transform(t)
+    assert "z" in out.columns and "a" not in out.columns
+
+
+def test_repartition_round_robin():
+    t = DataTable({"i": np.arange(6)})
+    out = Repartition(n=2).transform(t)
+    # blocks: rows [0,2,4] then [1,3,5]
+    np.testing.assert_array_equal(out["i"], [0, 2, 4, 1, 3, 5])
+
+
+def test_stratified_repartition():
+    y = np.array([0, 0, 0, 0, 1, 1, 1, 1], dtype=np.float64)
+    t = DataTable({"label": y})
+    out = StratifiedRepartition(labelCol="label").transform(t)
+    # each half must contain both classes
+    half = len(y) // 2
+    assert len(np.unique(out["label"][:half])) == 2
+    assert len(np.unique(out["label"][half:])) == 2
+
+
+def test_explode():
+    t = DataTable({"id": np.array([1, 2]),
+                   "words": np.array([["a", "b"], ["c"]], dtype=object)})
+    out = Explode(inputCol="words", outputCol="word").transform(t)
+    assert len(out) == 3
+    np.testing.assert_array_equal(out["id"], [1, 1, 2])
+    assert list(out["word"]) == ["a", "b", "c"]
+
+
+def test_udf_transformer_and_lambda():
+    t = DataTable({"x": np.array([1.0, 2.0]), "y": np.array([10.0, 20.0])})
+    out = UDFTransformer(inputCol="x", outputCol="sq",
+                         udf=lambda v: v * v).transform(t)
+    np.testing.assert_allclose(out["sq"], [1.0, 4.0])
+    out = UDFTransformer(inputCols=["x", "y"], outputCol="sum",
+                         udf=lambda a, b: a + b).transform(t)
+    np.testing.assert_allclose(out["sum"], [11.0, 22.0])
+    out = Lambda(transformFunc=lambda tb: tb.withColumn(
+        "z", np.asarray(tb["x"]) + 1)).transform(t)
+    np.testing.assert_allclose(out["z"], [2.0, 3.0])
+
+
+def test_multi_column_adapter():
+    from mmlspark_tpu.featurize.text import PageSplitter
+    t = DataTable({"t1": np.array(["ab cd"], dtype=object),
+                   "t2": np.array(["ef gh"], dtype=object)})
+    mca = MultiColumnAdapter(
+        baseStage=PageSplitter(maximumPageLength=3, minimumPageLength=1),
+        inputCols=["t1", "t2"], outputCols=["o1", "o2"])
+    out = mca.transform(t)
+    assert "o1" in out.columns and "o2" in out.columns
+
+
+def test_multi_column_adapter_estimator_fits_once():
+    from mmlspark_tpu.featurize import ValueIndexer
+    train = DataTable({"c1": np.array(["a", "b"], dtype=object)})
+    test = DataTable({"c1": np.array(["b", "z"], dtype=object)})
+    mca = MultiColumnAdapter(baseStage=ValueIndexer(),
+                             inputCols=["c1"], outputCols=["i1"])
+    model = mca.fit(train)
+    # levels frozen at fit: "b"->1, unseen "z"->-1 (no refit on test data)
+    np.testing.assert_array_equal(model.transform(test)["i1"], [1, -1])
+    with pytest.raises(TypeError):
+        mca.transform(test)
+
+
+def test_timer_and_cacher():
+    t = DataTable({"a": np.arange(4.0)})
+    inner = RenameColumn(inputCol="a", outputCol="b")
+    timer = Timer(stage=inner, logToScala=False)
+    out = timer.transform(t)
+    assert "b" in out.columns and len(timer.timings) == 1
+    out = Cacher().transform(t)
+    out["a"][0] = 99.0
+    assert t["a"][0] == 0.0  # cache snapshot decoupled
+
+
+def test_ensemble_by_key():
+    t = DataTable({
+        "key": np.array(["a", "a", "b"], dtype=object),
+        "score": np.array([1.0, 3.0, 5.0]),
+    })
+    out = EnsembleByKey(keys=["key"], cols=["score"],
+                        strategy="mean").transform(t)
+    assert len(out) == 2
+    np.testing.assert_allclose(out["mean(score)"], [2.0, 5.0])
+    out = EnsembleByKey(keys=["key"], cols=["score"], strategy="mean",
+                        collapseGroup=False).transform(t)
+    np.testing.assert_allclose(out["mean(score)"], [2.0, 2.0, 5.0])
+
+
+def test_summarize_data():
+    t = DataTable({"x": np.array([1.0, 2.0, 3.0, np.nan]),
+                   "s": np.array(["a", "b", "a", None], dtype=object)})
+    out = SummarizeData().transform(t)
+    i = list(out["column"]).index("x")
+    assert out["count"][i] == 4
+    assert out["missing_value_count"][i] == 1
+    assert out["mean"][i] == pytest.approx(2.0)
+    j = list(out["column"]).index("s")
+    assert out["unique_value_count"][j] == 2
+
+
+def test_text_preprocessor_and_unicode():
+    t = DataTable({"t": np.array(["Hello WORLD"], dtype=object)})
+    out = TextPreprocessor(inputCol="t", outputCol="o",
+                           map={"hello": "hi"},
+                           normFunc="lowerCase").transform(t)
+    assert out["o"][0] == "hi world"
+    t2 = DataTable({"t": np.array(["Ça va Bien"], dtype=object)})
+    out = UnicodeNormalize(inputCol="t", outputCol="o",
+                           form="NFKD").transform(t2)
+    assert "c" in out["o"][0]  # cedilla decomposed + lowercased
+
+
+def test_minibatch_roundtrip():
+    t = DataTable({"x": np.arange(10.0), "v": np.arange(20.0).reshape(10, 2)})
+    batched = FixedMiniBatchTransformer(batchSize=4).transform(t)
+    assert len(batched) == 3
+    assert batched["x"][0].shape == (4,)
+    assert batched["v"][2].shape == (2, 2)
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_allclose(flat["x"], t["x"])
+    np.testing.assert_allclose(flat["v"], t["v"])
