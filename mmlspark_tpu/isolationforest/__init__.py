@@ -1,0 +1,13 @@
+"""Isolation Forest anomaly detection.
+
+Reference: isolationforest/IsolationForest.scala (expected path, UNVERIFIED
+— SURVEY.md §2.1), a wrapper around the linkedin/isolation-forest Spark
+library.  TPU-native design: trees are grown on host (cheap — random
+splits over small subsamples) into fixed-depth arrays; scoring is a jit'd
+``vmap`` traversal over (trees × rows), the same array-tree evaluation the
+GBDT booster uses.
+"""
+
+from .iforest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

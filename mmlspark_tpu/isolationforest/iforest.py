@@ -1,0 +1,163 @@
+"""Isolation forest: host-grown random trees, device-scored.
+
+Reference: isolationforest/IsolationForest.scala (expected path, UNVERIFIED
+— SURVEY.md §2.1).  Trees live in heap-layout arrays (node i → children
+2i+1 / 2i+2), so scoring is a depth-bounded ``fori_loop`` gather per tree,
+``vmap``ed over trees — no recursion, static shapes, one XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import (HasFeaturesCol, HasPredictionCol, HasSeed, Param,
+                           TypeConverters)
+from ..core.pipeline import Estimator, Model
+from ..core.schema import DataTable, features_matrix
+from ..core import serialize
+
+_EULER = 0.5772156649
+
+
+def _avg_path_len(n) -> float:
+    """c(n): average BST unsuccessful-search path length."""
+    n = float(n)
+    if n <= 1.0:
+        return 0.0
+    return 2.0 * (np.log(n - 1.0) + _EULER) - 2.0 * (n - 1.0) / n
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _path_lengths(X, feat, thr, pathlen, depth: int):
+    """X: (N, F); feat/thr/pathlen: (T, M) heap trees → (N, T) path lens."""
+    def one_tree(f, t, pl):
+        def step(_, node):
+            is_leaf = f[node] < 0
+            go_left = X[jnp.arange(X.shape[0]),
+                        jnp.maximum(f[node], 0)] < t[node]
+            child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
+            return jnp.where(is_leaf, node, child)
+        node = jax.lax.fori_loop(
+            0, depth, step, jnp.zeros(X.shape[0], jnp.int32))
+        return pl[node]
+    return jax.vmap(one_tree)(feat, thr, pathlen).T
+
+
+class IsolationForest(HasFeaturesCol, HasPredictionCol, HasSeed, Estimator):
+    """Unsupervised anomaly detector (isolationforest/IsolationForest.scala)."""
+
+    numEstimators = Param("numEstimators", "Number of trees", default=100,
+                          typeConverter=TypeConverters.toInt)
+    maxSamples = Param("maxSamples", "Subsample size per tree", default=256,
+                       typeConverter=TypeConverters.toInt)
+    maxFeatures = Param("maxFeatures", "Fraction of features per tree",
+                        default=1.0, typeConverter=TypeConverters.toFloat)
+    contamination = Param("contamination",
+                          "Expected anomaly fraction (sets the threshold)",
+                          default=0.05, typeConverter=TypeConverters.toFloat)
+    scoreCol = Param("scoreCol", "Anomaly score output column",
+                     default="outlierScore",
+                     typeConverter=TypeConverters.toString)
+
+    def _fit(self, table: DataTable) -> "IsolationForestModel":
+        X = np.asarray(features_matrix(table, self.getFeaturesCol()),
+                       dtype=np.float32)
+        n, F = X.shape
+        rng = np.random.default_rng(self.getSeed())
+        T = self.getNumEstimators()
+        psi = min(self.getMaxSamples(), n)
+        depth = max(1, int(np.ceil(np.log2(max(psi, 2)))))
+        M = 2 ** (depth + 1) - 1
+        n_feats = max(1, int(round(self.getMaxFeatures() * F)))
+
+        feat = np.full((T, M), -1, dtype=np.int32)
+        thr = np.zeros((T, M), dtype=np.float32)
+        pathlen = np.zeros((T, M), dtype=np.float32)
+
+        for t in range(T):
+            sample = X[rng.choice(n, size=psi, replace=False)]
+            feat_pool = rng.choice(F, size=n_feats, replace=False)
+            # stack of (node, rows, depth)
+            stack = [(0, sample, 0)]
+            while stack:
+                node, rows, d = stack.pop()
+                n_rows = len(rows)
+                if d >= depth or n_rows <= 1:
+                    feat[t, node] = -1
+                    pathlen[t, node] = d + _avg_path_len(n_rows)
+                    continue
+                f = int(rng.choice(feat_pool))
+                lo, hi = rows[:, f].min(), rows[:, f].max()
+                if lo == hi:
+                    feat[t, node] = -1
+                    pathlen[t, node] = d + _avg_path_len(n_rows)
+                    continue
+                s = float(rng.uniform(lo, hi))
+                feat[t, node] = f
+                thr[t, node] = s
+                left_rows = rows[rows[:, f] < s]
+                right_rows = rows[rows[:, f] >= s]
+                stack.append((2 * node + 1, left_rows, d + 1))
+                stack.append((2 * node + 2, right_rows, d + 1))
+
+        # threshold from train scores at the contamination quantile
+        lens = np.asarray(_path_lengths(
+            jnp.asarray(X), jnp.asarray(feat), jnp.asarray(thr),
+            jnp.asarray(pathlen), depth + 1))
+        scores = np.power(2.0, -lens.mean(axis=1) / _avg_path_len(psi))
+        threshold = float(np.quantile(scores, 1.0 - self.getContamination()))
+
+        model = IsolationForestModel(feat=feat, thr=thr, pathlen=pathlen,
+                                     depth=depth, psi=psi,
+                                     threshold=threshold)
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+
+class IsolationForestModel(HasFeaturesCol, HasPredictionCol, Model):
+    scoreCol = IsolationForest.scoreCol
+
+    def __init__(self, feat=None, thr=None, pathlen=None, depth: int = 0,
+                 psi: int = 0, threshold: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self._feat, self._thr, self._pathlen = feat, thr, pathlen
+        self._depth, self._psi = int(depth), int(psi)
+        self._threshold = float(threshold)
+
+    @property
+    def threshold(self) -> float:
+        return self._threshold
+
+    def _transform(self, table: DataTable) -> DataTable:
+        X = np.asarray(features_matrix(table, self.getFeaturesCol()),
+                       dtype=np.float32)
+        lens = np.asarray(_path_lengths(
+            jnp.asarray(X), jnp.asarray(self._feat), jnp.asarray(self._thr),
+            jnp.asarray(self._pathlen), self._depth + 1))
+        scores = np.power(2.0, -lens.mean(axis=1) / _avg_path_len(self._psi))
+        return table.withColumns({
+            self.getScoreCol(): scores.astype(np.float64),
+            self.getPredictionCol():
+                (scores > self._threshold).astype(np.float64),
+        })
+
+    def _save_extra(self, path: str) -> None:
+        serialize.save_arrays(path, feat=self._feat, thr=self._thr,
+                              pathlen=self._pathlen)
+        serialize.save_json(path, "meta", {
+            "depth": self._depth, "psi": self._psi,
+            "threshold": self._threshold})
+
+    def _load_extra(self, path: str) -> None:
+        arrays = serialize.load_arrays(path)
+        self._feat, self._thr = arrays["feat"], arrays["thr"]
+        self._pathlen = arrays["pathlen"]
+        meta = serialize.load_json(path, "meta")
+        self._depth, self._psi = meta["depth"], meta["psi"]
+        self._threshold = meta["threshold"]

@@ -1,0 +1,11 @@
+"""Model explainability — LIME (reference ``lime/`` package).
+
+Reference: src/main/scala/com/microsoft/ml/spark/lime/ (expected paths,
+UNVERIFIED — SURVEY.md §2.1): tabular + image LIME, SLIC superpixels.
+"""
+
+from .lime import ImageLIME, TabularLIME, TabularLIMEModel
+from .superpixel import Superpixel, SuperpixelTransformer
+
+__all__ = ["ImageLIME", "TabularLIME", "TabularLIMEModel",
+           "Superpixel", "SuperpixelTransformer"]

@@ -1,0 +1,201 @@
+"""Tabular + image LIME.
+
+Reference: lime/LIME.scala (expected path, UNVERIFIED — SURVEY.md §2.1).
+Perturb → predict → weighted local linear fit, per row.  TPU-first shape:
+all perturbed samples for a row form one batch through the underlying
+model (one jit'd forward), and the local surrogate solve is a batched
+weighted least-squares (``vmap`` over rows on device) instead of the
+reference's per-row JVM regression.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import (HasInputCol, HasOutputCol, HasPredictionCol,
+                           Param, TypeConverters, HasSeed)
+from ..core.pipeline import Estimator, Model, Transformer
+from ..core.schema import DataTable, features_matrix
+from ..core import serialize
+from .superpixel import Superpixel
+
+
+@jax.jit
+def _weighted_lstsq(Xs, ys, ws, reg):
+    """Batched ridge-stabilized weighted least squares.
+
+    Xs: (R, S, F) samples per row, ys: (R, S), ws: (R, S) kernel weights,
+    reg: ridge strength (the stage's ``regularization`` param).
+    Returns (R, F) local coefficients (intercept excluded).
+    """
+    def solve(X, y, w):
+        Xa = jnp.concatenate([X, jnp.ones((X.shape[0], 1))], axis=1)
+        Xw = Xa * w[:, None]
+        A = Xw.T @ Xa + reg * jnp.eye(Xa.shape[1])
+        b = Xw.T @ y
+        coef = jnp.linalg.solve(A, b)
+        return coef[:-1]
+    return jax.vmap(solve)(Xs, ys, ws)
+
+
+class _LIMEParams(HasPredictionCol, HasSeed):
+    nSamples = Param("nSamples", "Perturbed samples per row", default=512,
+                     typeConverter=TypeConverters.toInt)
+    samplingFraction = Param("samplingFraction",
+                             "Probability a feature/superpixel stays ON",
+                             default=0.7,
+                             typeConverter=TypeConverters.toFloat)
+    regularization = Param("regularization", "Surrogate ridge term",
+                           default=0.001,
+                           typeConverter=TypeConverters.toFloat)
+    kernelWidth = Param("kernelWidth", "Exponential kernel width",
+                        default=0.75, typeConverter=TypeConverters.toFloat)
+
+
+class TabularLIME(_LIMEParams, HasInputCol, HasOutputCol, Estimator):
+    """Fits feature statistics; the model explains rows of a predictor
+    (lime/LIME.scala tabular path)."""
+
+    def __init__(self, model: Optional[Transformer] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._model = model
+
+    def setModel(self, model: Transformer) -> "TabularLIME":
+        self._model = model
+        return self
+
+    def _fit(self, table: DataTable) -> "TabularLIMEModel":
+        X = features_matrix(table, self.getInputCol())
+        out = TabularLIMEModel(
+            model=self._model,
+            means=X.mean(axis=0), stds=X.std(axis=0) + 1e-12)
+        out.setParams(**{k: v for k, v in self._iterSetParams()
+                         if out.hasParam(k)})
+        return out
+
+
+class TabularLIMEModel(_LIMEParams, HasInputCol, HasOutputCol, Model):
+    def __init__(self, model: Optional[Transformer] = None,
+                 means: Optional[np.ndarray] = None,
+                 stds: Optional[np.ndarray] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._model = model
+        self._means = means
+        self._stds = stds
+
+    def _predict_fn(self) -> Callable[[np.ndarray], np.ndarray]:
+        model = self._model
+        in_col = self.getInputCol()
+        pred_col = self.getPredictionCol()
+
+        def predict(X: np.ndarray) -> np.ndarray:
+            scored = model._transform(DataTable({in_col: X}))
+            out = np.asarray(scored[pred_col], dtype=np.float64)
+            return out if out.ndim == 1 else out[:, -1]
+        return predict
+
+    def _transform(self, table: DataTable) -> DataTable:
+        X = features_matrix(table, self.getInputCol())
+        R, F = X.shape
+        S = self.getNSamples()
+        rng = np.random.default_rng(self.getSeed())
+        predict = self._predict_fn()
+
+        # perturb in standardized space around each row
+        noise = rng.normal(size=(R, S, F))
+        Xs = X[:, None, :] + noise * self._stds[None, None, :]
+        flat = Xs.reshape(R * S, F)
+        ys = predict(flat).reshape(R, S)
+        # exponential kernel over standardized distance
+        d2 = ((noise) ** 2).mean(axis=2)
+        ws = np.exp(-d2 / (self.getKernelWidth() ** 2))
+        coefs = np.asarray(_weighted_lstsq(
+            jnp.asarray((Xs - self._means) / self._stds, jnp.float32),
+            jnp.asarray(ys, jnp.float32), jnp.asarray(ws, jnp.float32),
+            jnp.asarray(self.getRegularization(), jnp.float32)))
+        return table.withColumn(self.getOutputCol(),
+                                coefs.astype(np.float64))
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        serialize.save_arrays(path, means=self._means, stds=self._stds)
+        if self._model is not None:
+            serialize.save_stage(self._model, os.path.join(path, "model"),
+                                 overwrite=True)
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        arrays = serialize.load_arrays(path)
+        self._means, self._stds = arrays["means"], arrays["stds"]
+        p = os.path.join(path, "model")
+        self._model = serialize.load_stage(p) if os.path.exists(p) else None
+
+
+class ImageLIME(_LIMEParams, HasInputCol, HasOutputCol, Transformer):
+    """Superpixel-mask LIME for NHWC image columns (lime/LIME.scala image
+    path).  For each image: cluster superpixels, sample binary masks,
+    batch-predict masked images, fit the local surrogate over mask bits."""
+
+    cellSize = Param("cellSize", "Superpixel diameter", default=16.0,
+                     typeConverter=TypeConverters.toFloat)
+    modifier = Param("modifier", "Superpixel compactness", default=130.0,
+                     typeConverter=TypeConverters.toFloat)
+    superpixelCol = Param("superpixelCol", "Output superpixel-label column",
+                          default="superpixels",
+                          typeConverter=TypeConverters.toString)
+
+    def __init__(self, model: Optional[Transformer] = None,
+                 predictionFn: Optional[Callable] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._model = model
+        self._predict_fn = predictionFn
+
+    def setModel(self, model: Transformer) -> "ImageLIME":
+        self._model = model
+        return self
+
+    def _predict(self, imgs: np.ndarray) -> np.ndarray:
+        if self._predict_fn is not None:
+            return np.asarray(self._predict_fn(imgs), dtype=np.float64)
+        in_col = self.getInputCol()
+        scored = self._model._transform(DataTable({in_col: imgs}))
+        out = np.asarray(scored[self.getPredictionCol()], dtype=np.float64)
+        return out if out.ndim == 1 else out[:, -1]
+
+    def _transform(self, table: DataTable) -> DataTable:
+        imgs = np.asarray(table[self.getInputCol()], dtype=np.float32)
+        N, H, W, C = imgs.shape
+        n_segments = max(4, int((H / self.getCellSize())
+                                * (W / self.getCellSize())))
+        S = self.getNSamples()
+        keep_p = self.getSamplingFraction()
+        rng = np.random.default_rng(self.getSeed())
+
+        weights_out = np.empty(N, dtype=object)
+        labels_out = np.empty(N, dtype=object)
+        for i in range(N):
+            labels = Superpixel.cluster(imgs[i], n_segments=n_segments,
+                                        compactness=self.getModifier() / 13.0)
+            K = int(labels.max()) + 1
+            masks = (rng.random(size=(S, K)) < keep_p)   # (S, K) bool
+            masks[0] = True                              # all-on reference
+            pixel_masks = masks[:, labels]               # (S, H, W)
+            masked = imgs[i][None] * pixel_masks[..., None]
+            ys = self._predict(masked)                   # (S,)
+            d = 1.0 - masks.mean(axis=1)                 # fraction off
+            ws = np.exp(-(d ** 2) / (self.getKernelWidth() ** 2))
+            coef = np.asarray(_weighted_lstsq(
+                jnp.asarray(masks[None].astype(np.float32)),
+                jnp.asarray(ys[None], jnp.float32),
+                jnp.asarray(ws[None], jnp.float32),
+                jnp.asarray(self.getRegularization(), jnp.float32)))[0]
+            weights_out[i] = coef.astype(np.float64)
+            labels_out[i] = labels
+        return table.withColumns({
+            self.getOutputCol(): weights_out,
+            self.getSuperpixelCol(): labels_out,
+        })

@@ -1,0 +1,11 @@
+"""Nearest neighbors (reference ``nn/`` package).
+
+Reference: nn/BallTree.scala, nn/KNN.scala, nn/ConditionalKNN.scala
+(expected paths, UNVERIFIED — SURVEY.md §2.1).
+"""
+
+from .knn import KNN, KNNModel, ConditionalKNN, ConditionalKNNModel
+from .balltree import BallTree
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel",
+           "BallTree"]

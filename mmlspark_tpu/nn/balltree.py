@@ -1,0 +1,84 @@
+"""BallTree — host-side exact NN structure for API parity.
+
+Reference: nn/BallTree.scala (expected path, UNVERIFIED — SURVEY.md §2.1).
+The reference broadcasts a serialized BallTree to executors and queries it
+per row on the JVM.  On TPU the *fast* path is the brute-force matmul in
+:mod:`mmlspark_tpu.nn.knn` (distance = one MXU matmul + top_k, batched over
+queries); this class exists for users of the reference's BallTree API and
+for host-side queries on datasets too small to ship to the device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("center", "radius", "idx", "left", "right")
+
+    def __init__(self, center, radius, idx, left=None, right=None):
+        self.center = center
+        self.radius = radius
+        self.idx = idx          # leaf: indices array; internal: None
+        self.left = left
+        self.right = right
+
+
+class BallTree:
+    """Exact k-NN ball tree over a point matrix (euclidean)."""
+
+    def __init__(self, points: np.ndarray, leaf_size: int = 50):
+        self._pts = np.asarray(points, dtype=np.float64)
+        self._leaf_size = int(leaf_size)
+        self._root = self._build(np.arange(len(self._pts)))
+
+    def _build(self, idx: np.ndarray) -> _Node:
+        pts = self._pts[idx]
+        center = pts.mean(axis=0)
+        radius = float(np.sqrt(((pts - center) ** 2).sum(axis=1).max())) \
+            if len(pts) else 0.0
+        if len(idx) <= self._leaf_size:
+            return _Node(center, radius, idx)
+        # split on the direction of max spread
+        spread = pts.max(axis=0) - pts.min(axis=0)
+        dim = int(np.argmax(spread))
+        order = np.argsort(pts[:, dim], kind="stable")
+        half = len(idx) // 2
+        left = self._build(idx[order[:half]])
+        right = self._build(idx[order[half:]])
+        return _Node(center, radius, None, left, right)
+
+    def query(self, q: np.ndarray, k: int = 1
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (distances, indices) of the k nearest points to q."""
+        q = np.asarray(q, dtype=np.float64)
+        best: List[Tuple[float, int]] = []   # max-heap by -dist (small list)
+
+        def visit(node: _Node):
+            d_center = float(np.sqrt(((q - node.center) ** 2).sum()))
+            if len(best) == k and d_center - node.radius > best[-1][0]:
+                return  # prune: ball cannot contain anything closer
+            if node.idx is not None:
+                d = np.sqrt(((self._pts[node.idx] - q) ** 2).sum(axis=1))
+                for dist, i in zip(d, node.idx):
+                    if len(best) < k:
+                        best.append((float(dist), int(i)))
+                        best.sort()
+                    elif dist < best[-1][0]:
+                        best[-1] = (float(dist), int(i))
+                        best.sort()
+                return
+            # nearer child first
+            d_l = ((q - node.left.center) ** 2).sum()
+            d_r = ((q - node.right.center) ** 2).sum()
+            first, second = ((node.left, node.right) if d_l <= d_r
+                             else (node.right, node.left))
+            visit(first)
+            visit(second)
+
+        visit(self._root)
+        dists = np.asarray([d for d, _ in best])
+        idxs = np.asarray([i for _, i in best], dtype=np.int64)
+        return dists, idxs

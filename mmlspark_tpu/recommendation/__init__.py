@@ -1,0 +1,24 @@
+"""Recommendation (reference ``recommendation/`` package).
+
+Reference: src/main/scala/com/microsoft/ml/spark/recommendation/ (expected
+paths, UNVERIFIED — SURVEY.md §2.1): SAR (Smart Adaptive Recommendations)
+item-item recommender, RecommendationIndexer, RankingEvaluator,
+RankingAdapter, RankingTrainValidationSplit.
+"""
+
+from .sar import SAR, SARModel
+from .indexer import RecommendationIndexer, RecommendationIndexerModel
+from .ranking import (
+    RankingAdapter,
+    RankingAdapterModel,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RankingTrainValidationSplitModel,
+)
+
+__all__ = [
+    "SAR", "SARModel",
+    "RecommendationIndexer", "RecommendationIndexerModel",
+    "RankingAdapter", "RankingAdapterModel", "RankingEvaluator",
+    "RankingTrainValidationSplit", "RankingTrainValidationSplitModel",
+]

@@ -1,0 +1,90 @@
+"""RecommendationIndexer — string user/item ids → dense int indices.
+
+Reference: recommendation/RecommendationIndexer.scala (expected path,
+UNVERIFIED — SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..core.schema import DataTable
+from ..core import serialize
+
+
+class _IndexerParams:
+    pass
+
+
+class RecommendationIndexer(Estimator):
+    userInputCol = Param("userInputCol", "Raw user column",
+                         typeConverter=TypeConverters.toString)
+    userOutputCol = Param("userOutputCol", "Indexed user column",
+                          typeConverter=TypeConverters.toString)
+    itemInputCol = Param("itemInputCol", "Raw item column",
+                         typeConverter=TypeConverters.toString)
+    itemOutputCol = Param("itemOutputCol", "Indexed item column",
+                          typeConverter=TypeConverters.toString)
+    ratingCol = Param("ratingCol", "Rating column", default="rating",
+                      typeConverter=TypeConverters.toString)
+
+    def _fit(self, table: DataTable) -> "RecommendationIndexerModel":
+        users = sorted({str(v) for v in table[self.getUserInputCol()]})
+        items = sorted({str(v) for v in table[self.getItemInputCol()]})
+        model = RecommendationIndexerModel(userLevels=users, itemLevels=items)
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+
+class RecommendationIndexerModel(Model):
+    userInputCol = RecommendationIndexer.userInputCol
+    userOutputCol = RecommendationIndexer.userOutputCol
+    itemInputCol = RecommendationIndexer.itemInputCol
+    itemOutputCol = RecommendationIndexer.itemOutputCol
+    ratingCol = RecommendationIndexer.ratingCol
+
+    def __init__(self, userLevels: Optional[List[Any]] = None,
+                 itemLevels: Optional[List[Any]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._users = list(userLevels or [])
+        self._items = list(itemLevels or [])
+
+    @property
+    def userLevels(self) -> List[Any]:
+        return list(self._users)
+
+    @property
+    def itemLevels(self) -> List[Any]:
+        return list(self._items)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        u_index = {v: i for i, v in enumerate(self._users)}
+        i_index = {v: i for i, v in enumerate(self._items)}
+        u = np.asarray([u_index.get(str(v), -1)
+                        for v in table[self.getUserInputCol()]],
+                       dtype=np.int64)
+        it = np.asarray([i_index.get(str(v), -1)
+                         for v in table[self.getItemInputCol()]],
+                        dtype=np.int64)
+        return table.withColumns({self.getUserOutputCol(): u,
+                                  self.getItemOutputCol(): it})
+
+    def recoverUser(self, idx: np.ndarray) -> np.ndarray:
+        return np.asarray([self._users[i] for i in idx], dtype=object)
+
+    def recoverItem(self, idx: np.ndarray) -> np.ndarray:
+        return np.asarray([self._items[i] for i in idx], dtype=object)
+
+    def _save_extra(self, path: str) -> None:
+        serialize.save_json(path, "levels",
+                            {"users": self._users, "items": self._items})
+
+    def _load_extra(self, path: str) -> None:
+        levels = serialize.load_json(path, "levels")
+        self._users = levels["users"]
+        self._items = levels["items"]

@@ -1,0 +1,254 @@
+"""Ranking evaluation + adapter + train/validation split.
+
+Reference: recommendation/RankingEvaluator.scala, RankingAdapter.scala,
+RankingTrainValidationSplit.scala (expected paths, UNVERIFIED — SURVEY.md
+§2.1).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.params import HasSeed, Param, TypeConverters
+from ..core.pipeline import Estimator, Model, Transformer
+from ..core.schema import DataTable
+from ..core import serialize
+
+_METRICS = ("ndcgAt", "map", "precisionAtk", "recallAtK")
+
+
+class RankingEvaluator:
+    """NDCG@k / MAP / precision@k / recall@k over recommendation lists.
+
+    ``evaluate`` takes a table with per-user ``recommendations`` (int array
+    column, ranked) and ``groundTruth`` (object column of relevant item
+    lists).  Not a Transformer in the reference either (it's an Evaluator),
+    so it mirrors that shape.
+    """
+
+    def __init__(self, k: int = 10, metricName: str = "ndcgAt"):
+        if metricName not in _METRICS:
+            raise ValueError(f"Unknown metric {metricName!r}; "
+                             f"choose from {_METRICS}")
+        self.k = k
+        self.metricName = metricName
+
+    def setK(self, k: int) -> "RankingEvaluator":
+        self.k = k
+        return self
+
+    def setMetricName(self, name: str) -> "RankingEvaluator":
+        self.metricName = name
+        return self
+
+    def evaluate(self, table: DataTable,
+                 recCol: str = "recommendations",
+                 labelCol: str = "groundTruth") -> float:
+        recs = table[recCol]
+        truth = table[labelCol]
+        vals = []
+        for r, t in zip(recs, truth):
+            r = list(np.asarray(r).tolist())[:self.k]
+            t = set(np.asarray(t).tolist())
+            if not t:
+                continue
+            vals.append(self._one(r, t))
+        return float(np.mean(vals)) if vals else 0.0
+
+    def _one(self, rec: List[int], truth: set) -> float:
+        k = self.k
+        hits = [1.0 if r in truth else 0.0 for r in rec]
+        if self.metricName == "precisionAtk":
+            return sum(hits) / k
+        if self.metricName == "recallAtK":
+            return sum(hits) / len(truth)
+        if self.metricName == "map":
+            score, n_hits = 0.0, 0
+            for i, h in enumerate(hits):
+                if h:
+                    n_hits += 1
+                    score += n_hits / (i + 1.0)
+            return score / min(len(truth), k)
+        # ndcgAt
+        dcg = sum(h / np.log2(i + 2.0) for i, h in enumerate(hits))
+        ideal = sum(1.0 / np.log2(i + 2.0)
+                    for i in range(min(len(truth), k)))
+        return dcg / ideal if ideal > 0 else 0.0
+
+
+class RankingAdapter(Estimator):
+    """Wraps a recommender estimator so fit→transform yields per-user
+    ranked recommendation lists plus ground truth, ready for
+    :class:`RankingEvaluator` (recommendation/RankingAdapter.scala)."""
+
+    mode = Param("mode", "allUsers (only supported mode)", default="allUsers",
+                 typeConverter=TypeConverters.toString)
+    k = Param("k", "Recommendations per user", default=10,
+              typeConverter=TypeConverters.toInt)
+    minRatingsPerUser = Param("minRatingsPerUser",
+                              "Drop users with fewer ratings", default=1,
+                              typeConverter=TypeConverters.toInt)
+
+    def __init__(self, recommender: Optional[Estimator] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._recommender = recommender
+
+    def getRecommender(self) -> Optional[Estimator]:
+        return self._recommender
+
+    def setRecommender(self, rec: Estimator) -> "RankingAdapter":
+        self._recommender = rec
+        return self
+
+    def _fit(self, table: DataTable) -> "RankingAdapterModel":
+        fitted = self._recommender._fit(table)
+        model = RankingAdapterModel(fitted=fitted)
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+
+class RankingAdapterModel(Model):
+    mode = RankingAdapter.mode
+    k = RankingAdapter.k
+    minRatingsPerUser = RankingAdapter.minRatingsPerUser
+
+    def __init__(self, fitted=None, **kwargs):
+        super().__init__(**kwargs)
+        self._fitted = fitted
+
+    def getRecommenderModel(self):
+        return self._fitted
+
+    def _transform(self, table: DataTable) -> DataTable:
+        user_col = self._fitted.getUserCol()
+        item_col = self._fitted.getItemCol()
+        recs = self._fitted.recommendForAllUsers(self.getK())
+        users = np.asarray(table[user_col], dtype=np.int64)
+        items = np.asarray(table[item_col], dtype=np.int64)
+        truth: Dict[int, List[int]] = {}
+        for u, i in zip(users, items):
+            truth.setdefault(int(u), []).append(int(i))
+        rec_users = np.asarray(recs[self._fitted.getUserCol()],
+                               dtype=np.int64)
+        gt = np.empty(len(rec_users), dtype=object)
+        for r, u in enumerate(rec_users):
+            gt[r] = truth.get(int(u), [])
+        out = recs.withColumn("groundTruth", gt)
+        min_ratings = self.getMinRatingsPerUser()
+        if min_ratings > 1:
+            keep = np.asarray([len(truth.get(int(u), [])) >= min_ratings
+                               for u in rec_users])
+            out = out.take(keep)
+        return out
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        serialize.save_stage(self._fitted, os.path.join(path, "fitted"),
+                             overwrite=True)
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        self._fitted = serialize.load_stage(os.path.join(path, "fitted"))
+
+
+class RankingTrainValidationSplit(HasSeed, Estimator):
+    """Per-user leave-out split + hyperparameter evaluation
+    (recommendation/RankingTrainValidationSplit.scala)."""
+
+    trainRatio = Param("trainRatio", "Per-user train fraction", default=0.75,
+                       typeConverter=TypeConverters.toFloat)
+    userCol = Param("userCol", "User column", default="user",
+                    typeConverter=TypeConverters.toString)
+    itemCol = Param("itemCol", "Item column", default="item",
+                    typeConverter=TypeConverters.toString)
+    k = Param("k", "Evaluation depth", default=10,
+              typeConverter=TypeConverters.toInt)
+    metricName = Param("metricName", "Ranking metric", default="ndcgAt",
+                       typeConverter=TypeConverters.toString)
+
+    def __init__(self, estimator: Optional[Estimator] = None,
+                 estimatorParamMaps: Optional[Sequence[Dict]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._estimator = estimator
+        self._param_maps = list(estimatorParamMaps or [{}])
+
+    def setEstimator(self, est: Estimator) -> "RankingTrainValidationSplit":
+        self._estimator = est
+        return self
+
+    def setEstimatorParamMaps(self, maps) -> "RankingTrainValidationSplit":
+        self._param_maps = list(maps)
+        return self
+
+    def _split(self, table: DataTable):
+        users = np.asarray(table[self.getUserCol()], dtype=np.int64)
+        rng = np.random.default_rng(self.getSeed())
+        ratio = self.getTrainRatio()
+        train_mask = np.zeros(len(users), dtype=bool)
+        for u in np.unique(users):
+            idx = np.flatnonzero(users == u)
+            idx = rng.permutation(idx)
+            cut = max(1, int(round(len(idx) * ratio)))
+            train_mask[idx[:cut]] = True
+        return table.take(train_mask), table.take(~train_mask)
+
+    def _fit(self, table: DataTable) -> "RankingTrainValidationSplitModel":
+        if self._estimator is None:
+            raise ValueError("RankingTrainValidationSplit needs an estimator")
+        train, val = self._split(table)
+        evaluator = RankingEvaluator(k=self.getK(),
+                                     metricName=self.getMetricName())
+        best_metric, best_params = -np.inf, {}
+        metrics = []
+        for params in self._param_maps:
+            cand = self._estimator.copy(
+                {k: v for k, v in params.items()
+                 if self._estimator.hasParam(k)})
+            adapter = RankingAdapter(recommender=cand, k=self.getK())
+            fitted = adapter._fit(train)
+            scored = fitted._transform(val)
+            m = evaluator.evaluate(scored)
+            metrics.append(m)
+            if m > best_metric:
+                best_metric, best_params = m, dict(params)
+        final = self._estimator.copy(
+            {k: v for k, v in best_params.items()
+             if self._estimator.hasParam(k)})._fit(table)
+        model = RankingTrainValidationSplitModel(
+            bestModel=final, validationMetrics=metrics)
+        model.setParams(**{k: v for k, v in self._iterSetParams()
+                           if model.hasParam(k)})
+        return model
+
+
+class RankingTrainValidationSplitModel(Model):
+    def __init__(self, bestModel=None,
+                 validationMetrics: Optional[List[float]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._best = bestModel
+        self._metrics = list(validationMetrics or [])
+
+    def getBestModel(self):
+        return self._best
+
+    @property
+    def validationMetrics(self) -> List[float]:
+        return list(self._metrics)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        return self._best._transform(table)
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        serialize.save_stage(self._best, os.path.join(path, "best"),
+                             overwrite=True)
+        serialize.save_json(path, "metrics", self._metrics)
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        self._best = serialize.load_stage(os.path.join(path, "best"))
+        self._metrics = serialize.load_json(path, "metrics")

@@ -1,0 +1,30 @@
+"""Vowpal-Wabbit-equivalent online linear learning (reference ``vw/``).
+
+Reference: src/main/scala/com/microsoft/ml/spark/vw/ (expected paths,
+UNVERIFIED — SURVEY.md §2.1): VowpalWabbitClassifier/Regressor (JNI to the
+C++ VW engine), VowpalWabbitFeaturizer (murmur feature hashing),
+VowpalWabbitInteractions (namespace crosses).
+
+TPU-native design (SURVEY.md §2.2): the VW capability actually exercised is
+hashed linear/logistic SGD with adaptive (AdaGrad-style) learning rates.
+Hashing runs on host (murmur3, bit-compatible with the featurize package);
+the weight vector lives on device and the training pass is a single
+``lax.scan`` over minibatches — each step is one (B × D) · (D,) matvec on
+the MXU plus elementwise updates.  Distributed training uses model averaging
+over the mesh data axis (``psum``/mean), the same strategy the reference's
+VW spanning-tree allreduce implements.
+"""
+
+from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .learners import (
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitRegressionModel,
+    VowpalWabbitRegressor,
+)
+
+__all__ = [
+    "VowpalWabbitFeaturizer", "VowpalWabbitInteractions",
+    "VowpalWabbitClassifier", "VowpalWabbitClassificationModel",
+    "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
+]

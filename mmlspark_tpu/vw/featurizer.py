@@ -1,0 +1,129 @@
+"""VW-style feature hashing into a fixed 2^numBits dense vector.
+
+Reference: vw/VowpalWabbitFeaturizer.scala, vw/VowpalWabbitInteractions.scala
+(expected paths, UNVERIFIED — SURVEY.md §2.1).
+
+The reference emits sparse VW example strings; a TPU wants dense,
+statically-shaped operands, so here hashing scatters into a dense
+``(rows, 2^numBits)`` float column (numBits defaults low enough that dense
+is cheap; raise it for genuinely sparse workloads and the matmul against a
+weight vector still maps to the MXU).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.params import HasInputCols, HasOutputCol, Param, TypeConverters
+from ..core.pipeline import Transformer
+from ..core.schema import DataTable
+from ..featurize.hashing import murmur3_32
+
+
+def _hash(s: str, seed: int) -> int:
+    return murmur3_32(s.encode("utf-8"), seed)
+
+
+class VowpalWabbitFeaturizer(HasInputCols, HasOutputCol, Transformer):
+    """Hashes mixed-type columns into one dense vector column.
+
+    Per-column behavior (mirrors the reference featurizer):
+
+    * numeric scalar → weight at ``hash(colName)``
+    * string → weight 1.0 at ``hash(colName + "=" + value)``
+    * numeric vector → element i at ``hash(colName + "_" + i)``
+    * list of strings → weight 1.0 per token at ``hash(colName + "=" + tok)``
+    """
+
+    outputCol = Param("outputCol", "Output vector column", default="features",
+                      typeConverter=TypeConverters.toString)
+    numBits = Param("numBits", "log2 of the hash space", default=12,
+                    typeConverter=TypeConverters.toInt,
+                    validator=lambda v: 1 <= v <= 24)
+    sumCollisions = Param("sumCollisions",
+                          "Sum colliding values (else last write wins)",
+                          default=True, typeConverter=TypeConverters.toBool)
+    seed = Param("seed", "Murmur seed", default=0,
+                 typeConverter=TypeConverters.toInt)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        dim = 1 << self.getNumBits()
+        mask = dim - 1
+        seed = self.getSeed()
+        n = len(table)
+        out = np.zeros((n, dim), dtype=np.float32)
+        summing = self.getSumCollisions()
+        for name in self.getInputCols():
+            col = table[name]
+            if col.ndim == 2:
+                idx = np.asarray(
+                    [_hash(f"{name}_{i}", seed) & mask
+                     for i in range(col.shape[1])], dtype=np.int64)
+                vals = col.astype(np.float32)
+                for j, slot in enumerate(idx):
+                    if summing:
+                        out[:, slot] += vals[:, j]
+                    else:
+                        out[:, slot] = vals[:, j]
+            elif col.dtype.kind in "fiub":
+                slot = _hash(name, seed) & mask
+                if summing:
+                    out[:, slot] += col.astype(np.float32)
+                else:
+                    out[:, slot] = col.astype(np.float32)
+            else:
+                for r, v in enumerate(col):
+                    tokens = v if isinstance(v, (list, tuple)) else [v]
+                    for tok in tokens:
+                        slot = _hash(f"{name}={tok}", seed) & mask
+                        if summing:
+                            out[r, slot] += 1.0
+                        else:
+                            out[r, slot] = 1.0
+        return table.withColumn(self.getOutputCol(), out)
+
+
+class VowpalWabbitInteractions(HasInputCols, HasOutputCol, Transformer):
+    """Quadratic namespace crosses: the outer product of the input vector
+    columns, re-hashed into the output space (vw/VowpalWabbitInteractions
+    .scala — VW's ``-q ab`` flag)."""
+
+    outputCol = Param("outputCol", "Output vector column",
+                      default="interactions",
+                      typeConverter=TypeConverters.toString)
+    numBits = Param("numBits", "log2 of the hash space", default=12,
+                    typeConverter=TypeConverters.toInt,
+                    validator=lambda v: 1 <= v <= 24)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        cols = [np.asarray(table[c], dtype=np.float32)
+                for c in self.getInputCols()]
+        for c, name in zip(cols, self.getInputCols()):
+            if c.ndim != 2:
+                raise ValueError(
+                    f"Interactions need vector columns; {name!r} has shape "
+                    f"{c.shape} — run VowpalWabbitFeaturizer first")
+        dim = 1 << self.getNumBits()
+        n = len(table)
+        if len(cols) < 2:
+            raise ValueError("Need at least two input vector columns")
+        # pairwise crosses of nonzero slots, rehashed by slot-index pair
+        out = np.zeros((n, dim), dtype=np.float32)
+        for a_i in range(len(cols)):
+            for b_i in range(a_i + 1, len(cols)):
+                a, b = cols[a_i], cols[b_i]
+                # slot pair (i, j) → slot (i * P + j) mod dim; P a big prime
+                # mirrors VW's hash-combine of namespace feature hashes
+                ii, jj = np.nonzero(a)[1], np.nonzero(b)[1]
+                slots_a = np.unique(ii)
+                slots_b = np.unique(jj)
+                for i in slots_a:
+                    combined = (i.astype(np.int64) * 16777619 +
+                                slots_b.astype(np.int64)) % dim
+                    # np.add.at: colliding combined slots must SUM, and
+                    # fancy-index += silently drops duplicate contributions
+                    np.add.at(out, (slice(None), combined),
+                              a[:, [i]] * b[:, slots_b])
+        return table.withColumn(self.getOutputCol(), out)

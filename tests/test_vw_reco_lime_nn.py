@@ -1,0 +1,348 @@
+"""Tests for vw/, recommendation/, lime/, nn/, isolationforest/ packages."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import DataTable
+
+
+# -- vw -----------------------------------------------------------------------
+
+def test_vw_featurizer_hashing():
+    from mmlspark_tpu.vw import VowpalWabbitFeaturizer
+    t = DataTable({
+        "age": np.array([30.0, 40.0]),
+        "job": np.array(["tech", "edu"], dtype=object),
+        "vec": np.array([[1.0, 2.0], [3.0, 4.0]]),
+    })
+    out = VowpalWabbitFeaturizer(
+        inputCols=["age", "job", "vec"], numBits=10).transform(t)
+    f = out["features"]
+    assert f.shape == (2, 1024)
+    # numeric col: same slot both rows, values 30/40
+    assert set(np.round(f[0][f[0] != 0], 3)) >= {30.0}
+    # different categories hash to (almost surely) different slots
+    assert not np.array_equal(f[0] != 0, f[1] != 0)
+
+
+def test_vw_interactions():
+    from mmlspark_tpu.vw import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+    t = DataTable({
+        "a": np.array(["x", "y"], dtype=object),
+        "b": np.array(["p", "q"], dtype=object),
+    })
+    fa = VowpalWabbitFeaturizer(inputCols=["a"], outputCol="fa", numBits=8)
+    fb = VowpalWabbitFeaturizer(inputCols=["b"], outputCol="fb", numBits=8)
+    t = fb.transform(fa.transform(t))
+    out = VowpalWabbitInteractions(
+        inputCols=["fa", "fb"], outputCol="q", numBits=10).transform(t)
+    assert out["q"].shape == (2, 1024)
+    assert (out["q"] != 0).sum(axis=1).tolist() == [1, 1]
+
+
+def test_vw_classifier(binary_table, tmp_path):
+    from mmlspark_tpu.vw import (VowpalWabbitClassificationModel,
+                                 VowpalWabbitClassifier)
+    from mmlspark_tpu.train.metrics import roc_auc
+    t = DataTable(dict(binary_table))
+    model = VowpalWabbitClassifier(numPasses=10, learningRate=0.5).fit(t)
+    out = model.transform(t)
+    auc = roc_auc(np.asarray(t["label"]),
+                  np.asarray(out["probability"])[:, 1])
+    assert auc > 0.8
+
+    p = str(tmp_path / "vw")
+    model.save(p)
+    loaded = VowpalWabbitClassificationModel.load(p)
+    out2 = loaded.transform(t)
+    np.testing.assert_allclose(np.asarray(out2["probability"]),
+                               np.asarray(out["probability"]), rtol=1e-5)
+
+
+def test_vw_regressor(regression_table):
+    from mmlspark_tpu.vw import VowpalWabbitRegressor
+    t = DataTable(dict(regression_table))
+    # standardize features for SGD
+    X = np.asarray(t["features"])
+    X = (X - X.mean(0)) / (X.std(0) + 1e-9)
+    y = np.asarray(t["label"])
+    y_s = (y - y.mean()) / y.std()
+    t = DataTable({"features": X, "label": y_s})
+    model = VowpalWabbitRegressor(numPasses=20, learningRate=0.3).fit(t)
+    pred = np.asarray(model.transform(t)["prediction"])
+    r2 = 1 - np.sum((y_s - pred) ** 2) / np.sum((y_s - y_s.mean()) ** 2)
+    assert r2 > 0.5
+
+
+# -- recommendation -----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ratings():
+    rng = np.random.default_rng(5)
+    # two user cliques with disjoint item tastes + noise
+    users, items, vals = [], [], []
+    for u in range(40):
+        clique = u % 2
+        base_items = np.arange(0, 10) if clique == 0 else np.arange(10, 20)
+        chosen = rng.choice(base_items, size=6, replace=False)
+        for i in chosen:
+            users.append(u)
+            items.append(int(i))
+            vals.append(float(rng.integers(3, 6)))
+    return DataTable({"user": np.asarray(users, dtype=np.int64),
+                      "item": np.asarray(items, dtype=np.int64),
+                      "rating": np.asarray(vals)})
+
+
+def test_sar_recommends_within_clique(ratings, tmp_path):
+    from mmlspark_tpu.recommendation import SAR, SARModel
+    model = SAR(supportThreshold=1, similarityFunction="jaccard").fit(ratings)
+    sim = model.itemSimilarity
+    # items within a clique co-occur; across cliques never
+    assert sim[0, :10].sum() > 0
+    assert sim[0, 10:].sum() == 0
+    recs = model.recommendForAllUsers(5)
+    assert recs["recommendations"].shape == (40, 5)
+    u0_recs = recs["recommendations"][0]
+    assert all(r < 10 for r in u0_recs)  # user 0 is clique 0
+
+    scored = model.transform(ratings)
+    assert "prediction" in scored.columns
+
+    p = str(tmp_path / "sar")
+    model.save(p)
+    loaded = SARModel.load(p)
+    np.testing.assert_allclose(loaded.itemSimilarity, sim)
+
+
+def test_recommendation_indexer(tmp_path):
+    from mmlspark_tpu.recommendation import (RecommendationIndexer,
+                                             RecommendationIndexerModel)
+    t = DataTable({"u": np.array(["alice", "bob", "alice"], dtype=object),
+                   "i": np.array(["x", "y", "y"], dtype=object)})
+    model = RecommendationIndexer(
+        userInputCol="u", userOutputCol="ui",
+        itemInputCol="i", itemOutputCol="ii").fit(t)
+    out = model.transform(t)
+    np.testing.assert_array_equal(out["ui"], [0, 1, 0])
+    np.testing.assert_array_equal(out["ii"], [0, 1, 1])
+    assert list(model.recoverUser(np.array([1, 0]))) == ["bob", "alice"]
+
+    p = str(tmp_path / "ri")
+    model.save(p)
+    loaded = RecommendationIndexerModel.load(p)
+    assert loaded.userLevels == model.userLevels
+
+
+def test_ranking_evaluator():
+    from mmlspark_tpu.recommendation import RankingEvaluator
+    t = DataTable({
+        "recommendations": np.array([[1, 2, 3], [4, 5, 6]]),
+        "groundTruth": np.array([[1, 3], [9]], dtype=object),
+    })
+    ev = RankingEvaluator(k=3, metricName="precisionAtk")
+    assert ev.evaluate(t) == pytest.approx((2 / 3 + 0) / 2)
+    ev = RankingEvaluator(k=3, metricName="recallAtK")
+    assert ev.evaluate(t) == pytest.approx((1.0 + 0) / 2)
+    ev = RankingEvaluator(k=3, metricName="ndcgAt")
+    dcg = 1 / np.log2(2) + 1 / np.log2(4)
+    idcg = 1 / np.log2(2) + 1 / np.log2(3)
+    assert ev.evaluate(t) == pytest.approx((dcg / idcg) / 2)
+
+
+def test_ranking_adapter_and_split(ratings):
+    from mmlspark_tpu.recommendation import (RankingAdapter,
+                                             RankingEvaluator,
+                                             RankingTrainValidationSplit, SAR)
+    adapter = RankingAdapter(recommender=SAR(supportThreshold=1), k=5)
+    fitted = adapter.fit(ratings)
+    out = fitted.transform(ratings)
+    assert "groundTruth" in out.columns
+    ndcg = RankingEvaluator(k=5, metricName="ndcgAt").evaluate(out)
+    assert ndcg > 0.5  # clique structure is easy
+
+    split = RankingTrainValidationSplit(
+        estimator=SAR(supportThreshold=1),
+        estimatorParamMaps=[{"similarityFunction": "jaccard"},
+                            {"similarityFunction": "lift"}],
+        userCol="user", itemCol="item", k=5, trainRatio=0.7, seed=3)
+    model = split.fit(ratings)
+    assert len(model.validationMetrics) == 2
+    assert model.getBestModel() is not None
+
+
+def test_sar_cold_start_scores_zero(ratings):
+    from mmlspark_tpu.recommendation import SAR
+    model = SAR(supportThreshold=1).fit(ratings)
+    q = DataTable({"user": np.array([-1, 0], dtype=np.int64),
+                   "item": np.array([0, -1], dtype=np.int64)})
+    pred = model.transform(q)["prediction"]
+    assert pred[0] == 0.0 and pred[1] == 0.0
+    bad = DataTable({"user": np.array([-1], dtype=np.int64),
+                     "item": np.array([0], dtype=np.int64),
+                     "rating": np.array([1.0])})
+    with pytest.raises(ValueError, match="-1"):
+        SAR().fit(bad)
+
+
+def test_vw_sample_weights_shift_model():
+    from mmlspark_tpu.vw import VowpalWabbitClassifier
+    rng = np.random.default_rng(0)
+    n = 400
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    w = np.where(y > 0, 10.0, 0.1)  # up-weight positives hard
+    t = DataTable({"features": X, "label": y, "w": w})
+    m_plain = VowpalWabbitClassifier(numPasses=5).fit(t)
+    m_weighted = VowpalWabbitClassifier(numPasses=5, weightCol="w").fit(t)
+    p_plain = np.asarray(m_plain.transform(t)["probability"])[:, 1].mean()
+    p_weighted = np.asarray(
+        m_weighted.transform(t)["probability"])[:, 1].mean()
+    assert p_weighted > p_plain + 0.02  # weighting shifts toward positives
+
+
+def test_vw_ragged_tail_trains(monkeypatch):
+    # 300 rows with batch 256: tail rows must still contribute
+    from mmlspark_tpu.vw import VowpalWabbitClassifier
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    t = DataTable({"features": X, "label": y})
+    model = VowpalWabbitClassifier(numPasses=8, batchSize=256).fit(t)
+    acc = (np.asarray(model.transform(t)["prediction"]) == y).mean()
+    assert acc > 0.9
+
+
+# -- lime ---------------------------------------------------------------------
+
+def test_tabular_lime_recovers_importance():
+    from mmlspark_tpu.lime import TabularLIME
+    from mmlspark_tpu.core.pipeline import Transformer
+
+    class LinearModel(Transformer):
+        _registrable = False
+
+        def _transform(self, table):
+            X = np.asarray(table["features"])
+            return table.withColumn("prediction", 3.0 * X[:, 0] - 2.0 * X[:, 1])
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(20, 4))
+    t = DataTable({"features": X})
+    lime = TabularLIME(model=LinearModel(), inputCol="features",
+                       outputCol="weights", nSamples=256)
+    model = lime.fit(t)
+    out = model.transform(t)
+    W = np.asarray(out["weights"].tolist())
+    assert W.shape == (20, 4)
+    # standardized coefs: |w0|, |w1| >> |w2|, |w3|
+    mean_abs = np.abs(W).mean(axis=0)
+    assert mean_abs[0] > 5 * mean_abs[2]
+    assert mean_abs[1] > 5 * mean_abs[3]
+    # signs recovered
+    assert (W[:, 0] > 0).all() and (W[:, 1] < 0).all()
+
+
+def test_superpixel_and_image_lime():
+    from mmlspark_tpu.lime import ImageLIME, Superpixel
+    rng = np.random.default_rng(1)
+    img = np.zeros((24, 24, 3), dtype=np.float32)
+    img[:, 12:] = 1.0  # right half bright
+    labels = Superpixel.cluster(img, n_segments=9)
+    assert labels.shape == (24, 24)
+    assert labels.max() >= 3
+
+    # model: mean brightness of right half drives the prediction
+    def predict(imgs):
+        return imgs[:, :, 12:, :].mean(axis=(1, 2, 3))
+
+    imgs = np.stack([img, img])
+    t = DataTable({"image": imgs})
+    lime = ImageLIME(predictionFn=predict, inputCol="image",
+                     outputCol="weights", nSamples=64, cellSize=8.0)
+    out = lime.transform(t)
+    w = out["weights"][0]
+    labels0 = out["superpixels"][0]
+    # superpixels on the right half must out-weigh left-half ones
+    right_sp = np.unique(labels0[:, 18:])
+    left_sp = np.unique(labels0[:, :6])
+    right_w = np.mean([w[s] for s in right_sp])
+    left_w = np.mean([w[s] for s in left_sp if s not in set(right_sp)])
+    assert right_w > left_w + 0.01
+
+
+# -- nn -----------------------------------------------------------------------
+
+def test_balltree_matches_bruteforce():
+    from mmlspark_tpu.nn import BallTree
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(300, 8))
+    tree = BallTree(X, leaf_size=16)
+    q = rng.normal(size=8)
+    d, idx = tree.query(q, k=5)
+    brute = np.sqrt(((X - q) ** 2).sum(axis=1))
+    expect = np.argsort(brute)[:5]
+    np.testing.assert_array_equal(np.sort(idx), np.sort(expect))
+    np.testing.assert_allclose(np.sort(d), np.sort(brute[expect]))
+
+
+def test_knn(tmp_path):
+    from mmlspark_tpu.nn import KNN, KNNModel
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(100, 5)).astype(np.float32)
+    names = np.asarray([f"row{i}" for i in range(100)], dtype=object)
+    t = DataTable({"features": X, "name": names})
+    model = KNN(valuesCol="name", k=3).fit(t)
+    q = DataTable({"features": X[:10] + 1e-6})
+    out = model.transform(q)
+    # nearest neighbor of a barely-perturbed row is itself
+    assert [m[0] for m in out["matches"]] == list(range(10))
+    assert out["values"][0][0] == "row0"
+
+    p = str(tmp_path / "knn")
+    model.save(p)
+    loaded = KNNModel.load(p)
+    out2 = loaded.transform(q)
+    np.testing.assert_array_equal(out2["matches"], out["matches"])
+
+
+def test_conditional_knn():
+    from mmlspark_tpu.nn import ConditionalKNN
+    X = np.asarray([[0.0], [1.0], [2.0], [3.0]], dtype=np.float32)
+    labels = np.asarray(["a", "b", "a", "b"], dtype=object)
+    t = DataTable({"features": X, "label": labels})
+    model = ConditionalKNN(k=2).fit(t)
+    q = DataTable({"features": np.asarray([[0.1]], dtype=np.float32),
+                   "conditioner": np.asarray([["b"]], dtype=object)})
+    out = model.transform(q)
+    # only label-b rows allowed: indices 1 and 3
+    assert out["matches"][0] == [1, 3]
+    assert out["labels"][0] == ["b", "b"]
+
+
+# -- isolation forest ---------------------------------------------------------
+
+def test_isolation_forest(tmp_path):
+    from mmlspark_tpu.isolationforest import (IsolationForest,
+                                              IsolationForestModel)
+    rng = np.random.default_rng(4)
+    inliers = rng.normal(size=(500, 4))
+    outliers = rng.normal(size=(10, 4)) * 8 + 12
+    X = np.vstack([inliers, outliers]).astype(np.float32)
+    t = DataTable({"features": X})
+    model = IsolationForest(numEstimators=50, maxSamples=128,
+                            contamination=0.03, seed=0).fit(t)
+    out = model.transform(t)
+    scores = np.asarray(out["outlierScore"])
+    # outliers score higher than the typical inlier
+    assert scores[500:].mean() > scores[:500].mean() + 0.1
+    # most flagged points are true outliers
+    flagged = np.flatnonzero(np.asarray(out["prediction"]) > 0)
+    assert len(flagged) > 0
+    assert (flagged >= 500).mean() > 0.5
+
+    p = str(tmp_path / "if")
+    model.save(p)
+    loaded = IsolationForestModel.load(p)
+    out2 = loaded.transform(t)
+    np.testing.assert_allclose(out2["outlierScore"], scores)
