@@ -1,0 +1,54 @@
+"""Azure Cognitive Services transformers (reference ``cognitive/``).
+
+Reference: src/main/scala/com/microsoft/ml/spark/cognitive/ (expected
+paths, UNVERIFIED — SURVEY.md §2.1): ~30 transformers wrapping Azure REST
+APIs, all built on CognitiveServiceBase → SimpleHTTPTransformer.  Same
+layering here; each service is a declarative subclass contributing a URL
+path and a payload builder.  ``setUrl`` accepts any endpoint, so these run
+against mocks/self-hosted gateways without Azure.
+"""
+
+from .base import CognitiveServiceBase
+from .text import (
+    EntityDetector,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    NER,
+    TextSentiment,
+)
+from .vision import (
+    AnalyzeImage,
+    DescribeImage,
+    GenerateThumbnails,
+    OCR,
+    RecognizeDomainSpecificContent,
+    RecognizeText,
+    TagImage,
+)
+from .face import (
+    DetectFace,
+    FindSimilarFace,
+    GroupFaces,
+    IdentifyFaces,
+    VerifyFaces,
+)
+from .anomaly import (
+    DetectAnomalies,
+    DetectLastAnomaly,
+    SimpleDetectAnomalies,
+)
+from .speech import SpeechToText
+from .search import AddDocuments, AzureSearchWriter, BingImageSearch
+
+__all__ = [
+    "CognitiveServiceBase",
+    "TextSentiment", "LanguageDetector", "EntityDetector", "NER",
+    "KeyPhraseExtractor",
+    "AnalyzeImage", "DescribeImage", "OCR", "RecognizeText", "TagImage",
+    "GenerateThumbnails", "RecognizeDomainSpecificContent",
+    "DetectFace", "FindSimilarFace", "GroupFaces", "IdentifyFaces",
+    "VerifyFaces",
+    "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
+    "SpeechToText",
+    "BingImageSearch", "AddDocuments", "AzureSearchWriter",
+]

@@ -1,0 +1,47 @@
+"""Anomaly Detector transformers.
+
+Reference: cognitive/AnomalyDetection.scala (expected path, UNVERIFIED —
+SURVEY.md §2.1).  Row values are {"series": [{"timestamp", "value"}, ...]}
+payloads (or bare lists of points, wrapped with the stage's granularity).
+"""
+
+from ..core.params import Param, TypeConverters
+from .base import CognitiveServiceBase
+
+
+class _AnomalyBase(CognitiveServiceBase):
+    __abstractstage__ = True
+
+    granularity = Param("granularity",
+                        "Series granularity (daily/hourly/minutely...)",
+                        default="daily",
+                        typeConverter=TypeConverters.toString)
+    maxAnomalyRatio = Param("maxAnomalyRatio", "Max anomaly fraction",
+                            default=0.25,
+                            typeConverter=TypeConverters.toFloat)
+    sensitivity = Param("sensitivity", "Detection sensitivity", default=95,
+                        typeConverter=TypeConverters.toInt)
+
+    def _wrap(self, value):
+        if isinstance(value, dict) and "series" in value:
+            return value
+        return {"series": list(value),
+                "granularity": self.getGranularity(),
+                "maxAnomalyRatio": self.getMaxAnomalyRatio(),
+                "sensitivity": self.getSensitivity()}
+
+
+class DetectLastAnomaly(_AnomalyBase):
+    """Is the latest point anomalous?"""
+    _path = "/anomalydetector/v1.0/timeseries/last/detect"
+
+
+class DetectAnomalies(_AnomalyBase):
+    """Batch detection over the entire series."""
+    _path = "/anomalydetector/v1.0/timeseries/entire/detect"
+
+
+class SimpleDetectAnomalies(_AnomalyBase):
+    """Entire-series detection with the simplified grouped API of the
+    reference (cognitive/AnomalyDetection.scala SimpleDetectAnomalies)."""
+    _path = "/anomalydetector/v1.0/timeseries/entire/detect"

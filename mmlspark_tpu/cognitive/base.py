@@ -1,0 +1,97 @@
+"""CognitiveServiceBase.
+
+Reference: cognitive/CognitiveServiceBase.scala (expected path, UNVERIFIED
+— SURVEY.md §2.1).  Adds subscription-key auth, region-based URL
+construction, and per-service payload building on top of
+SimpleHTTPTransformer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..core.params import Param, TypeConverters
+from ..io.http import HTTPRequestData, JSONInputParser, SimpleHTTPTransformer
+
+
+class CognitiveServiceBase(SimpleHTTPTransformer):
+    """Shared plumbing for all cognitive transformers."""
+
+    __abstractstage__ = True
+
+    #: service URL path, e.g. "/text/analytics/v3.0/sentiment"
+    _path = ""
+
+    subscriptionKey = Param("subscriptionKey", "API subscription key",
+                            default=None,
+                            typeConverter=TypeConverters.toString)
+    location = Param("location", "Azure region, e.g. eastus", default=None,
+                     typeConverter=TypeConverters.toString)
+    url = Param("url", "Full endpoint URL (overrides location)",
+                default=None, typeConverter=TypeConverters.toString)
+    outputCol = Param("outputCol", "Response column", default="response",
+                      typeConverter=TypeConverters.toString)
+
+    def getUrl(self) -> str:
+        url = self._peek("url")
+        if url:
+            return url
+        loc = self._peek("location")
+        if loc:
+            return (f"https://{loc}.api.cognitive.microsoft.com"
+                    f"{self._path}")
+        raise ValueError(
+            f"{type(self).__name__} needs setUrl(...) or setLocation(...)")
+
+    def setLinkedService(self, _service: str):  # Synapse-parity no-op shim
+        return self
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        key = self._peek("subscriptionKey")
+        if key:
+            headers["Ocp-Apim-Subscription-Key"] = key
+        return headers
+
+    # subclasses override to wrap row payloads into the service envelope
+    def _wrap(self, value: Any) -> Any:
+        return value
+
+    # subclasses override to surface request options as URL query params
+    def _query(self) -> Dict[str, str]:
+        return {}
+
+    def _full_url(self) -> str:
+        url = self.getUrl()
+        query = self._query()
+        if query:
+            import urllib.parse
+            sep = "&" if "?" in url else "?"
+            url = url + sep + urllib.parse.urlencode(query)
+        return url
+
+    def _prepare(self, payload: Any) -> HTTPRequestData:
+        parser = JSONInputParser(self._full_url(), self._headers(),
+                                 self.getMethod())
+        return parser(self._wrap(payload))
+
+
+class DocumentServiceBase(CognitiveServiceBase):
+    """Text-analytics envelope: value → {"documents": [{id, text, lang}]}.
+
+    A row value may be a plain string (one document) or a list of strings
+    (batched documents, ids assigned positionally) — mirroring the
+    reference's text-analytics batching.
+    """
+
+    __abstractstage__ = True
+
+    language = Param("language", "Default document language", default="en",
+                     typeConverter=TypeConverters.toString)
+
+    def _wrap(self, value: Any) -> Any:
+        texts = value if isinstance(value, (list, tuple)) else [value]
+        lang = self.getLanguage()
+        return {"documents": [
+            {"id": str(i), "language": lang, "text": str(t)}
+            for i, t in enumerate(texts)]}

@@ -1,0 +1,57 @@
+"""Face API transformers.
+
+Reference: cognitive/Face.scala (expected path, UNVERIFIED — SURVEY.md
+§2.1).
+"""
+
+from ..core.params import Param, TypeConverters
+from .base import CognitiveServiceBase
+
+
+class DetectFace(CognitiveServiceBase):
+    """Face detection; row value is an image URL or payload dict."""
+    _path = "/face/v1.0/detect"
+
+    returnFaceId = Param("returnFaceId", "Return face ids", default=True,
+                         typeConverter=TypeConverters.toBool)
+    returnFaceLandmarks = Param("returnFaceLandmarks",
+                                "Return landmarks", default=False,
+                                typeConverter=TypeConverters.toBool)
+    returnFaceAttributes = Param("returnFaceAttributes",
+                                 "Attribute list", default=[],
+                                 typeConverter=TypeConverters.toListString)
+
+    def _wrap(self, value):
+        if isinstance(value, dict):
+            return value
+        return {"url": str(value)}
+
+    def _query(self):
+        q = {"returnFaceId": str(self.getReturnFaceId()).lower(),
+             "returnFaceLandmarks":
+                 str(self.getReturnFaceLandmarks()).lower()}
+        attrs = self.getReturnFaceAttributes()
+        if attrs:
+            q["returnFaceAttributes"] = ",".join(attrs)
+        return q
+
+
+class FindSimilarFace(CognitiveServiceBase):
+    """Similar-face search; row value is the request payload
+    (faceId + faceIds/faceListId)."""
+    _path = "/face/v1.0/findsimilars"
+
+
+class GroupFaces(CognitiveServiceBase):
+    """Groups face ids by similarity; row value holds {"faceIds": [...]}."""
+    _path = "/face/v1.0/group"
+
+
+class IdentifyFaces(CognitiveServiceBase):
+    """Identifies faces against a person group; row value is the payload."""
+    _path = "/face/v1.0/identify"
+
+
+class VerifyFaces(CognitiveServiceBase):
+    """Verifies two faces belong to the same person; row value payload."""
+    _path = "/face/v1.0/verify"

@@ -1,0 +1,113 @@
+"""Bing image search + Azure Search sink.
+
+Reference: cognitive/BingImageSearch.scala, cognitive/AzureSearch.scala
+(expected paths, UNVERIFIED — SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Any
+
+from ..core.params import Param, TypeConverters
+from ..io.http import HTTPRequestData
+from .base import CognitiveServiceBase
+
+
+class BingImageSearch(CognitiveServiceBase):
+    """Image search: row value is the query string (GET with q= param)."""
+
+    count = Param("count", "Results per query", default=10,
+                  typeConverter=TypeConverters.toInt)
+    offset = Param("offset", "Result offset", default=0,
+                   typeConverter=TypeConverters.toInt)
+    imageType = Param("imageType", "Filter: Photo/Clipart/...", default=None,
+                      typeConverter=TypeConverters.toString)
+
+    def getUrl(self) -> str:
+        url = self._peek("url")
+        if url:
+            return url
+        return "https://api.bing.microsoft.com/v7.0/images/search"
+
+    def _prepare(self, payload: Any) -> HTTPRequestData:
+        q = urllib.parse.quote(str(payload))
+        url = (f"{self.getUrl()}?q={q}&count={self.getCount()}"
+               f"&offset={self.getOffset()}")
+        img_type = self._peek("imageType")
+        if img_type:
+            url += f"&imageType={img_type}"
+        headers = {}
+        key = self._peek("subscriptionKey")
+        if key:
+            headers["Ocp-Apim-Subscription-Key"] = key
+        return HTTPRequestData(url, "GET", headers, None)
+
+    @staticmethod
+    def downloadFromUrls(table, urlCol: str, bytesCol: str = "bytes",
+                         concurrency: int = 8, timeout: float = 30.0):
+        """Fetch image bytes for a URL column (reference helper of the same
+        name)."""
+        from ..io.http import HTTPTransformer
+        import numpy as np
+        t = HTTPTransformer(inputCol=urlCol, outputCol="_resp",
+                            concurrency=concurrency,
+                            timeout=timeout).transform(table)
+        resp = t["_resp"]
+        blobs = np.empty(len(resp), dtype=object)
+        for i, r in enumerate(resp):
+            blobs[i] = r.body if r.statusCode == 200 else None
+        return t.drop("_resp").withColumn(bytesCol, blobs)
+
+
+class AddDocuments(CognitiveServiceBase):
+    """Azure Search document upload; row value is a document dict."""
+
+    serviceName = Param("serviceName", "Search service name", default=None,
+                        typeConverter=TypeConverters.toString)
+    indexName = Param("indexName", "Target index", default=None,
+                      typeConverter=TypeConverters.toString)
+    actionCol = Param("actionCol", "Search action", default="@search.action",
+                      typeConverter=TypeConverters.toString)
+
+    def getUrl(self) -> str:
+        url = self._peek("url")
+        if url:
+            return url
+        svc, idx = self._peek("serviceName"), self._peek("indexName")
+        if svc and idx:
+            return (f"https://{svc}.search.windows.net/indexes/{idx}"
+                    f"/docs/index?api-version=2020-06-30")
+        raise ValueError("AddDocuments needs setUrl or serviceName+indexName")
+
+    def _headers(self):
+        headers = {"Content-Type": "application/json"}
+        key = self._peek("subscriptionKey")
+        if key:
+            headers["api-key"] = key  # Azure Search uses api-key
+        return headers
+
+    def _wrap(self, value: Any) -> Any:
+        doc = dict(value)
+        doc.setdefault(self.getActionCol(), "upload")
+        return {"value": [doc]}
+
+
+class AzureSearchWriter:
+    """Bulk write a table into an Azure Search index via AddDocuments."""
+
+    @staticmethod
+    def write(table, url: str = None, subscriptionKey: str = None,
+              serviceName: str = None, indexName: str = None,
+              docCol: str = "doc", errorCol: str = "error"):
+        stage = AddDocuments(inputCol=docCol, outputCol="_indexed",
+                             errorCol=errorCol)
+        if url:
+            stage.setUrl(url)
+        if subscriptionKey:
+            stage.setSubscriptionKey(subscriptionKey)
+        if serviceName:
+            stage.setServiceName(serviceName)
+        if indexName:
+            stage.setIndexName(indexName)
+        return stage.transform(table)

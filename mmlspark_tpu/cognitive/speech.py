@@ -1,0 +1,39 @@
+"""Speech-to-text transformer.
+
+Reference: cognitive/SpeechToText.scala (expected path, UNVERIFIED —
+SURVEY.md §2.1).  Row values are raw audio bytes; the request body is the
+audio payload with a WAV content type rather than JSON.
+"""
+
+from ..core.params import Param, TypeConverters
+from ..io.http import HTTPRequestData
+from .base import CognitiveServiceBase
+
+
+class SpeechToText(CognitiveServiceBase):
+    _path = "/speech/recognition/conversation/cognitiveservices/v1"
+
+    audioFormat = Param("audioFormat", "Content type of the audio",
+                        default="audio/wav; codecs=audio/pcm; samplerate=16000",
+                        typeConverter=TypeConverters.toString)
+    speechLanguage = Param("speechLanguage", "Recognition language",
+                           default="en-US",
+                           typeConverter=TypeConverters.toString)
+
+    def getUrl(self) -> str:
+        url = self._peek("url")
+        if url:
+            return url
+        loc = self._peek("location")
+        if loc:
+            return (f"https://{loc}.stt.speech.microsoft.com{self._path}"
+                    f"?language={self.getSpeechLanguage()}")
+        raise ValueError("SpeechToText needs setUrl(...) or setLocation(...)")
+
+    def _prepare(self, payload) -> HTTPRequestData:
+        body = bytes(payload) if not isinstance(payload, bytes) else payload
+        headers = {"Content-Type": self.getAudioFormat()}
+        key = self._peek("subscriptionKey")
+        if key:
+            headers["Ocp-Apim-Subscription-Key"] = key
+        return HTTPRequestData(self.getUrl(), "POST", headers, body)

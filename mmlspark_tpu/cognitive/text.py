@@ -1,0 +1,37 @@
+"""Text Analytics transformers.
+
+Reference: cognitive/TextAnalytics.scala (expected path, UNVERIFIED —
+SURVEY.md §2.1).
+"""
+
+from .base import DocumentServiceBase
+
+
+class TextSentiment(DocumentServiceBase):
+    """Sentiment scoring per document."""
+    _path = "/text/analytics/v3.0/sentiment"
+
+
+class LanguageDetector(DocumentServiceBase):
+    """Language identification per document."""
+    _path = "/text/analytics/v3.0/languages"
+
+    def _wrap(self, value):
+        texts = value if isinstance(value, (list, tuple)) else [value]
+        return {"documents": [{"id": str(i), "text": str(t)}
+                              for i, t in enumerate(texts)]}
+
+
+class EntityDetector(DocumentServiceBase):
+    """Linked-entity recognition."""
+    _path = "/text/analytics/v3.0/entities/linking"
+
+
+class NER(DocumentServiceBase):
+    """Named-entity recognition (general)."""
+    _path = "/text/analytics/v3.0/entities/recognition/general"
+
+
+class KeyPhraseExtractor(DocumentServiceBase):
+    """Key-phrase extraction."""
+    _path = "/text/analytics/v3.0/keyPhrases"

@@ -1,0 +1,99 @@
+"""Computer Vision transformers.
+
+Reference: cognitive/ComputerVision.scala (expected path, UNVERIFIED —
+SURVEY.md §2.1).  Row values are image URLs (wrapped as {"url": ...}) or
+ready payload dicts.
+"""
+
+from ..core.params import Param, TypeConverters
+from .base import CognitiveServiceBase
+
+
+class _ImageServiceBase(CognitiveServiceBase):
+    __abstractstage__ = True
+
+    def _wrap(self, value):
+        if isinstance(value, dict):
+            return value
+        return {"url": str(value)}
+
+
+class AnalyzeImage(_ImageServiceBase):
+    """Full image analysis (categories/tags/description/faces/color)."""
+    _path = "/vision/v3.2/analyze"
+
+    visualFeatures = Param("visualFeatures",
+                           "Comma-joined feature list",
+                           default=["Categories"],
+                           typeConverter=TypeConverters.toListString)
+
+    def _query(self):
+        return {"visualFeatures": ",".join(self.getVisualFeatures())}
+
+
+class DescribeImage(_ImageServiceBase):
+    """Natural-language image captions."""
+    _path = "/vision/v3.2/describe"
+
+    maxCandidates = Param("maxCandidates", "Caption candidates", default=1,
+                          typeConverter=TypeConverters.toInt)
+
+    def _query(self):
+        return {"maxCandidates": str(self.getMaxCandidates())}
+
+
+class OCR(_ImageServiceBase):
+    """Printed-text OCR."""
+    _path = "/vision/v3.2/ocr"
+
+    detectOrientation = Param("detectOrientation",
+                              "Detect text orientation", default=True,
+                              typeConverter=TypeConverters.toBool)
+
+    def _query(self):
+        return {"detectOrientation":
+                str(self.getDetectOrientation()).lower()}
+
+
+class RecognizeText(_ImageServiceBase):
+    """Async text recognition (Read API submit call)."""
+    _path = "/vision/v3.2/read/analyze"
+
+    mode = Param("mode", "Printed or Handwritten", default="Printed",
+                 typeConverter=TypeConverters.toString)
+
+    def _query(self):
+        return {"mode": self.getMode()}
+
+
+class TagImage(_ImageServiceBase):
+    """Content tags with confidence."""
+    _path = "/vision/v3.2/tag"
+
+
+class GenerateThumbnails(_ImageServiceBase):
+    """Smart-cropped thumbnails."""
+    _path = "/vision/v3.2/generateThumbnail"
+
+    width = Param("width", "Thumbnail width", default=64,
+                  typeConverter=TypeConverters.toInt)
+    height = Param("height", "Thumbnail height", default=64,
+                   typeConverter=TypeConverters.toInt)
+    smartCropping = Param("smartCropping", "Smart cropping", default=True,
+                          typeConverter=TypeConverters.toBool)
+
+    def _query(self):
+        return {"width": str(self.getWidth()),
+                "height": str(self.getHeight()),
+                "smartCropping": str(self.getSmartCropping()).lower()}
+
+
+class RecognizeDomainSpecificContent(_ImageServiceBase):
+    """Domain-model analysis (celebrities/landmarks)."""
+
+    model = Param("model", "Domain model name", default="celebrities",
+                  typeConverter=TypeConverters.toString)
+
+    @property
+    def _path(self):  # path depends on the model param
+        return f"/vision/v3.2/models/{self._peek('model', 'celebrities')}/analyze"

@@ -1,0 +1,24 @@
+"""Data plane / IO (reference ``io/`` package).
+
+Reference: src/main/scala/com/microsoft/ml/spark/io/ (expected paths,
+UNVERIFIED — SURVEY.md §2.1, §3.4): HTTP-on-Spark, Spark Serving, binary
+file datasource, PowerBI writer.
+"""
+
+from .http import (
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    SimpleHTTPTransformer,
+)
+from .serving import HTTPServer, request_table, reply_from_table
+from .binary import BinaryFileReader, read_binary_files
+from .powerbi import PowerBIWriter
+
+__all__ = [
+    "HTTPTransformer", "SimpleHTTPTransformer",
+    "JSONInputParser", "JSONOutputParser",
+    "HTTPServer", "request_table", "reply_from_table",
+    "BinaryFileReader", "read_binary_files",
+    "PowerBIWriter",
+]

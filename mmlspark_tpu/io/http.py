@@ -1,0 +1,219 @@
+"""HTTP-on-Spark equivalent: a column of requests → pooled async execution
+→ a column of responses.
+
+Reference: io/http/HTTPTransformer.scala, SimpleHTTPTransformer.scala,
+Clients.scala, Parsers.scala, HandlingUtils.scala (expected paths,
+UNVERIFIED — SURVEY.md §2.1).  The reference runs an async HTTP client pool
+per partition; here a thread pool per transform call does the same work on
+the host (this layer is pure data plane — nothing to accelerate).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.params import HasInputCol, HasOutputCol, Param, TypeConverters
+from ..core.pipeline import Transformer
+from ..core.schema import DataTable
+
+
+class HTTPRequestData:
+    """Row payload for HTTPTransformer — mirrors the reference's
+    HTTPRequestData struct."""
+
+    __slots__ = ("url", "method", "headers", "body")
+
+    def __init__(self, url: str, method: str = "GET",
+                 headers: Optional[Dict[str, str]] = None,
+                 body: Optional[bytes] = None):
+        self.url = url
+        self.method = method
+        self.headers = dict(headers or {})
+        self.body = body
+
+    @classmethod
+    def coerce(cls, v: Any) -> "HTTPRequestData":
+        if isinstance(v, HTTPRequestData):
+            return v
+        if isinstance(v, str):
+            return cls(v)
+        if isinstance(v, dict):
+            body = v.get("body")
+            if isinstance(body, str):
+                body = body.encode("utf-8")
+            return cls(v["url"], v.get("method", "GET"),
+                       v.get("headers"), body)
+        raise TypeError(f"Cannot coerce {type(v).__name__} to request")
+
+
+class HTTPResponseData:
+    """Response struct: status, reason, headers, body bytes."""
+
+    __slots__ = ("statusCode", "reason", "headers", "body", "error")
+
+    def __init__(self, statusCode: int, reason: str = "",
+                 headers: Optional[Dict[str, str]] = None,
+                 body: bytes = b"", error: Optional[str] = None):
+        self.statusCode = statusCode
+        self.reason = reason
+        self.headers = dict(headers or {})
+        self.body = body
+        self.error = error
+
+    def json(self) -> Any:
+        return json.loads(self.body.decode("utf-8"))
+
+    def __repr__(self) -> str:
+        return (f"HTTPResponseData({self.statusCode}, "
+                f"{len(self.body)} bytes)")
+
+
+def _execute(req: HTTPRequestData, timeout: float, max_retries: int,
+             backoff: float) -> HTTPResponseData:
+    last_err = None
+    for attempt in range(max_retries + 1):
+        try:
+            r = urllib.request.Request(
+                req.url, data=req.body, headers=req.headers,
+                method=req.method)
+            with urllib.request.urlopen(r, timeout=timeout) as resp:
+                return HTTPResponseData(
+                    resp.status, getattr(resp, "reason", ""),
+                    dict(resp.headers), resp.read())
+        except urllib.error.HTTPError as e:
+            # HTTP error statuses are responses, not transport failures
+            return HTTPResponseData(e.code, str(e.reason),
+                                    dict(e.headers or {}),
+                                    e.read() if e.fp else b"")
+        except Exception as e:  # transport error: retry with backoff
+            last_err = e
+            if attempt < max_retries:
+                time.sleep(backoff * (2 ** attempt))
+    return HTTPResponseData(0, "", {}, b"", error=str(last_err))
+
+
+class HTTPTransformer(HasInputCol, HasOutputCol, Transformer):
+    """Executes a column of HTTP requests through a bounded worker pool
+    (io/http/HTTPTransformer.scala)."""
+
+    concurrency = Param("concurrency", "Concurrent requests", default=8,
+                        typeConverter=TypeConverters.toInt)
+    timeout = Param("timeout", "Per-request timeout seconds", default=60.0,
+                    typeConverter=TypeConverters.toFloat)
+    maxRetries = Param("maxRetries", "Transport-failure retries", default=3,
+                       typeConverter=TypeConverters.toInt)
+    backoffTime = Param("backoffTime", "Initial retry backoff seconds",
+                        default=0.1, typeConverter=TypeConverters.toFloat)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        reqs = [HTTPRequestData.coerce(v)
+                for v in table[self.getInputCol()]]
+        timeout = self.getTimeout()
+        retries = self.getMaxRetries()
+        backoff = self.getBackoffTime()
+        with ThreadPoolExecutor(max_workers=self.getConcurrency()) as pool:
+            responses = list(pool.map(
+                lambda r: _execute(r, timeout, retries, backoff), reqs))
+        out = np.empty(len(responses), dtype=object)
+        out[:] = responses
+        return table.withColumn(self.getOutputCol(), out)
+
+
+class JSONInputParser:
+    """Builds POST requests from JSON-serializable row payloads
+    (io/http/Parsers.scala)."""
+
+    def __init__(self, url: str, headers: Optional[Dict[str, str]] = None,
+                 method: str = "POST"):
+        self.url = url
+        self.headers = {"Content-Type": "application/json",
+                        **(headers or {})}
+        self.method = method
+
+    def __call__(self, payload: Any) -> HTTPRequestData:
+        return HTTPRequestData(
+            self.url, self.method, self.headers,
+            json.dumps(payload, default=_np_default).encode("utf-8"))
+
+
+def _np_default(o):
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"Not JSON-serializable: {type(o).__name__}")
+
+
+class JSONOutputParser:
+    """Parses response bodies as JSON, optionally drilling into a path."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+
+    def __call__(self, resp: HTTPResponseData) -> Any:
+        if resp.error or resp.statusCode >= 400 or resp.statusCode == 0:
+            return None
+        obj = resp.json()
+        if self.path:
+            for part in self.path.split("."):
+                obj = obj[int(part)] if part.isdigit() else obj[part]
+        return obj
+
+
+class SimpleHTTPTransformer(HasInputCol, HasOutputCol, Transformer):
+    """JSON-in/JSON-out HTTP with error handling in one stage
+    (io/http/SimpleHTTPTransformer.scala)."""
+
+    url = Param("url", "Target URL", typeConverter=TypeConverters.toString)
+    method = Param("method", "HTTP method", default="POST",
+                   typeConverter=TypeConverters.toString)
+    errorCol = Param("errorCol", "Column collecting failures",
+                     default="error", typeConverter=TypeConverters.toString)
+    concurrency = HTTPTransformer.concurrency
+    timeout = HTTPTransformer.timeout
+    maxRetries = HTTPTransformer.maxRetries
+    backoffTime = HTTPTransformer.backoffTime
+    flattenOutput = Param("flattenOutput",
+                          "JSON path to extract from responses (optional)",
+                          default=None, typeConverter=TypeConverters.toString)
+
+    def _headers(self) -> Dict[str, str]:
+        return {"Content-Type": "application/json"}
+
+    def _prepare(self, payload: Any) -> HTTPRequestData:
+        parser = JSONInputParser(self.getUrl(), self._headers(),
+                                 self.getMethod())
+        return parser(payload)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        payloads = table[self.getInputCol()]
+        reqs = [self._prepare(v) for v in payloads]
+        timeout, retries = self.getTimeout(), self.getMaxRetries()
+        backoff = self.getBackoffTime()
+        with ThreadPoolExecutor(max_workers=self.getConcurrency()) as pool:
+            responses = list(pool.map(
+                lambda r: _execute(r, timeout, retries, backoff), reqs))
+        parse = JSONOutputParser(self.getFlattenOutput())
+        parsed = np.empty(len(responses), dtype=object)
+        errors = np.empty(len(responses), dtype=object)
+        for i, resp in enumerate(responses):
+            try:
+                parsed[i] = parse(resp)
+            except (ValueError, KeyError, IndexError) as e:
+                parsed[i] = None
+                errors[i] = f"parse error: {e}"
+                continue
+            errors[i] = (resp.error if resp.error
+                         else (f"HTTP {resp.statusCode}"
+                               if resp.statusCode >= 400 else None))
+        return table.withColumns({self.getOutputCol(): parsed,
+                                  self.getErrorCol(): errors})

@@ -1,0 +1,191 @@
+"""Serving: turn a pipeline into a web service (Spark Serving equivalent).
+
+Reference: io/http/HTTPSourceV2.scala, DistributedHTTPSource.scala,
+ServingImplicits.scala (expected paths, UNVERIFIED — SURVEY.md §2.1, §3.4).
+The reference parks each HTTP request's open socket keyed by request-id,
+emits (id, request) rows into a streaming micro-batch, runs the user's
+pipeline, and routes replies back via HTTPSink.
+
+This build keeps that exact architecture, minus Spark streaming: an
+:class:`HTTPServer` accepts requests into a queue; the driver loop pulls
+micro-batches with :func:`HTTPServer.get_batch`, converts them to a table
+(:func:`request_table`), runs any pipeline/model, and answers with
+:func:`reply_from_table` — replies route to the still-open sockets by id.
+``serve_forever`` wires the loop up for the one-liner case.  Batching is
+the TPU-relevant part: requests accumulate into one fixed-size device batch
+instead of per-request forwards.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.schema import DataTable
+
+
+class _Pending:
+    __slots__ = ("event", "response", "status")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.response: Any = None
+        self.status = 200
+
+
+class HTTPServer:
+    """Accepts JSON POSTs, parks the socket, exposes micro-batches.
+
+    Analog of ``DistributedHTTPSource`` for one process; a mesh deployment
+    runs one server per host exactly like the reference runs one per
+    executor (SURVEY.md §3.4).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout: float = 30.0):
+        self._queue: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
+        self._pending: Dict[str, _Pending] = {}
+        self._lock = threading.Lock()
+        self._reply_timeout = reply_timeout
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                if api_path not in ("/", self.path):
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(
+                        self.rfile.read(length).decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    self.send_error(400, "invalid JSON")
+                    return
+                rid = uuid.uuid4().hex
+                pending = _Pending()
+                with outer._lock:
+                    outer._pending[rid] = pending
+                outer._queue.put((rid, payload))
+                ok = pending.event.wait(outer._reply_timeout)
+                with outer._lock:
+                    outer._pending.pop(rid, None)
+                if not ok:
+                    self.send_error(504, "pipeline timeout")
+                    return
+                body = json.dumps(pending.response).encode("utf-8")
+                self.send_response(pending.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "HTTPServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def get_batch(self, max_rows: int = 64, timeout: float = 0.05
+                  ) -> List[Tuple[str, Any]]:
+        """Pull up to ``max_rows`` parked requests (micro-batch trigger)."""
+        batch: List[Tuple[str, Any]] = []
+        try:
+            batch.append(self._queue.get(timeout=timeout))
+            while len(batch) < max_rows:
+                batch.append(self._queue.get_nowait())
+        except queue.Empty:
+            pass
+        return batch
+
+    def reply(self, request_id: str, response: Any,
+              status: int = 200) -> bool:
+        """HTTPSink: route a reply to the parked socket by request-id."""
+        with self._lock:
+            pending = self._pending.get(request_id)
+        if pending is None:
+            return False  # socket gone (timeout/disconnect)
+        pending.response = response
+        pending.status = status
+        pending.event.set()
+        return True
+
+
+def request_table(batch: List[Tuple[str, Any]]) -> DataTable:
+    """(id, payload) micro-batch → table with ``id`` + payload columns.
+
+    Dict payloads with shared keys become real columns (vector columns for
+    list values); anything else lands in a ``value`` object column.
+    """
+    ids = np.asarray([rid for rid, _ in batch], dtype=object)
+    payloads = [p for _, p in batch]
+    cols: Dict[str, Any] = {"id": ids}
+    if payloads and all(isinstance(p, dict) for p in payloads):
+        keys = set(payloads[0])
+        for p in payloads[1:]:
+            keys &= set(p)
+        for k in sorted(keys):
+            vals = [p[k] for p in payloads]
+            if all(isinstance(v, (list, tuple)) for v in vals):
+                try:
+                    cols[k] = np.asarray(vals, dtype=np.float64)
+                    continue
+                except (ValueError, TypeError):
+                    pass
+            arr = np.empty(len(vals), dtype=object)
+            arr[:] = vals
+            cols[k] = arr
+    else:
+        arr = np.empty(len(payloads), dtype=object)
+        arr[:] = payloads
+        cols["value"] = arr
+    return DataTable(cols)
+
+
+def reply_from_table(server: HTTPServer, table: DataTable,
+                     reply_col: str, id_col: str = "id") -> int:
+    """Route one reply per row back through the server; returns #delivered."""
+    delivered = 0
+    ids = table[id_col]
+    vals = table[reply_col]
+    for rid, v in zip(ids, vals):
+        if isinstance(v, np.ndarray):
+            v = v.tolist()
+        elif isinstance(v, np.generic):
+            v = v.item()
+        if server.reply(str(rid), v):
+            delivered += 1
+    return delivered
+
+
+def serve_forever(server: HTTPServer,
+                  transform: Callable[[DataTable], DataTable],
+                  reply_col: str, max_rows: int = 64,
+                  stop_event: Optional[threading.Event] = None) -> None:
+    """Micro-batch loop: accumulate → transform → route replies."""
+    while stop_event is None or not stop_event.is_set():
+        batch = server.get_batch(max_rows=max_rows)
+        if not batch:
+            continue
+        table = request_table(batch)
+        out = transform(table)
+        reply_from_table(server, out, reply_col)

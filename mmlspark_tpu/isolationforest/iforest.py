@@ -29,6 +29,9 @@ def _avg_path_len(n) -> float:
     n = float(n)
     if n <= 1.0:
         return 0.0
+    if n == 2.0:
+        # exact value; the harmonic approximation below gives ~0.154
+        return 1.0
     return 2.0 * (np.log(n - 1.0) + _EULER) - 2.0 * (n - 1.0) / n
 
 

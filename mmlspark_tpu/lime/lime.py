@@ -175,26 +175,33 @@ class ImageLIME(_LIMEParams, HasInputCol, HasOutputCol, Transformer):
         keep_p = self.getSamplingFraction()
         rng = np.random.default_rng(self.getSeed())
 
-        weights_out = np.empty(N, dtype=object)
+        # SLIC's label space is the static grid², independent of image
+        # content — one shape for every image means one XLA compile and one
+        # batched surrogate solve for the whole table
+        K = int(np.ceil(np.sqrt(n_segments))) ** 2
+        all_masks = np.empty((N, S, K), dtype=np.float32)
+        all_ys = np.empty((N, S), dtype=np.float32)
+        all_ws = np.empty((N, S), dtype=np.float32)
         labels_out = np.empty(N, dtype=object)
         for i in range(N):
             labels = Superpixel.cluster(imgs[i], n_segments=n_segments,
                                         compactness=self.getModifier() / 13.0)
-            K = int(labels.max()) + 1
             masks = (rng.random(size=(S, K)) < keep_p)   # (S, K) bool
             masks[0] = True                              # all-on reference
             pixel_masks = masks[:, labels]               # (S, H, W)
             masked = imgs[i][None] * pixel_masks[..., None]
-            ys = self._predict(masked)                   # (S,)
+            all_ys[i] = self._predict(masked)            # (S,)
             d = 1.0 - masks.mean(axis=1)                 # fraction off
-            ws = np.exp(-(d ** 2) / (self.getKernelWidth() ** 2))
-            coef = np.asarray(_weighted_lstsq(
-                jnp.asarray(masks[None].astype(np.float32)),
-                jnp.asarray(ys[None], jnp.float32),
-                jnp.asarray(ws[None], jnp.float32),
-                jnp.asarray(self.getRegularization(), jnp.float32)))[0]
-            weights_out[i] = coef.astype(np.float64)
+            all_ws[i] = np.exp(-(d ** 2) / (self.getKernelWidth() ** 2))
+            all_masks[i] = masks
             labels_out[i] = labels
+        coefs = np.asarray(_weighted_lstsq(
+            jnp.asarray(all_masks), jnp.asarray(all_ys),
+            jnp.asarray(all_ws),
+            jnp.asarray(self.getRegularization(), jnp.float32)))
+        weights_out = np.empty(N, dtype=object)
+        for i in range(N):
+            weights_out[i] = coefs[i].astype(np.float64)
         return table.withColumns({
             self.getOutputCol(): weights_out,
             self.getSuperpixelCol(): labels_out,

@@ -16,10 +16,6 @@ from ..core.schema import DataTable
 from ..core import serialize
 
 
-class _IndexerParams:
-    pass
-
-
 class RecommendationIndexer(Estimator):
     userInputCol = Param("userInputCol", "Raw user column",
                          typeConverter=TypeConverters.toString)

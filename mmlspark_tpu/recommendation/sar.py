@@ -141,13 +141,16 @@ class SARModel(_SARParams, Model):
         """Scores each (user, item) row: affinity·similarity[:, item]."""
         users = np.asarray(table[self.getUserCol()], dtype=np.int64)
         items = np.asarray(table[self.getItemCol()], dtype=np.int64)
-        scores = np.asarray(_score(jnp.asarray(self._aff),
-                                   jnp.asarray(self._sim)))
-        n_users, n_items = scores.shape
+        n_users, n_items = self._aff.shape[0], self._sim.shape[0]
         known = ((users >= 0) & (users < n_users)
                  & (items >= 0) & (items < n_items))
         pred = np.zeros(len(users))  # cold-start ids score 0, never wrap
-        pred[known] = scores[users[known], items[known]]
+        # score only the users present in the batch, not the full matrix
+        uniq, inverse = np.unique(users[known], return_inverse=True)
+        if len(uniq):
+            sub_scores = np.asarray(_score(
+                jnp.asarray(self._aff[uniq]), jnp.asarray(self._sim)))
+            pred[known] = sub_scores[inverse, items[known]]
         return table.withColumn("prediction", pred.astype(np.float64))
 
     def recommendForAllUsers(self, numItems: int) -> DataTable:
@@ -165,8 +168,28 @@ class SARModel(_SARParams, Model):
     def recommendForUserSubset(self, users: np.ndarray,
                                numItems: int) -> DataTable:
         users = np.asarray(users, dtype=np.int64)
-        all_recs = self.recommendForAllUsers(numItems)
-        return all_recs.take(users)
+        n_users, n_items = self._aff.shape
+        valid = (users >= 0) & (users < n_users)
+        k = min(numItems, n_items)
+        # score only the requested users; unknown/cold-start ids get empty
+        # recommendations instead of wrapping to another user's row
+        items_out = np.full((len(users), k), -1, dtype=np.int64)
+        ratings_out = np.zeros((len(users), k))
+        if valid.any():
+            scores = _score(jnp.asarray(self._aff[users[valid]]),
+                            jnp.asarray(self._sim))
+            if not self.getAllowSeedItemsInRecommendations():
+                scores = jnp.where(
+                    jnp.asarray(self._seen[users[valid]]) > 0,
+                    -jnp.inf, scores)
+            top_scores, top_items = jax.lax.top_k(scores, k)
+            items_out[valid] = np.asarray(top_items)
+            ratings_out[valid] = np.asarray(top_scores)
+        return DataTable({
+            self.getUserCol(): users,
+            "recommendations": items_out,
+            "ratings": ratings_out,
+        })
 
     def _save_extra(self, path: str) -> None:
         serialize.save_arrays(path, similarity=self._sim,

@@ -1,0 +1,255 @@
+"""Tests for io/ (HTTP, serving, binary, PowerBI) and cognitive/ packages.
+
+All HTTP tests run against in-process local servers — hermetic, mirroring
+the reference's serving/HTTP tests (SURVEY.md §4).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import DataTable
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    """POST /echo returns {"echo": <payload>}; /fail returns 500;
+    GET /q echoes the query string."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n)) if n else None
+            if self.path.startswith("/fail"):
+                self._send(500, {"error": "boom"})
+            elif self.path.startswith("/sentiment"):
+                docs = payload["documents"]
+                self._send(200, {"documents": [
+                    {"id": d["id"], "sentiment": "positive"
+                     if "good" in d["text"] else "negative"}
+                    for d in docs], "key": self.headers.get(
+                        "Ocp-Apim-Subscription-Key")})
+            else:
+                self._send(200, {"echo": payload,
+                                 "headers": dict(self.headers)})
+
+        def do_GET(self):
+            self._send(200, {"path": self.path})
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    server.server_close()
+
+
+def test_http_transformer(echo_server):
+    from mmlspark_tpu.io import HTTPTransformer
+    reqs = np.empty(3, dtype=object)
+    reqs[0] = {"url": f"{echo_server}/a", "method": "POST",
+               "headers": {"Content-Type": "application/json"},
+               "body": json.dumps({"x": 1})}
+    reqs[1] = f"{echo_server}/q?y=2"           # bare URL => GET
+    reqs[2] = {"url": f"{echo_server}/fail", "method": "POST"}
+    t = DataTable({"request": reqs})
+    out = HTTPTransformer(inputCol="request",
+                          outputCol="response").transform(t)
+    r0, r1, r2 = out["response"]
+    assert r0.statusCode == 200 and r0.json()["echo"] == {"x": 1}
+    assert r1.statusCode == 200 and r1.json()["path"] == "/q?y=2"
+    assert r2.statusCode == 500
+
+
+def test_simple_http_transformer(echo_server):
+    from mmlspark_tpu.io import SimpleHTTPTransformer
+    payloads = np.empty(2, dtype=object)
+    payloads[0] = {"text": "hello"}
+    payloads[1] = {"text": "world"}
+    t = DataTable({"payload": payloads})
+    out = SimpleHTTPTransformer(
+        inputCol="payload", outputCol="parsed",
+        url=f"{echo_server}/echo").transform(t)
+    assert out["parsed"][0]["echo"] == {"text": "hello"}
+    assert out["error"][0] is None
+
+    out = SimpleHTTPTransformer(
+        inputCol="payload", outputCol="parsed",
+        url=f"{echo_server}/fail", maxRetries=0).transform(t)
+    assert out["parsed"][0] is None
+    assert "500" in out["error"][0]
+
+
+def test_serving_round_trip():
+    from mmlspark_tpu.io import HTTPServer, request_table, reply_from_table
+    server = HTTPServer().start()
+    try:
+        results = {}
+
+        def client(i):
+            req = urllib.request.Request(
+                server.address, json.dumps({"features": [float(i)] * 3}
+                                           ).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                results[i] = json.loads(resp.read())
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for th in threads:
+            th.start()
+        # micro-batch loop: one pull should see several parked requests
+        t0 = time.time()
+        handled = 0
+        while handled < 4 and time.time() - t0 < 10:
+            batch = server.get_batch(max_rows=8, timeout=0.2)
+            if not batch:
+                continue
+            table = request_table(batch)
+            assert "features" in table.columns  # dict keys became columns
+            preds = np.asarray(table["features"]).sum(axis=1)
+            out = table.withColumn("pred", preds)
+            handled += reply_from_table(server, out, "pred")
+        for th in threads:
+            th.join(timeout=10)
+        assert len(results) == 4
+        assert results[2] == pytest.approx(6.0)
+    finally:
+        server.stop()
+
+
+def test_binary_file_reader(tmp_path):
+    from mmlspark_tpu.io import BinaryFileReader, read_binary_files
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "a.bin").write_bytes(b"alpha")
+    (tmp_path / "b.txt").write_bytes(b"beta!")
+    (tmp_path / "sub" / "c.bin").write_bytes(b"gamma")
+    t = read_binary_files(str(tmp_path), recursive=True)
+    assert len(t) == 3
+    assert t["length"].tolist() == [5, 5, 5]
+    t = read_binary_files(str(tmp_path), pattern="*.bin", recursive=True)
+    assert len(t) == 2
+    assert t["bytes"][0] == b"alpha"
+
+    batches = list(BinaryFileReader(str(tmp_path), batch_size=2))
+    assert [len(b) for b in batches] == [2, 1]
+
+
+def test_powerbi_writer(echo_server):
+    from mmlspark_tpu.io import PowerBIWriter
+    t = DataTable({"x": np.arange(5.0), "name": np.array(
+        list("abcde"), dtype=object)})
+    writer = PowerBIWriter(f"{echo_server}/rows", batch_size=2)
+    assert writer.write(t) == 3  # 2+2+1 rows
+    bad = PowerBIWriter(f"{echo_server}/fail", batch_size=10, max_retries=0)
+    with pytest.raises(IOError):
+        bad.write(t)
+
+
+# -- cognitive ----------------------------------------------------------------
+
+def test_text_sentiment_mock(echo_server):
+    from mmlspark_tpu.cognitive import TextSentiment
+    texts = np.empty(2, dtype=object)
+    texts[0] = "good stuff"
+    texts[1] = "awful stuff"
+    t = DataTable({"text": texts})
+    stage = TextSentiment(inputCol="text", outputCol="sentiment",
+                          subscriptionKey="k123",
+                          url=f"{echo_server}/sentiment")
+    out = stage.transform(t)
+    docs0 = out["sentiment"][0]["documents"]
+    assert docs0[0]["sentiment"] == "positive"
+    assert out["sentiment"][1]["documents"][0]["sentiment"] == "negative"
+    # subscription key header reached the service
+    assert out["sentiment"][0]["key"] == "k123"
+
+
+def test_document_batching(echo_server):
+    from mmlspark_tpu.cognitive import KeyPhraseExtractor
+    batch = np.empty(1, dtype=object)
+    batch[0] = ["doc one", "doc two"]
+    t = DataTable({"text": batch})
+    out = KeyPhraseExtractor(inputCol="text", outputCol="r",
+                             url=f"{echo_server}/echo").transform(t)
+    echoed = out["r"][0]["echo"]
+    assert [d["id"] for d in echoed["documents"]] == ["0", "1"]
+
+
+def test_vision_and_anomaly_payloads(echo_server):
+    from mmlspark_tpu.cognitive import DescribeImage, DetectAnomalies
+    urls = np.empty(1, dtype=object)
+    urls[0] = "http://images/x.png"
+    t = DataTable({"image": urls})
+    out = DescribeImage(inputCol="image", outputCol="r",
+                        url=f"{echo_server}/echo").transform(t)
+    assert out["r"][0]["echo"] == {"url": "http://images/x.png"}
+
+    series = np.empty(1, dtype=object)
+    series[0] = [{"timestamp": "2026-01-01T00:00:00Z", "value": 1.0}]
+    t = DataTable({"series": series})
+    out = DetectAnomalies(inputCol="series", outputCol="r",
+                          url=f"{echo_server}/echo").transform(t)
+    echoed = out["r"][0]["echo"]
+    assert echoed["granularity"] == "daily"
+    assert len(echoed["series"]) == 1
+
+
+def test_location_url_construction():
+    from mmlspark_tpu.cognitive import TextSentiment, BingImageSearch
+    s = TextSentiment(inputCol="t", outputCol="o", location="eastus")
+    assert s.getUrl() == ("https://eastus.api.cognitive.microsoft.com"
+                          "/text/analytics/v3.0/sentiment")
+    with pytest.raises(ValueError):
+        TextSentiment(inputCol="t", outputCol="o").getUrl()
+    assert "bing" in BingImageSearch(inputCol="q", outputCol="o").getUrl()
+
+
+def test_vision_query_params(echo_server):
+    from mmlspark_tpu.cognitive import AnalyzeImage, DetectFace
+    urls = np.empty(1, dtype=object)
+    urls[0] = "http://images/x.png"
+    t = DataTable({"image": urls})
+    stage = AnalyzeImage(inputCol="image", outputCol="r",
+                         url=f"{echo_server}/echo",
+                         visualFeatures=["Tags", "Faces"])
+    # echo server returns the path it was hit on via GET; for POST we check
+    # the full URL construction directly
+    assert "visualFeatures=Tags%2CFaces" in stage._full_url()
+    face = DetectFace(inputCol="image", outputCol="r",
+                      url=f"{echo_server}/echo",
+                      returnFaceAttributes=["age", "glasses"])
+    assert "returnFaceId=true" in face._full_url()
+    assert "returnFaceAttributes=age%2Cglasses" in face._full_url()
+    out = face.transform(t)  # request still round-trips with query params
+    assert out["r"][0]["echo"]["url"] == "http://images/x.png"
+
+
+def test_all_cognitive_stages_constructible():
+    import mmlspark_tpu.cognitive as cog
+    skipped = {"CognitiveServiceBase"}
+    count = 0
+    for name in cog.__all__:
+        if name in skipped or name == "AzureSearchWriter":
+            continue
+        cls = getattr(cog, name)
+        stage = cls(inputCol="in", outputCol="out")
+        assert stage.hasParam("subscriptionKey"), name
+        count += 1
+    assert count >= 20

@@ -185,6 +185,19 @@ def test_sar_cold_start_scores_zero(ratings):
         SAR().fit(bad)
 
 
+def test_sar_recommend_subset_cold_start(ratings):
+    from mmlspark_tpu.recommendation import SAR
+    model = SAR(supportThreshold=1).fit(ratings)
+    recs = model.recommendForUserSubset(np.array([-1, 0, 10_000]), 3)
+    # invalid ids get empty recs, never another user's row
+    assert recs["recommendations"][0].tolist() == [-1, -1, -1]
+    assert recs["recommendations"][2].tolist() == [-1, -1, -1]
+    assert (recs["recommendations"][1] >= 0).all()
+    all_recs = model.recommendForAllUsers(3)
+    np.testing.assert_array_equal(recs["recommendations"][1],
+                                  all_recs["recommendations"][0])
+
+
 def test_vw_sample_weights_shift_model():
     from mmlspark_tpu.vw import VowpalWabbitClassifier
     rng = np.random.default_rng(0)
