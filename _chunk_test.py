@@ -1,0 +1,13 @@
+import numpy as np, jax, jax.numpy as jnp, time
+from mmlspark_tpu.ops.histogram import compute_histogram
+B, n, f = 256, 400000, 50
+rng = np.random.default_rng(1)
+bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+for rc in (2048, 8192, 32768, 131072):
+    fn = jax.jit(lambda b, g, r=rc: compute_histogram(b, g, B, method="dot16", row_chunk=r))
+    r = fn(bins, gh); jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(10): r = fn(bins, gh)
+    jax.block_until_ready(r)
+    print(f"dot16 rc={rc}: {(time.perf_counter()-t0)/10*1e3:.2f} ms")

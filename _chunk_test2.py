@@ -1,0 +1,19 @@
+import numpy as np, jax, jax.numpy as jnp, time
+from mmlspark_tpu.ops.histogram import compute_histogram
+B, n, f = 256, 400000, 50
+rng = np.random.default_rng(1)
+bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+ref = None
+def bench(tag, fn):
+    global ref
+    r = fn(bins, gh); jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(10): r = fn(bins, gh)
+    jax.block_until_ready(r)
+    ok = "?" if ref is None else f"{float(jnp.max(jnp.abs(r-ref))):.2e}"
+    if ref is None: ref = r
+    print(f"{tag}: {(time.perf_counter()-t0)/10*1e3:.2f} ms  maxdiff={ok}")
+bench("m-only   ", jax.jit(lambda b, g: compute_histogram(b, g, B, method="dot16")))
+bench("m+rc8192 ", jax.jit(lambda b, g: compute_histogram(b, g, B, method="dot16", row_chunk=8192)))
+bench("m-only2  ", jax.jit(lambda b, g, mm="dot16": compute_histogram(b, g, B, method=mm)))

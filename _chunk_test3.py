@@ -1,0 +1,23 @@
+import numpy as np, jax, jax.numpy as jnp, time
+from mmlspark_tpu.ops.histogram import compute_histogram
+B, n, f = 256, 400000, 50
+rng = np.random.default_rng(1)
+bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+
+def bench(tag, fn, iters=10):
+    r = fn(bins, gh); s = np.asarray(r).sum()   # warm + sync
+    t0 = time.perf_counter()
+    _ = np.asarray(fn(bins, gh)).sum()
+    base = time.perf_counter() - t0             # 1 iter + fetch
+    t0 = time.perf_counter()
+    for _ in range(iters): r = fn(bins, gh)
+    _ = np.asarray(r).sum()
+    tot = time.perf_counter() - t0              # N iters + fetch
+    per = (tot - base) / (iters - 1)
+    print(f"{tag}: {per*1e3:.2f} ms/iter (1it+fetch={base*1e3:.0f}ms)")
+
+for m in ("dot16", "pallas", "pallas_bf16"):
+    bench(m, jax.jit(lambda b, g, mm=m: compute_histogram(b, g, B, method=mm)))
+for rc in (32768, 131072):
+    bench(f"dot16 rc={rc}", jax.jit(lambda b, g, r=rc: compute_histogram(b, g, B, method="dot16", row_chunk=r)))

@@ -1,0 +1,15 @@
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS","") + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sklearn.datasets import make_classification
+X, y = make_classification(n_samples=2000, n_features=20, n_informative=10,
+                           n_redundant=4, random_state=7, class_sep=0.8)
+tbl = {"features": X, "label": y.astype(np.float64)}
+from mmlspark_tpu.gbdt import LightGBMClassifier
+for i in range(8):
+    m = LightGBMClassifier(numIterations=10, numLeaves=15).fit(tbl)
+    out = m.transform(tbl)
+    print("run", i, "ok", len(m.getModel().trees))

@@ -1,0 +1,41 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from mmlspark_tpu.ops.histogram import compute_histogram
+from mmlspark_tpu.gbdt.grower import GrowerConfig, grow_tree
+from mmlspark_tpu.gbdt.objectives import BinaryObjective
+from mmlspark_tpu.gbdt.engine import _boost_step
+
+n, f, B = 20000, 20, 256
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+
+for method in ("segment", "dot16", "onehot"):
+    fn = jax.jit(lambda b, g, m=method: compute_histogram(b, g, B, method=m))
+    r = fn(bins, gh); jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(20): r = fn(bins, gh)
+    jax.block_until_ready(r)
+    print(f"hist {method}: {(time.perf_counter()-t0)/20*1e3:.2f} ms")
+
+cfg = GrowerConfig(num_leaves=31, num_bins=B, min_data_in_leaf=20, hist_method="auto")
+fmask = jnp.ones(f, jnp.float32)
+tree, rl = grow_tree(bins, gh.at[:, 2].set(1.0), fmask, cfg)
+jax.block_until_ready(rl)
+t0 = time.perf_counter()
+for _ in range(5): tree, rl = grow_tree(bins, gh, fmask, cfg)
+jax.block_until_ready(rl)
+print(f"grow_tree: {(time.perf_counter()-t0)/5*1e3:.1f} ms")
+
+# full boost step
+obj = BinaryObjective()
+labels = jnp.asarray((rng.random(n) > .5), jnp.float32)
+w = jnp.ones(n, jnp.float32)
+scores = jnp.zeros(n, jnp.float32)
+ones = jnp.ones(n, jnp.float32)
+tree, scores2 = _boost_step(bins, scores, labels, w, ones, fmask, obj, cfg, 0.1)
+jax.block_until_ready(scores2)
+t0 = time.perf_counter()
+s = jnp.zeros(n, jnp.float32)
+for _ in range(5): tree, s = _boost_step(bins, s, labels, w, ones, fmask, obj, cfg, 0.1)
+jax.block_until_ready(s)
+print(f"boost_step: {(time.perf_counter()-t0)/5*1e3:.1f} ms")

@@ -1,0 +1,15 @@
+import time, numpy as np
+import cProfile, pstats
+rng = np.random.default_rng(0)
+n, f = 20000, 20
+X = rng.normal(size=(n, f)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float64)
+from mmlspark_tpu.gbdt import LightGBMClassifier
+kw = dict(learningRate=0.1, numLeaves=31, maxBin=255, minDataInLeaf=20, verbosity=0)
+LightGBMClassifier(numIterations=2, **kw).fit({"features": X, "label": y})
+t0 = time.perf_counter()
+pr = cProfile.Profile(); pr.enable()
+LightGBMClassifier(numIterations=5, **kw).fit({"features": X, "label": y})
+pr.disable()
+print(f"fit: {time.perf_counter()-t0:.2f}s")
+pstats.Stats(pr).sort_stats("cumulative").print_stats(18)

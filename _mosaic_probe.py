@@ -1,0 +1,29 @@
+import jax, jax.numpy as jnp, numpy as np, functools
+from jax.experimental import pallas as pl
+
+def k1(x_ref, o_ref):
+    x = x_ref[...]                       # (256, 8, 16)
+    o_ref[...] = x.reshape(256, 128)     # collapse (8,16) -> 128 lanes
+
+def k2(x_ref, o_ref):
+    x = x_ref[...]                       # (8, 256)
+    o_ref[...] = x.T                     # 2D transpose
+
+def k3(x_ref, o_ref):
+    x = x_ref[...]                       # (256, 128)
+    o_ref[...] = jnp.repeat(x, 3, axis=1)  # lane-repeat 128->384
+
+for name, kern, inshape, outshape in [
+    ("reshape-collapse", k1, (256, 8, 16), (256, 128)),
+    ("transpose2d", k2, (8, 256), (256, 8)),
+    ("repeat3", k3, (256, 128), (256, 384)),
+]:
+    x = jnp.asarray(np.random.default_rng(0).normal(size=inshape), jnp.float32)
+    try:
+        out = pl.pallas_call(kern, out_shape=jax.ShapeDtypeStruct(outshape, jnp.float32))(x)
+        ref = {"reshape-collapse": lambda: np.asarray(x).reshape(outshape),
+               "transpose2d": lambda: np.asarray(x).T,
+               "repeat3": lambda: np.repeat(np.asarray(x), 3, axis=1)}[name]()
+        print(name, "OK maxdiff", float(np.max(np.abs(np.asarray(out) - ref))))
+    except Exception as e:
+        print(name, "FAIL:", str(e).split("\n")[0][:120])

@@ -1,0 +1,20 @@
+import numpy as np, jax, jax.numpy as jnp, time
+from mmlspark_tpu.ops.pallas_histogram import histogram_pallas
+B, n, f = 256, 400000, 50
+rng = np.random.default_rng(1)
+bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+def bench(tag, fn, iters=10):
+    r = fn(bins, gh); _ = np.asarray(r).sum()
+    t0 = time.perf_counter(); _ = np.asarray(fn(bins, gh)).sum()
+    base = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters): r = fn(bins, gh)
+    _ = np.asarray(r).sum()
+    tot = time.perf_counter() - t0
+    print(f"{tag}: {(tot-base)/(iters-1)*1e3:.2f} ms/iter", flush=True)
+for rc in (4096,):
+    try:
+        bench(f"rc={rc}", jax.jit(lambda b, g, r=rc: histogram_pallas(b, g, B, row_chunk=r, accum="bfloat16")))
+    except Exception as e:
+        print(f"rc={rc} FAIL {str(e)[:90]}", flush=True)

@@ -1,0 +1,21 @@
+import numpy as np, jax, jax.numpy as jnp, time
+from mmlspark_tpu.ops.histogram import compute_histogram
+n, f, B = 20000, 50, 256
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+ref = compute_histogram(bins, gh, B, method="segment")
+for m in ("pallas", "pallas_bf16"):
+    t0=time.perf_counter()
+    out = compute_histogram(bins, gh, B, method=m)
+    jax.block_until_ready(out)
+    err = float(jnp.max(jnp.abs(out - ref)) / (jnp.max(jnp.abs(ref)) + 1e-9))
+    print(m, "rel err:", err, f"first-call {time.perf_counter()-t0:.1f}s")
+# timing
+for m in ("segment", "dot16", "pallas", "pallas_bf16"):
+    fn = jax.jit(lambda b, g, mm=m: compute_histogram(b, g, B, method=mm))
+    r = fn(bins, gh); jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(20): r = fn(bins, gh)
+    jax.block_until_ready(r)
+    print(f"{m}: {(time.perf_counter()-t0)/20*1e3:.2f} ms")

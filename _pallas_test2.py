@@ -1,0 +1,22 @@
+import numpy as np, jax, jax.numpy as jnp, time
+from mmlspark_tpu.ops.histogram import compute_histogram
+B = 256
+# exact integer check, small
+rng = np.random.default_rng(1)
+bins_s = jnp.asarray(rng.integers(0, B, size=(3000, 7)), jnp.int32)
+gh_s = jnp.asarray(rng.integers(0, 3, size=(3000, 3)), jnp.float32)
+ref = compute_histogram(bins_s, gh_s, B, method="segment")
+out = compute_histogram(bins_s, gh_s, B, method="pallas")
+print("int exact max abs diff:", float(jnp.max(jnp.abs(out - ref))))
+# bench scale
+n, f = 400000, 50
+bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+for m in ("segment", "dot16", "pallas", "pallas_bf16"):
+    fn = jax.jit(lambda b, g, mm=m: compute_histogram(b, g, B, method=mm))
+    r = fn(bins, gh); jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(10): r = fn(bins, gh)
+    jax.block_until_ready(r)
+    dt = (time.perf_counter()-t0)/10
+    print(f"{m}: {dt*1e3:.2f} ms  ({2*n*f*B*3/dt/1e12:.1f} TFLOP/s eff)")

@@ -1,0 +1,27 @@
+import numpy as np, jax, jax.numpy as jnp, time
+from mmlspark_tpu.ops.histogram import compute_histogram
+B = 256
+rng = np.random.default_rng(1)
+bins_s = jnp.asarray(rng.integers(0, B, size=(3000, 7)), jnp.int32)
+gh_s = jnp.asarray(rng.integers(0, 3, size=(3000, 3)), jnp.float32)
+ref = compute_histogram(bins_s, gh_s, B, method="segment")
+out = compute_histogram(bins_s, gh_s, B, method="pallas")
+print("int exact max abs diff:", float(jnp.max(jnp.abs(out - ref))))
+n, f = 400000, 50
+bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+ref = None
+def bench(tag, fn, iters=10):
+    global ref
+    r = fn(bins, gh); _ = np.asarray(r).sum()
+    t0 = time.perf_counter(); _ = np.asarray(fn(bins, gh)).sum()
+    base = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters): r = fn(bins, gh)
+    d = float(jnp.max(jnp.abs(r - ref))) if ref is not None else 0.0
+    tot = time.perf_counter() - t0
+    if ref is None: ref = r
+    print(f"{tag}: {(tot-base)/(iters-1)*1e3:.2f} ms/iter  maxdiff={d:.2e}")
+bench("dot16      ", jax.jit(lambda b, g: compute_histogram(b, g, B, method="dot16")))
+bench("pallas     ", jax.jit(lambda b, g: compute_histogram(b, g, B, method="pallas")))
+bench("pallas_bf16", jax.jit(lambda b, g: compute_histogram(b, g, B, method="pallas_bf16")))

@@ -1,0 +1,18 @@
+import numpy as np, jax, jax.numpy as jnp, time
+n, f = 400000, 50
+rng = np.random.default_rng(0)
+mask = jnp.asarray(rng.random(n) > 0.5)
+vals = jnp.asarray(rng.normal(size=n), jnp.float32)
+bins = jnp.asarray(rng.integers(0, 256, size=(n, f)), jnp.int32)
+def bench(tag, fn, *args, iters=10):
+    r = fn(*args); _ = np.asarray(r).ravel()[:1]
+    t0 = time.perf_counter(); _ = np.asarray(fn(*args)).ravel()[:1]
+    base = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters): r = fn(*args)
+    _ = np.asarray(r).ravel()[:1]
+    print(f"{tag}: {(time.perf_counter()-t0-base)/(iters-1)*1e3:.2f} ms", flush=True)
+bench("argsort-bool", jax.jit(lambda m: jnp.argsort(~m)), mask)
+bench("top_k 80k", jax.jit(lambda v: jax.lax.top_k(v, 80000)[1]), vals)
+bench("gather n/2 rows", jax.jit(lambda b, m: b[jnp.argsort(~m)[:n//2]]), bins, mask)
+bench("cumsum+scatter", jax.jit(lambda m: jnp.zeros(n//2, jnp.int32).at[jnp.where(m, jnp.cumsum(m)-1, n//2)].set(jnp.arange(n), mode="drop")), mask)

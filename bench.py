@@ -65,11 +65,11 @@ def main():
 
     kw = dict(learningRate=0.1, numLeaves=leaves, maxBin=255,
               minDataInLeaf=20, verbosity=0)
-    # warm-up: compile the boost step on a slice (same static shapes except
-    # n; grower compiles per (n, f) so use the full array with 2 iters)
+    # warm-up: identical config so the timed fit is pure steady state
+    # (boost step AND forest-pack kernels compiled, caches hot)
     log("warm-up / compile...")
     t0 = time.perf_counter()
-    LightGBMClassifier(numIterations=2, **kw).fit(
+    LightGBMClassifier(numIterations=iters, **kw).fit(
         {"features": X, "label": y})
     log(f"warm-up (incl compile): {time.perf_counter() - t0:.2f}s")
 

@@ -100,9 +100,20 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                             default="", typeConverter=TypeConverters.toString)
     seed = Param("seed", "Random seed", default=42,
                  typeConverter=TypeConverters.toInt)
+    boostingType = Param("boostingType",
+                         "gbdt (plain boosting) or goss (gradient-based "
+                         "one-side sampling)", default="gbdt",
+                         typeConverter=TypeConverters.toString)
+    topRate = Param("topRate",
+                    "GOSS: fraction of rows kept by largest gradient",
+                    default=0.2, typeConverter=TypeConverters.toFloat)
+    otherRate = Param("otherRate",
+                      "GOSS: fraction of remaining rows sampled (amplified "
+                      "by (1-topRate)/otherRate)", default=0.1,
+                      typeConverter=TypeConverters.toFloat)
     histogramMethod = Param("histogramMethod",
                             "TPU histogram backend: auto, dot16, onehot, "
-                            "segment", default="auto",
+                            "segment, pallas, pallas_bf16", default="auto",
                             typeConverter=TypeConverters.toString)
     passThroughArgs = Param("passThroughArgs",
                             "Raw 'key=value key=value' LightGBM param string "
@@ -133,6 +144,9 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
             boost_from_average=self.getBoostFromAverage(),
             seed=self.getSeed(),
             bagging_seed=self.getBaggingSeed(),
+            boosting=self.getBoostingType(),
+            top_rate=self.getTopRate(),
+            other_rate=self.getOtherRate(),
             histogram_method=self.getHistogramMethod(),
             verbosity=self.getVerbosity(),
             pass_through=pass_through,
@@ -222,7 +236,8 @@ class LightGBMBase(Estimator, LightGBMParams):
         # reference trains across all executors (SURVEY.md §3.1); the
         # parallelism param picks the axis layout.
         mesh = getattr(self, "_mesh", None)
-        if mesh is None and grad_override is None and not val_kwargs:
+        if mesh is None and grad_override is None and not val_kwargs \
+                and self.getBoostingType() != "goss":
             import jax
             if jax.device_count() > 1:
                 from .distributed import resolve_mesh

@@ -49,6 +49,10 @@ class TrainParams:
     boost_from_average: bool = True
     seed: int = 42
     bagging_seed: int = 3
+    #: "gbdt" or "goss" (gradient-based one-side sampling)
+    boosting: str = "gbdt"
+    top_rate: float = 0.2
+    other_rate: float = 0.1
     histogram_method: str = "auto"
     verbosity: int = 1
     #: raw passthrough params recorded into the model file (parity with the
@@ -74,6 +78,40 @@ def _grad_hess_jit(scores, labels, weights, obj: Objective):
     return obj.grad_hess(scores, labels, weights)
 
 
+@functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr", "k1", "k2",
+                                             "amp"),
+                   donate_argnums=(1,))
+def _boost_step_goss(bins, scores, labels, weights, key, feature_mask,
+                     obj: Objective, cfg: GrowerConfig, lr: float,
+                     k1: int, k2: int, amp: float):
+    """One GOSS iteration: grow the tree on top-|g·h| rows plus an amplified
+    random sample of the rest (Ke et al. 2017; LightGBM boosting=goss).
+
+    The histogram work shrinks to ``(topRate + otherRate)·n`` rows — the
+    LightGBM-native answer to the hot loop's cost, and the one that maps
+    best to TPUs (a gather instead of sparse masking).  Scores still update
+    for every row via a full binned traversal of the new tree.
+    """
+    g, h = obj.grad_hess(scores, labels, weights)
+    n = g.shape[0]
+    rank = jnp.argsort(-jnp.abs(g * h))          # descending influence
+    top_idx = rank[:k1]
+    rest = rank[k1:]
+    rk = jax.random.uniform(key, (n - k1,))
+    other_idx = jnp.take(rest, jnp.argsort(rk)[:k2])
+    idx = jnp.concatenate([top_idx, other_idx])
+    amp_vec = jnp.concatenate([
+        jnp.ones(k1, jnp.float32), jnp.full(k2, amp, jnp.float32)])
+    bins_g = jnp.take(bins, idx, axis=0)
+    gh = jnp.stack([jnp.take(g, idx) * amp_vec,
+                    jnp.take(h, idx) * amp_vec,
+                    jnp.ones(k1 + k2, jnp.float32)], axis=1)
+    tree, _ = _grow_tree_impl(bins_g, gh, feature_mask, cfg)
+    scores = scores + lr * predict_tree_binned(tree, bins, cfg.num_leaves)
+    tree = apply_shrinkage(tree, lr)
+    return tree, scores
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "lr", "k"),
                    donate_argnums=(1,))
 def _boost_step_class_k(bins, scores, g, h, bag_mask, feature_mask,
@@ -97,6 +135,74 @@ def _update_val_scores(tree: TreeArrays, val_bins, val_scores, lr,
     return val_scores + lr * predict_tree_binned(tree, val_bins, max_steps)
 
 
+@jax.jit
+def _pack_trees(trees: List[TreeArrays]) -> jnp.ndarray:
+    """Flatten a list of TreeArrays into one (T, P) f32 buffer.
+
+    Device→host latency dominates on a tunneled TPU (each transfer costs
+    ~the round-trip time regardless of size), so the whole forest crosses
+    in ONE transfer instead of 12 per tree.  int fields fit f32 exactly
+    (node/feature/bin ids ≪ 2^24); counts are already f32 on device.
+    Stacking happens *inside* jit so trees produced under shard_map (multi-
+    device, replicated) are legal inputs — XLA inserts the resharding.
+    """
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+    f32 = lambda a: a.astype(jnp.float32)  # noqa: E731
+    return jnp.concatenate([
+        f32(stacked.num_leaves)[:, None],
+        f32(stacked.node_feat), f32(stacked.node_bin),
+        f32(stacked.node_left), f32(stacked.node_right),
+        stacked.node_gain, stacked.node_value,
+        stacked.node_weight, stacked.node_count,
+        stacked.leaf_value, stacked.leaf_weight, stacked.leaf_count,
+    ], axis=1)
+
+
+def _fetch_host_trees(trees_dev: List[TreeArrays], num_leaves: int,
+                      mapper: BinMapper) -> Tuple[List[HostTree], np.ndarray]:
+    """One batched device→host transfer → per-tree HostTrees + leaf counts."""
+    if not trees_dev:
+        return [], np.zeros(0, np.int64)
+    # Pad the list to a power-of-two bucket so _pack_trees compiles once per
+    # bucket size instead of once per distinct forest size.
+    T = len(trees_dev)
+    bucket = max(8, 1 << (T - 1).bit_length())
+    packed = np.asarray(_pack_trees(
+        trees_dev + [trees_dev[0]] * (bucket - T)))[:T]
+    L, m = num_leaves, num_leaves - 1
+    offs = np.cumsum([1] + [m] * 8 + [L] * 3)
+    cols = [packed[:, a:b] for a, b in zip([0] + list(offs), offs)]
+    nls = cols[0][:, 0].astype(np.int64)
+    out = []
+    for i in range(packed.shape[0]):
+        tree = TreeArrays(
+            node_feat=cols[1][i].astype(np.int32),
+            node_bin=cols[2][i].astype(np.int32),
+            node_left=cols[3][i].astype(np.int32),
+            node_right=cols[4][i].astype(np.int32),
+            node_gain=cols[5][i], node_value=cols[6][i],
+            node_weight=cols[7][i], node_count=cols[8][i],
+            leaf_value=cols[9][i], leaf_weight=cols[10][i],
+            leaf_count=cols[11][i], num_leaves=nls[i])
+        out.append(host_tree_from_arrays(tree, mapper, mapper.missing_bin))
+    return out, nls
+
+
+def _truncate_no_growth(host_trees: List[HostTree], nls: np.ndarray, K: int,
+                        stop_iter: int, verbosity: int
+                        ) -> Tuple[List[HostTree], int]:
+    """Reproduce LightGBM's stop-at-first-stump-iteration semantics post hoc
+    (the loop no longer syncs per iteration to learn leaf counts live)."""
+    grew = (nls.reshape(-1, K) > 1).any(axis=1)
+    if grew.all():
+        return host_trees, stop_iter
+    first = int(np.argmax(~grew))
+    if verbosity > 0:
+        log.info("No further splits with positive gain; stopping at "
+                 "iteration %d", first)
+    return host_trees[:(first + 1) * K], min(stop_iter, first)
+
+
 def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
           mapper: BinMapper, objective: Objective, params: TrainParams,
           feature_names: Optional[List[str]] = None,
@@ -113,6 +219,11 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     ``grad_fn_override``: optional ``(scores) -> (g, h)`` replacing the
     objective's grad/hess (used by the ranking objective which closes over
     query structure).
+
+    ``callbacks``: each called as ``cb(it, trees_dev)`` with the list of
+    on-device ``TreeArrays`` grown so far (fixed-size, shrinkage applied);
+    host conversion happens once after the loop, so callbacks that need
+    host trees must convert explicitly (and pay the device sync).
 
     ``mesh``: a ``(data, feature)`` Mesh for distributed training; rows and
     features are padded to the mesh shape and the boost step runs under
@@ -138,6 +249,40 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         min_gain_to_split=params.min_gain_to_split,
         hist_method=params.histogram_method)
 
+    if params.boosting not in ("gbdt", "goss"):
+        raise NotImplementedError(
+            f"boostingType={params.boosting!r} is not supported; "
+            "use 'gbdt' or 'goss' (dart/rf not yet implemented)")
+    use_goss = params.boosting == "goss"
+    if use_goss:
+        if K > 1 or grad_fn_override is not None:
+            raise NotImplementedError(
+                "boostingType='goss' currently supports single-model "
+                "objectives (binary/regression)")
+        if params.bagging_freq > 0 and params.bagging_fraction < 1.0:
+            raise ValueError("Cannot use bagging in GOSS "
+                             "(as in LightGBM); unset baggingFraction/"
+                             "baggingFreq or use boostingType='gbdt'")
+        if not (0.0 < params.top_rate < 1.0 and
+                0.0 < params.other_rate < 1.0) or \
+                params.top_rate + params.other_rate >= 1.0:
+            raise ValueError("GOSS needs 0 < topRate < 1, "
+                             "0 < otherRate < 1 and topRate + otherRate "
+                             f"< 1, got {params.top_rate}/"
+                             f"{params.other_rate}")
+        k1 = max(1, int(np.ceil(n * params.top_rate)))
+        k2 = max(1, int(np.ceil(n * params.other_rate)))
+        if k1 + k2 >= n:
+            use_goss = False   # rounding on tiny n: nothing to shrink
+            if params.verbosity > 0:
+                log.info("GOSS sample covers every row (n=%d); training "
+                         "falls back to plain gbdt", n)
+        else:
+            goss_amp = (1.0 - params.top_rate) / params.other_rate
+            goss_keys = jax.random.split(
+                jax.random.PRNGKey(params.bagging_seed),
+                params.num_iterations)
+
     use_mesh = mesh is not None and int(np.prod(
         [mesh.shape[a] for a in mesh.axis_names])) > 1
     if use_mesh:
@@ -145,6 +290,10 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             raise NotImplementedError(
                 "ranking objectives are single-mesh-axis for now; train "
                 "the ranker without a mesh")
+        if use_goss:
+            raise NotImplementedError(
+                "boostingType='goss' with an explicit mesh is not yet "
+                "supported; drop setMesh(...) or use boostingType='gbdt'")
         if val_bins is not None or callbacks:
             raise NotImplementedError(
                 "validation/early stopping and callbacks are not yet "
@@ -178,7 +327,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     full_fmask = jnp.ones(f, jnp.float32)
     fmask = full_fmask
 
-    trees: List[HostTree] = []
+    trees_dev: List[TreeArrays] = []
     stop_iter = params.num_iterations
     for it in range(params.num_iterations):
         if params.bagging_freq > 0 and params.bagging_fraction < 1.0 \
@@ -192,7 +341,6 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             m[sel] = 1.0
             fmask = jnp.asarray(m)
 
-        grew_any = False
         if K > 1 and grad_fn_override is None:
             g_iter, h_iter = _grad_hess_jit(scores, labels_d, weights_d,
                                             objective)
@@ -208,31 +356,26 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 tree, scores = _boost_step_class_k(
                     bins_d, scores, g_iter, h_iter, bag_mask, fmask,
                     cfg, params.learning_rate, k)
+            elif use_goss:
+                tree, scores = _boost_step_goss(
+                    bins_d, scores, labels_d, weights_d, goss_keys[it],
+                    fmask, objective, cfg, params.learning_rate,
+                    k1, k2, goss_amp)
             else:
                 tree, scores = _boost_step(
                     bins_d, scores, labels_d, weights_d, bag_mask, fmask,
                     objective, cfg, params.learning_rate)
-            nl = int(tree.num_leaves)
-            if nl > 1:
-                grew_any = True
-            trees.append(host_tree_from_arrays(tree, mapper,
-                                               mapper.missing_bin))
+            trees_dev.append(tree)
             if has_val:
+                # trees are already shrunk (apply_shrinkage inside the boost
+                # step), so val scores add leaf values at lr=1.0
                 if K == 1:
                     val_scores = _update_val_scores(
-                        tree, val_bins_d, val_scores,
-                        params.learning_rate, params.num_leaves)
+                        tree, val_bins_d, val_scores, 1.0, params.num_leaves)
                 else:
                     val_scores = val_scores.at[:, k].set(_update_val_scores(
                         tree, val_bins_d, val_scores[:, k],
-                        params.learning_rate, params.num_leaves))
-
-        if not grew_any:
-            if params.verbosity > 0:
-                log.info("No further splits with positive gain; stopping at "
-                         "iteration %d", it)
-            stop_iter = it
-            break
+                        1.0, params.num_leaves))
 
         if has_val:
             metric = float(val_metric(np.asarray(val_scores),
@@ -246,12 +389,15 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                              "(best %d, metric %.6f)", it, best_iter,
                              best_metric)
                 stop_iter = best_iter + 1
-                trees = trees[:stop_iter * K]
+                trees_dev = trees_dev[:stop_iter * K]
                 break
         if callbacks:
             for cb in callbacks:
-                cb(it, trees)
+                cb(it, trees_dev)
 
+    trees, nls = _fetch_host_trees(trees_dev, params.num_leaves, mapper)
+    trees, stop_iter = _truncate_no_growth(trees, nls, K, stop_iter,
+                                           params.verbosity)
     return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)
 
@@ -267,7 +413,7 @@ def _finalize_booster(trees, K, init, params, objective, mapper,
             t.internal_value = t.internal_value + init
 
     engine_params = {
-        "boosting": "gbdt",
+        "boosting": params.boosting,
         "objective": objective.model_str,
         "num_iterations": str(stop_iter),
         "learning_rate": f"{params.learning_rate:g}",
@@ -307,7 +453,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     fmask_full[:f] = 1.0
     fmask = jnp.asarray(fmask_full)
 
-    trees: List[HostTree] = []
+    trees_dev: List[TreeArrays] = []
     stop_iter = params.num_iterations
     bag = real
     for it in range(params.num_iterations):
@@ -325,7 +471,6 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
             m[sel] = 1.0
             fmask = jnp.asarray(m)
 
-        grew_any = False
         if K > 1:
             g_iter, h_iter = grads_fn(scores, labels_d, w_d)
         for k in range(K):
@@ -335,13 +480,10 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
             else:
                 tree, scores = step(bins_d, scores, labels_d, w_d, bag,
                                     fmask, jnp.asarray(k, jnp.int32))
-            if int(tree.num_leaves) > 1:
-                grew_any = True
-            trees.append(host_tree_from_arrays(tree, mapper,
-                                               mapper.missing_bin))
-        if not grew_any:
-            stop_iter = it
-            break
+            trees_dev.append(tree)
 
+    trees, nls = _fetch_host_trees(trees_dev, params.num_leaves, mapper)
+    trees, stop_iter = _truncate_no_growth(trees, nls, K, stop_iter,
+                                           params.verbosity)
     return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)

@@ -35,12 +35,27 @@ def sigmoid(x):
 
 
 class Objective:
-    """Base: subclasses define grad/hess and the boost-from-average init."""
+    """Base: subclasses define grad/hess and the boost-from-average init.
+
+    Objectives are passed to jitted boost steps as *static* arguments, so
+    they hash by value (type + full instance state, including what
+    ``prepare`` resolved): two fits with identical objective config hit the
+    same XLA executable instead of recompiling per estimator instance.
+    """
 
     name = "base"
     num_model_per_iteration = 1
     #: substring written into the LightGBM model file objective line
     model_str = "custom"
+
+    def _key(self):
+        return (type(self), tuple(sorted(self.__dict__.items())))
+
+    def __eq__(self, other):
+        return isinstance(other, Objective) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
 
     def prepare(self, labels: np.ndarray, weights: np.ndarray) -> None:
         """Resolve label statistics (class weights etc.); always called once

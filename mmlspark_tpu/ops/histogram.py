@@ -42,6 +42,8 @@ GH_CHANNELS = 3  # grad, hess, count
 
 
 def _auto_method() -> str:
+    # dot16 currently beats the pallas kernel on v5e (the B·3/128² output
+    # bound caps both; XLA's scan pipelines better) — keep pallas opt-in.
     return "dot16" if jax.default_backend() in ("tpu", "axon") else "segment"
 
 
@@ -55,7 +57,8 @@ def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
       gh: ``(n, 3)`` float (grad, hess, count); rows not in the active leaf
         must already be zeroed.
       num_bins: static bin count B.
-      method: "segment" | "dot16" | "onehot" | "auto".
+      method: "segment" | "dot16" | "onehot" | "pallas" | "pallas_bf16"
+        | "auto".
 
     Returns:
       ``(f, num_bins, 3)`` float32 histogram.
@@ -68,6 +71,15 @@ def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         return _hist_dot16(bins, gh, num_bins, row_chunk)
     if method == "onehot":
         return _hist_onehot(bins, gh, num_bins, row_chunk)
+    if method in ("pallas", "pallas_bf16"):
+        from .pallas_histogram import BMAX, histogram_pallas
+        if num_bins > BMAX:   # kernel folds 16x16 nibbles; fall back
+            return _hist_dot16(bins, gh, num_bins, row_chunk)
+        return histogram_pallas(
+            bins, gh.astype(jnp.float32), num_bins,
+            row_chunk=min(row_chunk, 4096),   # VMEM ceiling for the kernel
+            accum="bfloat16" if method == "pallas_bf16" else "float32",
+            interpret=jax.default_backend() == "cpu")
     raise ValueError(f"Unknown histogram method {method!r}")
 
 

@@ -1,0 +1,140 @@
+"""Pallas TPU kernel for gradient-histogram construction.
+
+The GBDT hot loop builds per-feature (B, 3) gradient histograms — a scatter
+by bin index, the one primitive TPUs lack.  Matmul reformulations pay a
+structural tax: a per-feature one-hot contraction has only ``B·3`` output
+elements, so the MXU runs at ``B·3 / 128²`` ≈ 4.7 % utilization no matter
+how the nibbles are split (that is what XLA's dot16 path achieves).
+
+This kernel buys utilization back by **folding 8 features into one
+128-wide matmul pair**.  With ``B = 256 = 16·16`` split into lo/hi nibbles
+and combined keys
+
+  klo = f·16 + (bin % 16)   ∈ [0, 128)
+  khi = f·16 + (bin // 16)  ∈ [0, 128)
+
+the contraction ``outᶜ = onehot(klo)ᵀ @ (onehot(khi) · ghᶜ)`` is a clean
+(128, C) × (C, 128) MXU matmul per gradient channel whose **diagonal**
+16×16 blocks are exactly the 8 features' histograms (off-diagonal blocks
+are cross-feature garbage that costs 8× FLOPs but runs at ~100 % MXU
+utilization — a net win over the 4.7 % structural bound, biggest in bf16).
+Everything stays in VMEM; the kernel emits the full (3, 128, 128) product
+per feature-block and XLA extracts the diagonal afterwards (in-kernel
+lane slicing and reshapes are Mosaic-hostile).
+
+``accum="bfloat16"`` runs the matmul operands in bf16 with f32
+accumulation (preferred_element_type): the one-hot side is exact, only
+grad/hess operand values round.
+
+This replaces the per-feature scatter-add inside the reference's native
+engine (``LGBM_BoosterUpdateOneIter`` → ConstructHistograms; SURVEY.md §3.1
+hot loop).  On CPU the kernel runs in interpret mode (tests only).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LO = 16          # low-nibble width
+FB = 8           # features folded per matmul: FB * LO = 128 lanes
+BMAX = LO * LO   # 256 bins supported; larger falls back to dot16
+
+
+def _hist_kernel(binsT_ref, gh_ref, out_ref, lo_scr, hi_scr, *, accum_dtype):
+    """One (feature_block, row_chunk) grid step; accumulates into out_ref."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    bT = binsT_ref[...].T                 # (C, FB) int32
+    g = gh_ref[...].astype(jnp.float32)   # (C, 3)
+    c = bT.shape[0]
+
+    # Combined one-hots built 16 lanes at a time (per folded feature) into
+    # VMEM scratch — n·(16+16) compares per row-feature instead of n·128.
+    iota16 = jax.lax.broadcasted_iota(jnp.int32, (c, LO), 1)
+    for f in range(FB):
+        col = bT[:, f][:, None]
+        lo_scr[:, f * LO:(f + 1) * LO] = (col % LO == iota16).astype(
+            accum_dtype)
+        hi_scr[:, f * LO:(f + 1) * LO] = (col // LO == iota16).astype(
+            jnp.float32)
+
+    lo_oh = lo_scr[...]
+    hi_oh = hi_scr[...]
+    for ch in range(3):
+        rhs = (hi_oh * g[:, ch][:, None]).astype(accum_dtype)
+        out_ref[0, ch] += jax.lax.dot_general(
+            lo_oh, rhs, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (128, 128)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "row_chunk", "accum",
+                                    "interpret"))
+def histogram_pallas(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
+                     row_chunk: int = 1024, accum: str = "float32",
+                     interpret: bool = False) -> jnp.ndarray:
+    """Per-feature gradient histograms via a VMEM-resident Pallas kernel.
+
+    Args:
+      bins: ``(n, f)`` int32 bin indices in ``[0, num_bins)``;
+        num_bins ≤ 256.
+      gh: ``(n, 3)`` float32 (grad, hess, count), pre-masked.
+      accum: "float32" | "bfloat16" — MXU operand precision (accumulation
+        is always f32 via preferred_element_type).
+
+    Returns:
+      ``(f, num_bins, 3)`` float32.
+    """
+    if num_bins > BMAX:
+        raise ValueError(f"pallas histogram supports ≤{BMAX} bins, "
+                         f"got {num_bins}")
+    n, f = bins.shape
+    accum_dtype = jnp.bfloat16 if accum == "bfloat16" else jnp.float32
+
+    c = min(row_chunk, max(128 * ((n + 127) // 128), 128))
+    n_pad = (-n) % c
+    f_pad = (-f) % FB
+    # padded rows point at bin 0 with zero gh weight → no contribution
+    binsT = jnp.pad(bins.T, ((0, f_pad), (0, n_pad)))
+    gh = jnp.pad(gh.astype(jnp.float32), ((0, n_pad), (0, 0)))
+    fp, np_ = binsT.shape
+    nfb = fp // FB
+
+    grid = (nfb, np_ // c)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, accum_dtype=accum_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((FB, c), lambda i, j: (i, j)),
+            pl.BlockSpec((c, 3), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 3, FB * LO, FB * LO),
+                               lambda i, j: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nfb, 3, FB * LO, FB * LO),
+                                       jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((c, FB * LO), accum_dtype),
+            pltpu.VMEM((c, FB * LO), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * np_ * nfb * 128 * 128,
+            bytes_accessed=np_ * fp * 4 + np_ * 12 + nfb * 3 * 128 * 128 * 4,
+            transcendentals=0),
+    )(binsT.astype(jnp.int32), gh)
+    # extract diagonal blocks: out[i, ch, f·16+lo, f·16+hi] → hist
+    out = out.reshape(nfb, 3, FB, LO, FB, LO)
+    diag = out[:, :, jnp.arange(FB), :, jnp.arange(FB), :]  # (FB, nfb, 3, LO, LO)
+    # (FB, nfb, 3, lo, hi) → (nfb, FB, hi, lo, 3) → (f, B, 3)
+    hist = diag.transpose(1, 0, 4, 3, 2).reshape(fp, BMAX, 3)
+    return hist[:f, :num_bins, :]

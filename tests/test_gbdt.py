@@ -226,3 +226,76 @@ class TestReviewRegressions:
         a = m1.getModel().save_native_model_string()
         b = m2.getModel().save_native_model_string()
         assert a != b  # different bagging seeds -> different forests
+
+
+class TestGoss:
+    def test_goss_trains_and_matches_gbdt_quality(self, binary_table):
+        from sklearn.metrics import roc_auc_score
+        kw = dict(numIterations=30, numLeaves=15, verbosity=0)
+        plain = LightGBMClassifier(**kw).fit(binary_table)
+        goss = LightGBMClassifier(boostingType="goss", topRate=0.3,
+                                  otherRate=0.2, **kw).fit(binary_table)
+        y = binary_table["label"]
+        auc_p = roc_auc_score(y, np.asarray(
+            plain.transform(binary_table)["probability"])[:, 1])
+        auc_g = roc_auc_score(y, np.asarray(
+            goss.transform(binary_table)["probability"])[:, 1])
+        assert auc_g > auc_p - 0.02  # sampled fit stays close in quality
+        assert "boosting: goss" in goss.getModel().save_native_model_string()
+
+    def test_goss_deterministic_given_seed(self, binary_table):
+        kw = dict(numIterations=5, boostingType="goss", baggingSeed=7,
+                  verbosity=0)
+        a = LightGBMClassifier(**kw).fit(binary_table)
+        b = LightGBMClassifier(**kw).fit(binary_table)
+        assert a.getModel().save_native_model_string() == \
+            b.getModel().save_native_model_string()
+
+    def test_goss_regressor(self, regression_table):
+        m = LightGBMRegressor(objective="regression", boostingType="goss",
+                              numIterations=10, verbosity=0).fit(
+            regression_table)
+        out = m.transform(regression_table)
+        resid = np.asarray(out["prediction"]) - regression_table["label"]
+        base = regression_table["label"] - regression_table["label"].mean()
+        assert np.mean(resid ** 2) < 0.5 * np.mean(base ** 2)
+
+    def test_goss_rejects_bagging_and_bad_rates(self, binary_table):
+        import pytest
+        with pytest.raises(ValueError, match="bagging in GOSS"):
+            LightGBMClassifier(boostingType="goss", baggingFraction=0.5,
+                               baggingFreq=1, numIterations=2).fit(
+                binary_table)
+        with pytest.raises(ValueError, match="otherRate"):
+            LightGBMClassifier(boostingType="goss", otherRate=0.0,
+                               numIterations=2).fit(binary_table)
+
+
+class TestValScoreScale:
+    def test_val_margins_match_model_margins(self, binary_table):
+        """Early-stopping val scores must equal true model margins (the
+        shrunk trees carry the learning rate already — regression test for
+        the double-lr bug)."""
+        from mmlspark_tpu.gbdt import engine as eng
+        n = len(binary_table["label"])
+        vmask = np.zeros(n, bool)
+        vmask[: n // 4] = True
+        t = dict(binary_table)
+        t["valid"] = vmask.astype(np.float64)
+        captured = {}
+        orig = eng._update_val_scores
+
+        def spy(tree, vb, vs, lr, ms):
+            out = orig(tree, vb, vs, lr, ms)
+            captured["val"] = np.asarray(out)
+            return out
+        eng._update_val_scores = spy
+        try:
+            m = LightGBMClassifier(
+                numIterations=3, validationIndicatorCol="valid",
+                earlyStoppingRound=100, verbosity=0).fit(t)
+        finally:
+            eng._update_val_scores = orig
+        margins = np.asarray(m.getModel().predict_margin(
+            np.asarray(binary_table["features"])[vmask]))
+        assert np.allclose(captured["val"], margins, atol=1e-4)
