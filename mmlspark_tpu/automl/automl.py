@@ -64,6 +64,14 @@ class FindBestModel(_EvalParams, Estimator):
     def getModels(self) -> List[Estimator]:
         return list(self._models)
 
+    def _save_extra(self, path: str) -> None:
+        serialize.save_stage_list(self._models, os.path.join(path, "models"))
+
+    def _load_extra(self, path: str) -> None:
+        p = os.path.join(path, "models")
+        self._models = serialize.load_stage_list(p) if os.path.exists(p) \
+            else []
+
     def _fit(self, table: DataTable) -> "BestModel":
         if not self._models:
             raise ValueError("FindBestModel needs candidate models")
@@ -164,6 +172,23 @@ class RangeHyperParam:
         return [float(v) for v in np.linspace(self.lo, self.hi, n)]
 
 
+def _space_to_json(space) -> Dict[str, Any]:
+    if isinstance(space, (list, tuple)):  # GridSpace accepts raw sequences
+        return {"type": "discrete", "values": list(space)}
+    if isinstance(space, DiscreteHyperParam):
+        return {"type": "discrete", "values": space.values}
+    if isinstance(space, RangeHyperParam):
+        return {"type": "range", "lo": space.lo, "hi": space.hi,
+                "isInt": space.isInt}
+    raise TypeError(f"Cannot serialize hyperparam space {type(space)}")
+
+
+def _space_from_json(obj: Dict[str, Any]):
+    if obj["type"] == "discrete":
+        return DiscreteHyperParam(obj["values"])
+    return RangeHyperParam(obj["lo"], obj["hi"], isInt=obj["isInt"])
+
+
 class HyperparamBuilder:
     """Collects (paramName → space) pairs."""
 
@@ -234,6 +259,22 @@ class TuneHyperparameters(_EvalParams, HasSeed, Estimator):
     def setHyperParams(self, spaces: Dict[str, Any]) -> "TuneHyperparameters":
         self._hyper = dict(spaces)
         return self
+
+    def _save_extra(self, path: str) -> None:
+        serialize.save_stage_list(self._models, os.path.join(path, "models"))
+        serialize.save_json(path, "spaces",
+                            {k: _space_to_json(s)
+                             for k, s in self._hyper.items()})
+
+    def _load_extra(self, path: str) -> None:
+        p = os.path.join(path, "models")
+        self._models = serialize.load_stage_list(p) if os.path.exists(p) \
+            else []
+        try:
+            spaces = serialize.load_json(path, "spaces")
+        except FileNotFoundError:
+            spaces = {}
+        self._hyper = {k: _space_from_json(v) for k, v in spaces.items()}
 
     def _candidates(self) -> List[Dict[str, Any]]:
         if self.getSearchMode() == "grid":

@@ -34,6 +34,16 @@ class TestObject:
     compare_cols: Optional[List[str]] = None
     #: tolerance for numeric comparison
     tol: float = 1e-6
+    #: class name the estimator's ``fit`` must produce — lets the meta-test
+    #: count Model classes as covered, and the serialization test verify the
+    #: declaration (a wrong name fails the assert, so coverage stays honest)
+    fitted_model_cls: Optional[str] = None
+    #: external-IO stages (live REST endpoints) fuzz persistence only, like
+    #: the reference's secret-gated cognitive suites (SURVEY.md §4)
+    serialization_only: bool = False
+    #: reason a scenario cannot round-trip persistence (must be non-empty
+    #: when set); the experiment smoke still runs
+    skip_serialization: Optional[str] = None
 
 
 # class name -> provider returning scenarios

@@ -125,6 +125,37 @@ def load_arrays(path: str, name: str = "arrays") -> Dict[str, np.ndarray]:
         return {k: z[k] for k in z.files}
 
 
+def save_optional_stage(path: str, name: str, stage: Any) -> None:
+    """Persist a possibly-None nested stage under ``path/name``."""
+    if stage is not None:
+        save_stage(stage, os.path.join(path, name), overwrite=True)
+
+
+def load_optional_stage(path: str, name: str) -> Any:
+    p = os.path.join(path, name)
+    return load_stage(p) if os.path.exists(p) else None
+
+
+def save_callable(path: str, name: str, fn: Any) -> None:
+    """Persist a python callable with cloudpickle.
+
+    Same contract as Spark's pickled Python UDFs: the load environment must
+    provide the same modules the function closes over.
+    """
+    import cloudpickle
+    with open(os.path.join(path, f"{name}.pkl"), "wb") as f:
+        cloudpickle.dump(fn, f)
+
+
+def load_callable(path: str, name: str) -> Any:
+    import cloudpickle
+    p = os.path.join(path, f"{name}.pkl")
+    if not os.path.exists(p):
+        return None
+    with open(p, "rb") as f:
+        return cloudpickle.load(f)
+
+
 def save_json(path: str, name: str, obj: Any) -> None:
     with open(os.path.join(path, f"{name}.json"), "w") as f:
         json.dump(obj, f, default=_json_default)

@@ -303,7 +303,7 @@ class Featurize(_FeaturizeParams, Estimator):
                 else:
                     dim = min(num_features, 4096)
                     specs.append({"col": name, "kind": "hash", "dim": dim})
-        model = FeaturizeModel(specs=specs)
+        model = self._model_cls(specs=specs)
         model.setParams(**{k: v for k, v in self._iterSetParams()
                            if model.hasParam(k)})
         return model
@@ -385,3 +385,7 @@ class AssembleFeatures(Featurize):
 
 class AssembleFeaturesModel(FeaturizeModel):
     """Alias model class for API parity."""
+
+
+Featurize._model_cls = FeaturizeModel
+AssembleFeatures._model_cls = AssembleFeaturesModel

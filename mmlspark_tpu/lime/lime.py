@@ -68,6 +68,12 @@ class TabularLIME(_LIMEParams, HasInputCol, HasOutputCol, Estimator):
         self._model = model
         return self
 
+    def _save_extra(self, path: str) -> None:
+        serialize.save_optional_stage(path, "model", self._model)
+
+    def _load_extra(self, path: str) -> None:
+        self._model = serialize.load_optional_stage(path, "model")
+
     def _fit(self, table: DataTable) -> "TabularLIMEModel":
         X = features_matrix(table, self.getInputCol())
         out = TabularLIMEModel(
@@ -157,6 +163,15 @@ class ImageLIME(_LIMEParams, HasInputCol, HasOutputCol, Transformer):
     def setModel(self, model: Transformer) -> "ImageLIME":
         self._model = model
         return self
+
+    def _save_extra(self, path: str) -> None:
+        serialize.save_optional_stage(path, "model", self._model)
+        if self._predict_fn is not None:
+            serialize.save_callable(path, "predict_fn", self._predict_fn)
+
+    def _load_extra(self, path: str) -> None:
+        self._model = serialize.load_optional_stage(path, "model")
+        self._predict_fn = serialize.load_callable(path, "predict_fn")
 
     def _predict(self, imgs: np.ndarray) -> np.ndarray:
         if self._predict_fn is not None:

@@ -102,6 +102,13 @@ class RankingAdapter(Estimator):
         self._recommender = rec
         return self
 
+    def _save_extra(self, path: str) -> None:
+        serialize.save_optional_stage(path, "recommender", self._recommender)
+
+    def _load_extra(self, path: str) -> None:
+        self._recommender = serialize.load_optional_stage(path,
+                                                          "recommender")
+
     def _fit(self, table: DataTable) -> "RankingAdapterModel":
         fitted = self._recommender._fit(table)
         model = RankingAdapterModel(fitted=fitted)
@@ -175,6 +182,17 @@ class RankingTrainValidationSplit(HasSeed, Estimator):
         super().__init__(**kwargs)
         self._estimator = estimator
         self._param_maps = list(estimatorParamMaps or [{}])
+
+    def _save_extra(self, path: str) -> None:
+        serialize.save_optional_stage(path, "estimator", self._estimator)
+        serialize.save_json(path, "param_maps", self._param_maps)
+
+    def _load_extra(self, path: str) -> None:
+        self._estimator = serialize.load_optional_stage(path, "estimator")
+        try:
+            self._param_maps = serialize.load_json(path, "param_maps")
+        except FileNotFoundError:
+            self._param_maps = [{}]
 
     def setEstimator(self, est: Estimator) -> "RankingTrainValidationSplit":
         self._estimator = est

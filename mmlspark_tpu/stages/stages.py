@@ -128,14 +128,22 @@ class Cacher(Transformer):
 
 class UDFTransformer(HasInputCol, HasInputCols, HasOutputCol, Transformer):
     """Applies a python function to one column (rowwise) or several columns
-    (rowwise over tuples) — stages/UDFTransformer.scala.  The function is
-    user code and does not persist; save/load restores params only."""
+    (rowwise over tuples) — stages/UDFTransformer.scala.  The function
+    persists via cloudpickle (Spark's pickled-Python-UDF contract: load in
+    an environment providing the modules it closes over)."""
 
     _udf: Optional[Callable] = None  # survives load_stage's __new__ path
 
     def __init__(self, udf: Optional[Callable] = None, **kwargs):
         super().__init__(**kwargs)
         self._udf = udf
+
+    def _save_extra(self, path: str) -> None:
+        if self._udf is not None:
+            serialize.save_callable(path, "udf", self._udf)
+
+    def _load_extra(self, path: str) -> None:
+        self._udf = serialize.load_callable(path, "udf")
 
     def setUDF(self, udf: Callable) -> "UDFTransformer":
         self._udf = udf
@@ -157,15 +165,21 @@ class UDFTransformer(HasInputCol, HasInputCols, HasOutputCol, Transformer):
 
 
 class Lambda(Transformer):
-    """Arbitrary table→table function (stages/Lambda.scala).  Not
-    persistable (function state), mirroring the reference where Lambda saves
-    only its SQL-free closure marker."""
+    """Arbitrary table→table function (stages/Lambda.scala).  The function
+    persists via cloudpickle, same contract as UDFTransformer."""
 
     _fn: Optional[Callable] = None  # survives load_stage's __new__ path
 
     def __init__(self, transformFunc: Optional[Callable] = None, **kwargs):
         super().__init__(**kwargs)
         self._fn = transformFunc
+
+    def _save_extra(self, path: str) -> None:
+        if self._fn is not None:
+            serialize.save_callable(path, "fn", self._fn)
+
+    def _load_extra(self, path: str) -> None:
+        self._fn = serialize.load_callable(path, "fn")
 
     def setTransform(self, fn: Callable) -> "Lambda":
         self._fn = fn

@@ -78,6 +78,13 @@ class _TrainBase(_TrainParams, Estimator):
                            if model.hasParam(k)})
         return model
 
+    # unfitted estimator persistence: the wrapped learner is real state
+    def _save_extra(self, path: str) -> None:
+        serialize.save_optional_stage(path, "model", self._model)
+
+    def _load_extra(self, path: str) -> None:
+        self._model = serialize.load_optional_stage(path, "model")
+
 
 class _TrainedModel(_TrainParams, Model):
     __abstractstage__ = True

@@ -1,0 +1,175 @@
+"""Structural fuzzing meta-suite.
+
+The reference's signature testing idea (SURVEY.md §4): every public stage
+must declare test objects, and the suite derives serialization round-trips
+plus fit→transform smoke tests automatically.  ``test_meta_every_stage_
+covered`` reflects over ``STAGE_REGISTRY`` exactly as the reference's
+"FuzzingTest" reflects over the jar — adding a stage without registering a
+provider (tests/fuzzing_providers.py) fails the build.
+"""
+
+import importlib
+import pkgutil
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu
+from mmlspark_tpu.core import fuzzing
+from mmlspark_tpu.core.pipeline import (Estimator, Model, STAGE_REGISTRY,
+                                        Transformer)
+from mmlspark_tpu.core.schema import DataTable
+
+# import every module so STAGE_REGISTRY is complete
+for _m in pkgutil.walk_packages(mmlspark_tpu.__path__, "mmlspark_tpu."):
+    importlib.import_module(_m.name)
+
+import fuzzing_providers  # noqa: E402  (registers all providers)
+
+PROVIDERS = fuzzing.all_providers()
+
+
+def _declared_model_classes():
+    declared = set()
+    for name, provider in PROVIDERS.items():
+        for to in provider():
+            if to.fitted_model_cls:
+                declared.add(to.fitted_model_cls)
+    return declared
+
+
+def test_meta_every_stage_covered():
+    """Every registry entry: provider, declared fitted model, or reasoned
+    exemption.  This is the structural-coverage enforcement gate."""
+    declared_models = _declared_model_classes()
+    missing = []
+    for name, cls in sorted(STAGE_REGISTRY.items()):
+        if name in PROVIDERS or name in fuzzing.EXEMPT:
+            continue
+        if issubclass(cls, Model) and name in declared_models:
+            continue
+        missing.append(name)
+    assert not missing, (
+        f"Stages with no fuzzing provider, no fitted_model_cls declaration "
+        f"and no EXEMPT reason: {missing} — register them in "
+        f"tests/fuzzing_providers.py")
+
+
+def test_meta_exemptions_have_reasons():
+    for name, reason in fuzzing.EXEMPT.items():
+        assert isinstance(reason, str) and len(reason) >= 10, (
+            f"EXEMPT[{name!r}] needs a real reason")
+        assert name in STAGE_REGISTRY, (
+            f"EXEMPT[{name!r}] names an unknown stage")
+
+
+def test_meta_declared_model_classes_exist():
+    for cls_name in _declared_model_classes():
+        assert cls_name in STAGE_REGISTRY, (
+            f"fitted_model_cls={cls_name!r} is not a registered stage")
+
+
+# -- derived tests ------------------------------------------------------------
+
+def _assert_tables_match(a: DataTable, b: DataTable, cols, tol):
+    if cols is None:
+        cols = [c for c in a.columns if c in b.columns]
+    for c in cols:
+        va, vb = np.asarray(a[c]), np.asarray(b[c])
+        assert va.shape == vb.shape, f"column {c}: {va.shape} != {vb.shape}"
+        if va.dtype == object or vb.dtype == object:
+            for ea, eb in zip(va.ravel(), vb.ravel()):
+                ea_arr = np.asarray(ea)
+                eb_arr = np.asarray(eb)
+                if ea_arr.dtype.kind in "fc":
+                    np.testing.assert_allclose(ea_arr, eb_arr, atol=tol,
+                                               rtol=tol)
+                else:
+                    assert np.array_equal(ea_arr, eb_arr), f"column {c}"
+        elif va.dtype.kind in "fc":
+            np.testing.assert_allclose(va, vb, atol=tol, rtol=tol,
+                                       err_msg=f"column {c}")
+        else:
+            assert np.array_equal(va, vb), f"column {c} differs"
+
+
+def _comparable_params(stage):
+    out = {}
+    for k, v in stage._iterSetParams():
+        try:
+            import json
+            json.dumps(v, default=str)
+        except (TypeError, ValueError):
+            v = f"<unserializable {type(v).__name__}>"
+        out[k] = v
+    return out
+
+
+@pytest.mark.parametrize("name", sorted(PROVIDERS))
+def test_serialization_fuzzing(name, tmp_path):
+    """Save/load round-trip of the stage and (for estimators) its fitted
+    model; re-run and compare outputs (reference SerializationFuzzing)."""
+    scenarios = PROVIDERS[name]()
+    assert scenarios, f"provider for {name} returned no scenarios"
+    if all(to.skip_serialization for to in scenarios):
+        pytest.skip(f"{name}: {scenarios[0].skip_serialization}")
+    for i, to in enumerate(scenarios):
+        if to.skip_serialization:
+            continue  # other scenarios of this provider still run
+        stage = to.stage
+        p = str(tmp_path / f"{name}_{i}")
+        stage.save(p)
+        loaded = type(stage).load(p)
+        assert type(loaded) is type(stage)
+        assert _comparable_params(loaded) == _comparable_params(stage)
+        if to.serialization_only:
+            continue
+
+        if isinstance(stage, Estimator):
+            assert to.fitting_data is not None, (
+                f"{name} scenario {i}: estimator without fitting_data")
+            model = stage.fit(to.fitting_data)
+            if to.fitted_model_cls:
+                assert type(model).__name__ == to.fitted_model_cls, (
+                    f"{name} declared fitted_model_cls="
+                    f"{to.fitted_model_cls} but fit produced "
+                    f"{type(model).__name__}")
+            data = (to.transform_data if to.transform_data is not None
+                    else to.fitting_data)
+            out = model.transform(data)
+            # loaded estimator must fit and produce matching outputs
+            out_loaded_fit = loaded.fit(to.fitting_data).transform(data)
+            _assert_tables_match(out, out_loaded_fit, to.compare_cols,
+                                 to.tol)
+            # fitted model round-trip
+            mp = str(tmp_path / f"{name}_{i}_model")
+            model.save(mp)
+            model_loaded = type(model).load(mp)
+            out2 = model_loaded.transform(data)
+            _assert_tables_match(out, out2, to.compare_cols, to.tol)
+        else:
+            assert to.transform_data is not None, (
+                f"{name} scenario {i}: transformer without transform_data")
+            out = stage.transform(to.transform_data)
+            out2 = loaded.transform(to.transform_data)
+            _assert_tables_match(out, out2, to.compare_cols, to.tol)
+
+
+@pytest.mark.parametrize("name", sorted(PROVIDERS))
+def test_experiment_fuzzing(name):
+    """fit→transform smoke execution (reference ExperimentFuzzing)."""
+    scenarios = PROVIDERS[name]()
+    if all(to.serialization_only for to in scenarios):
+        pytest.skip(f"{name}: external-IO stage, persistence-only")
+    for to in scenarios:
+        if to.serialization_only:
+            continue
+        stage = to.stage
+        if isinstance(stage, Estimator):
+            model = stage.fit(to.fitting_data)
+            data = (to.transform_data if to.transform_data is not None
+                    else to.fitting_data)
+            out = model.transform(data)
+        else:
+            out = stage.transform(to.transform_data)
+        assert out is not None and len(out.columns) >= 1
