@@ -115,6 +115,26 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                             "TPU histogram backend: auto, dot16, onehot, "
                             "segment, pallas, pallas_bf16", default="auto",
                             typeConverter=TypeConverters.toString)
+    categoricalSlotIndexes = Param(
+        "categoricalSlotIndexes",
+        "Feature indexes treated as categorical (reference "
+        "LightGBMParams.categoricalSlotIndexes)", default=None,
+        typeConverter=TypeConverters.toListInt)
+    categoricalSlotNames = Param(
+        "categoricalSlotNames",
+        "Feature names treated as categorical (resolved against the "
+        "features column names)", default=None,
+        typeConverter=TypeConverters.toListString)
+    catSmooth = Param("catSmooth", "Categorical smoothing (cat_smooth)",
+                      default=10.0, typeConverter=TypeConverters.toFloat)
+    catL2 = Param("catL2", "Extra L2 for categorical splits (cat_l2)",
+                  default=10.0, typeConverter=TypeConverters.toFloat)
+    maxCatThreshold = Param(
+        "maxCatThreshold", "Max categories on the smaller split side",
+        default=32, typeConverter=TypeConverters.toInt)
+    maxCatToOnehot = Param(
+        "maxCatToOnehot", "Cardinality at or below which one-vs-rest "
+        "splits are used", default=4, typeConverter=TypeConverters.toInt)
     passThroughArgs = Param("passThroughArgs",
                             "Raw 'key=value key=value' LightGBM param string "
                             "recorded into the model file",
@@ -149,6 +169,10 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
             other_rate=self.getOtherRate(),
             histogram_method=self.getHistogramMethod(),
             verbosity=self.getVerbosity(),
+            cat_smooth=self.getCatSmooth(),
+            cat_l2=self.getCatL2(),
+            max_cat_threshold=self.getMaxCatThreshold(),
+            max_cat_to_onehot=self.getMaxCatToOnehot(),
             pass_through=pass_through,
         )
 
@@ -209,8 +233,19 @@ class LightGBMBase(Estimator, LightGBMParams):
         objective = get_objective(obj_name, num_class=num_class,
                                   **self._objective_kwargs())
 
+        feature_names = list(
+            getattr(table[self.getFeaturesCol()], "columns", [])) or None
+        cat_idx = list(self.getCategoricalSlotIndexes() or [])
+        for nm in self.getCategoricalSlotNames() or []:
+            if not feature_names or nm not in feature_names:
+                raise ValueError(
+                    f"categoricalSlotNames: {nm!r} not found among feature "
+                    f"columns {feature_names}")
+            cat_idx.append(feature_names.index(nm))
+        cat_idx = sorted(set(cat_idx))
         mapper = fit_bin_mapper(X[train_idx], max_bin=self.getMaxBin(),
-                                seed=self.getSeed())
+                                seed=self.getSeed(),
+                                categorical_features=cat_idx or None)
         bins = mapper.transform(X[train_idx])
         y_train = y[train_idx]
         w_train = w[train_idx] if w is not None else None
@@ -228,8 +263,6 @@ class LightGBMBase(Estimator, LightGBMParams):
             )
 
         params = self._train_params()
-        feature_names = list(
-            getattr(table[self.getFeaturesCol()], "columns", [])) or None
         grad_override = self._grad_fn_override(table, train_idx, y_train,
                                                w_train)
         # Distributed by default when a mesh is available, like the

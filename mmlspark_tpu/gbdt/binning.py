@@ -26,16 +26,38 @@ import numpy as np
 
 @dataclass
 class BinMapper:
-    """Per-feature binning spec: ``upper_bounds[f]`` sorted ascending."""
+    """Per-feature binning spec: ``upper_bounds[f]`` sorted ascending.
+
+    Categorical features (``categorical[f]``) bin by category identity
+    instead: ``cat_values[f]`` lists the raw (non-negative integer) category
+    per bin index, most-frequent first — the analog of LightGBM's
+    categorical ``BinMapper`` (bin_type=categorical).  Unseen categories and
+    NaN map to ``missing_bin``.
+    """
 
     upper_bounds: List[np.ndarray]   # len f, each (num_bins_f - 1,) finite
     has_missing: np.ndarray          # (f,) bool
     num_total_bins: int              # B used for histogram sizing (max over f)
     missing_bin: int                 # index reserved for NaN (== B - 1)
+    categorical: Optional[np.ndarray] = None   # (f,) bool
+    cat_values: Optional[List[Optional[np.ndarray]]] = None  # raw cat per bin
 
     @property
     def num_features(self) -> int:
         return len(self.upper_bounds)
+
+    @property
+    def has_categorical(self) -> bool:
+        return self.categorical is not None and bool(self.categorical.any())
+
+    def is_categorical(self, j: int) -> bool:
+        return self.categorical is not None and bool(self.categorical[j])
+
+    def feature_num_bins(self, j: int) -> int:
+        """Value bins actually used by feature j (excl. the missing bin)."""
+        if self.is_categorical(j):
+            return len(self.cat_values[j])
+        return len(self.upper_bounds[j]) + 1
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Map raw features to bin indices ``(n, f)``, NaN → missing_bin."""
@@ -46,11 +68,25 @@ class BinMapper:
         out = np.empty((n, f), dtype=np.int32)
         for j in range(f):
             col = X[:, j]
+            if self.is_categorical(j):
+                out[:, j] = self._transform_cat(col, j)
+                continue
             out[:, j] = np.searchsorted(self.upper_bounds[j], col, side="left")
             nan_mask = np.isnan(col)
             if nan_mask.any():
                 out[nan_mask, j] = self.missing_bin
         return out
+
+    def _transform_cat(self, col: np.ndarray, j: int) -> np.ndarray:
+        cats = self.cat_values[j]                       # bin -> raw value
+        order = np.argsort(cats)
+        sorted_cats = cats[order]
+        vals = np.nan_to_num(col, nan=-1.0).astype(np.int64)
+        pos = np.searchsorted(sorted_cats, vals)
+        pos = np.clip(pos, 0, len(sorted_cats) - 1)
+        hit = sorted_cats[pos] == vals
+        bins = np.where(hit, order[pos], self.missing_bin)
+        return bins.astype(np.int32)
 
     def bin_threshold_value(self, feature: int, bin_idx: int) -> float:
         """Real-valued threshold for a split at ``bin <= bin_idx``.
@@ -65,10 +101,14 @@ class BinMapper:
         return float(ub[bin_idx])
 
     def feature_infos(self) -> List[str]:
-        """LightGBM model-file ``feature_infos`` entries ([min:max] per feat)."""
+        """LightGBM model-file ``feature_infos`` entries: [min:max] for
+        numeric features, colon-joined category list for categorical."""
         infos = []
-        for ub in self.upper_bounds:
-            if len(ub) == 0:
+        for j, ub in enumerate(self.upper_bounds):
+            if self.is_categorical(j):
+                cats = np.sort(self.cat_values[j])
+                infos.append(":".join(str(int(c)) for c in cats) or "none")
+            elif len(ub) == 0:
                 infos.append("none")
             else:
                 infos.append(f"[{ub[0]:.6g}:{ub[-1]:.6g}]")
@@ -78,11 +118,18 @@ class BinMapper:
 def fit_bin_mapper(X: np.ndarray, max_bin: int = 255,
                    sample_cnt: int = 200000,
                    min_data_in_bin: int = 3,
-                   seed: int = 0) -> BinMapper:
+                   seed: int = 0,
+                   categorical_features: Optional[List[int]] = None
+                   ) -> BinMapper:
     """Learn per-feature bin upper bounds (GreedyFindBin analog).
 
     ``max_bin`` counts value bins; one extra trailing bin is reserved for
     missing values, giving ``num_total_bins = max_bin + 1``.
+
+    ``categorical_features``: column indexes binned by category identity
+    (raw values must be non-negative integers, LightGBM's contract); the
+    ``max_bin - 1`` most frequent categories get bins, the rest join the
+    missing bin.
     """
     n, f = X.shape
     if n > sample_cnt:
@@ -91,18 +138,46 @@ def fit_bin_mapper(X: np.ndarray, max_bin: int = 255,
         sample = X[idx]
     else:
         sample = X
+    cat_set = set(int(c) for c in (categorical_features or []))
+    for c in cat_set:
+        if not 0 <= c < f:
+            raise ValueError(
+                f"categorical feature index {c} out of range [0, {f})")
     bounds: List[np.ndarray] = []
     has_missing = np.zeros(f, dtype=bool)
+    categorical = np.zeros(f, dtype=bool)
+    cat_values: List[Optional[np.ndarray]] = [None] * f
     for j in range(f):
         col = sample[:, j]
         nan = np.isnan(col)
         has_missing[j] = bool(nan.any())
         col = col[~nan]
-        bounds.append(_find_bounds(col, max_bin, min_data_in_bin))
+        if j in cat_set:
+            categorical[j] = True
+            cat_values[j] = _find_categories(col, max_bin, j)
+            bounds.append(np.empty(0, dtype=np.float64))
+        else:
+            bounds.append(_find_bounds(col, max_bin, min_data_in_bin))
     num_total_bins = max_bin + 1
     return BinMapper(upper_bounds=bounds, has_missing=has_missing,
                      num_total_bins=num_total_bins,
-                     missing_bin=num_total_bins - 1)
+                     missing_bin=num_total_bins - 1,
+                     categorical=categorical if cat_set else None,
+                     cat_values=cat_values if cat_set else None)
+
+
+def _find_categories(col: np.ndarray, max_bin: int, j: int) -> np.ndarray:
+    if col.size and (col < 0).any():
+        raise ValueError(
+            f"Categorical feature {j} has negative values; categories must "
+            "be non-negative integers (LightGBM contract)")
+    ints = col.astype(np.int64)
+    if col.size and not np.array_equal(ints, col):
+        raise ValueError(
+            f"Categorical feature {j} has non-integer values")
+    vals, counts = np.unique(ints, return_counts=True)
+    order = np.argsort(-counts, kind="stable")   # most frequent first
+    return vals[order][:max_bin - 1].astype(np.int64)
 
 
 def _find_bounds(col: np.ndarray, max_bin: int,

@@ -46,6 +46,16 @@ class HostTree:
     internal_weight: np.ndarray  # (m,) f64
     internal_count: np.ndarray  # (m,) i64
     shrinkage: float = 1.0
+    #: categorical splits (LightGBM layout): for a node with
+    #: decision_type bit0 set, ``threshold`` holds an index j into
+    #: ``cat_boundaries``; words ``cat_threshold[cat_boundaries[j]:
+    #: cat_boundaries[j+1]]`` form a bitset over raw category values —
+    #: bit set → value goes LEFT.
+    num_cat: int = 0
+    cat_boundaries: np.ndarray = field(
+        default_factory=lambda: np.zeros(1, np.int32))
+    cat_threshold: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.uint32))
 
     @property
     def num_leaves(self) -> int:
@@ -72,12 +82,36 @@ def host_tree_from_arrays(tree: TreeArrays, mapper: BinMapper,
     m = max(num_leaves - 1, 0)
     feat = np.asarray(tree.node_feat)[:m]
     bins = np.asarray(tree.node_bin)[:m]
+    is_cat = np.asarray(tree.node_is_cat)[:m] > 0
+    cat_bits = np.asarray(tree.node_cat_bits)[:m]
     thr = np.array([mapper.bin_threshold_value(int(f), int(b))
                     for f, b in zip(feat, bins)], dtype=np.float64)
     # decision_type: numerical split; missing (NaN) routes right in training
     # (missing bin is the trailing bin), i.e. default_left = false.
     dt = np.where(mapper.has_missing[feat] if m else np.zeros(0, bool),
                   8, 2).astype(np.int32)  # 8 = missing:NaN, 2 = default-left
+    num_cat = 0
+    cat_boundaries = [0]
+    cat_words: List[np.ndarray] = []
+    if is_cat.any():
+        for i in np.flatnonzero(is_cat):
+            f_i = int(feat[i])
+            cats = mapper.cat_values[f_i]
+            bits = cat_bits[i]
+            left_bins = [b for b in range(len(cats))
+                         if (bits[b >> 5] >> (b & 31)) & 1]
+            left_cats = sorted(int(cats[b]) for b in left_bins)
+            missing_left = bool(
+                (bits[missing_bin >> 5] >> (missing_bin & 31)) & 1)
+            nwords = (max(left_cats, default=0) // 32) + 1
+            words = np.zeros(nwords, np.uint32)
+            for c in left_cats:
+                words[c >> 5] |= np.uint32(1) << np.uint32(c & 31)
+            dt[i] = 1 | (2 if missing_left else 0)
+            thr[i] = float(num_cat)       # index into cat_boundaries
+            cat_words.append(words)
+            cat_boundaries.append(cat_boundaries[-1] + nwords)
+            num_cat += 1
     return HostTree(
         split_feature=feat.astype(np.int32),
         threshold=thr,
@@ -93,6 +127,10 @@ def host_tree_from_arrays(tree: TreeArrays, mapper: BinMapper,
         internal_weight=np.asarray(tree.node_weight, np.float64)[:m],
         internal_count=np.asarray(tree.node_count, np.float64)[:m]
             .astype(np.int64),
+        num_cat=num_cat,
+        cat_boundaries=np.asarray(cat_boundaries, np.int32),
+        cat_threshold=(np.concatenate(cat_words).astype(np.uint32)
+                       if cat_words else np.zeros(0, np.uint32)),
     )
 
 
@@ -149,6 +187,8 @@ class Booster:
             v[low] = np.nextafter(v[low], np.float32(np.inf))
             return v
 
+        ncat_max = max(max(t.num_cat for t in self.trees), 1)
+        words_max = max(max(len(t.cat_threshold) for t in self.trees), 1)
         stacked = {
             "feat": pad([t.split_feature for t in self.trees], m, np.int32),
             "thr": pad([thr32(t) for t in self.trees], m, np.float32),
@@ -157,7 +197,18 @@ class Booster:
             "leaf": pad([t.leaf_value for t in self.trees], L, np.float32),
             "single": np.array(
                 [t.num_leaves <= 1 for t in self.trees], np.bool_),
+            "is_cat": pad([(t.decision_type & 1).astype(np.int32)
+                           for t in self.trees], m, np.int32),
+            "dleft": pad([((t.decision_type & 2) >> 1).astype(np.int32)
+                          for t in self.trees], m, np.int32),
+            # zero-padded; padded entries are only read for numeric nodes
+            # whose categorical branch result is discarded
+            "cat_bnd": pad([t.cat_boundaries for t in self.trees],
+                           ncat_max + 1, np.int32),
+            "cat_words": pad([t.cat_threshold for t in self.trees],
+                             words_max, np.uint32),
             "depth": depth,
+            "has_cat": any(t.num_cat > 0 for t in self.trees),
         }
         self._stacked = {k: (jnp.asarray(v) if isinstance(v, np.ndarray)
                              else v) for k, v in stacked.items()}
@@ -183,7 +234,10 @@ class Booster:
         margins = _predict_forest(X, s["feat"][:use_t], s["thr"][:use_t],
                                   s["left"][:use_t], s["right"][:use_t],
                                   s["leaf"][:use_t], s["single"][:use_t],
-                                  s["depth"], K)
+                                  s["is_cat"][:use_t], s["dleft"][:use_t],
+                                  s["cat_bnd"][:use_t],
+                                  s["cat_words"][:use_t],
+                                  s["depth"], K, s["has_cat"])
         margins = margins + self.init_score
         return margins[:, 0] if K == 1 else margins
 
@@ -208,7 +262,9 @@ class Booster:
         if s is None:
             return jnp.zeros((X.shape[0], 0), jnp.int32)
         return _predict_leaves(X, s["feat"], s["thr"], s["left"], s["right"],
-                               s["single"], s["depth"])
+                               s["single"], s["is_cat"], s["dleft"],
+                               s["cat_bnd"], s["cat_words"], s["depth"],
+                               s["has_cat"])
 
     # -- feature importance --------------------------------------------------
 
@@ -242,7 +298,7 @@ class Booster:
             tb = io.StringIO()
             tb.write(f"Tree={i}\n")
             tb.write(f"num_leaves={t.num_leaves}\n")
-            tb.write("num_cat=0\n")
+            tb.write(f"num_cat={t.num_cat}\n")
             if t.num_leaves > 1:
                 tb.write(_arr_line("split_feature", t.split_feature))
                 tb.write(_arr_line("split_gain", t.split_gain))
@@ -256,6 +312,9 @@ class Booster:
                 tb.write(_arr_line("internal_value", t.internal_value))
                 tb.write(_arr_line("internal_weight", t.internal_weight))
                 tb.write(_arr_line("internal_count", t.internal_count))
+                if t.num_cat > 0:
+                    tb.write(_arr_line("cat_boundaries", t.cat_boundaries))
+                    tb.write(_arr_line("cat_threshold", t.cat_threshold))
             else:
                 tb.write(_arr_line("leaf_value", t.leaf_value))
             tb.write("is_linear=0\n")
@@ -305,14 +364,9 @@ class Booster:
             if "num_leaves" not in kv:
                 continue
             L = int(kv["num_leaves"])
-            if int(kv.get("num_cat", 0)) != 0:
-                raise NotImplementedError(
-                    "categorical splits not yet supported by the importer")
+            num_cat = int(kv.get("num_cat", 0))
             if L > 1:
                 dt = _parse_arr(kv["decision_type"], np.int32)
-                if np.any(dt & 1):
-                    raise NotImplementedError(
-                        "categorical decision_type not supported")
                 trees.append(HostTree(
                     split_feature=_parse_arr(kv["split_feature"], np.int32),
                     threshold=_parse_arr(kv["threshold"], np.float64),
@@ -333,6 +387,15 @@ class Booster:
                     internal_count=_parse_arr(
                         kv.get("internal_count", "0"), np.int64),
                     shrinkage=float(kv.get("shrinkage", 1.0)),
+                    num_cat=num_cat,
+                    cat_boundaries=(_parse_arr(kv["cat_boundaries"],
+                                               np.int64).astype(np.int32)
+                                    if num_cat > 0
+                                    else np.zeros(1, np.int32)),
+                    cat_threshold=(_parse_arr(kv["cat_threshold"],
+                                              np.int64).astype(np.uint32)
+                                   if num_cat > 0
+                                   else np.zeros(0, np.uint32)),
                 ))
             else:
                 lv = _parse_arr(kv["leaf_value"], np.float64)
@@ -391,16 +454,36 @@ def _param_from_str(s: str, key: str, default: float) -> float:
     return float(m.group(1)) if m else default
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "num_class"))
-def _predict_forest(X, feat, thr, left, right, leaf, single, depth,
-                    num_class):
+def _cat_go_left(x, j, tdleft_node, cat_bnd, cat_words):
+    """Raw-value categorical decision: x in node j's bitset → left.
+
+    NaN routes by the node's default_left bit; negative / out-of-range
+    values (unseen categories) route right, matching LightGBM.
+    """
+    j = jnp.clip(j, 0, cat_bnd.shape[0] - 2)
+    b0 = cat_bnd[j]
+    b1 = cat_bnd[j + 1]
+    xnan = jnp.isnan(x)
+    c = jnp.where(xnan, -1.0, x).astype(jnp.int32)
+    widx = b0 + (c >> 5)
+    ok = (c >= 0) & (widx < b1)
+    word = cat_words[jnp.clip(widx, 0, cat_words.shape[0] - 1)]
+    bit = ((word >> (c & 31).astype(jnp.uint32)) & 1).astype(bool)
+    return jnp.where(xnan, tdleft_node > 0, ok & bit)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("depth", "num_class", "has_cat"))
+def _predict_forest(X, feat, thr, left, right, leaf, single, is_cat, dleft,
+                    cat_bnd, cat_words, depth, num_class, has_cat=True):
     """Sum tree outputs: scan over trees, fixed-depth gather walk per tree."""
     n = X.shape[0]
     K = num_class
 
     def one_tree(carry, tree):
         scores = carry
-        tfeat, tthr, tleft, tright, tleaf, tsingle, k = tree
+        (tfeat, tthr, tleft, tright, tleaf, tsingle, tcat, tdleft,
+         tbnd, twords, k) = tree
         node = jnp.where(tsingle, jnp.full(n, -1, jnp.int32),
                          jnp.zeros(n, jnp.int32))
 
@@ -410,6 +493,10 @@ def _predict_forest(X, feat, thr, left, right, leaf, single, depth,
             f = tfeat[safe]
             x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
             go_left = x <= tthr[safe]
+            if has_cat:  # static: numeric-only forests skip the bitset walk
+                left_cat = _cat_go_left(x, tthr[safe].astype(jnp.int32),
+                                        tdleft[safe], tbnd, twords)
+                go_left = jnp.where(tcat[safe] > 0, left_cat, go_left)
             nxt = jnp.where(go_left, tleft[safe], tright[safe])
             return jnp.where(is_leaf, node, nxt)
 
@@ -421,16 +508,19 @@ def _predict_forest(X, feat, thr, left, right, leaf, single, depth,
     ks = jnp.arange(feat.shape[0], dtype=jnp.int32) % K
     init = jnp.zeros((n, K), jnp.float32)
     out, _ = jax.lax.scan(one_tree, init,
-                          (feat, thr, left, right, leaf, single, ks))
+                          (feat, thr, left, right, leaf, single, is_cat,
+                           dleft, cat_bnd, cat_words, ks))
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("depth",))
-def _predict_leaves(X, feat, thr, left, right, single, depth):
+@functools.partial(jax.jit, static_argnames=("depth", "has_cat"))
+def _predict_leaves(X, feat, thr, left, right, single, is_cat, dleft,
+                    cat_bnd, cat_words, depth, has_cat=True):
     n = X.shape[0]
 
     def one_tree(_, tree):
-        tfeat, tthr, tleft, tright, tsingle = tree
+        tfeat, tthr, tleft, tright, tsingle, tcat, tdleft, tbnd, twords = \
+            tree
         node = jnp.where(tsingle, jnp.full(n, -1, jnp.int32),
                          jnp.zeros(n, jnp.int32))
 
@@ -439,12 +529,18 @@ def _predict_leaves(X, feat, thr, left, right, single, depth):
             safe = jnp.maximum(node, 0)
             f = tfeat[safe]
             x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-            nxt = jnp.where(x <= tthr[safe], tleft[safe], tright[safe])
+            go_left = x <= tthr[safe]
+            if has_cat:  # static: numeric-only forests skip the bitset walk
+                left_cat = _cat_go_left(x, tthr[safe].astype(jnp.int32),
+                                        tdleft[safe], tbnd, twords)
+                go_left = jnp.where(tcat[safe] > 0, left_cat, go_left)
+            nxt = jnp.where(go_left, tleft[safe], tright[safe])
             return jnp.where(is_leaf, node, nxt)
 
         node = jax.lax.fori_loop(0, depth, body, node)
         return None, -(node + 1)
 
     _, leaves = jax.lax.scan(one_tree, None,
-                             (feat, thr, left, right, single))
+                             (feat, thr, left, right, single, is_cat,
+                              dleft, cat_bnd, cat_words))
     return leaves.T.astype(jnp.int32)

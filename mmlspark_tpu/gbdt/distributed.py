@@ -85,18 +85,18 @@ def make_boost_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
     """
     cfg = _sharded_cfg(mesh, cfg)
 
-    def step(bins, scores, labels, weights, bag, fmask, k):
+    def step(bins, scores, labels, weights, bag, feat_info, k):
         del k
         g, h = obj.grad_hess(scores, labels, weights)
         gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, fmask, cfg)
+        tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
         scores = scores + lr * tree.leaf_value[row_leaf]
         return apply_shrinkage(tree, lr), scores
 
     mapped = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(DATA_AXIS), P(FEATURE_AXIS), P()),
+                  P(DATA_AXIS), P(DATA_AXIS), P(FEATURE_AXIS, None), P()),
         out_specs=(P(), P(DATA_AXIS)),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(1,))
@@ -118,11 +118,11 @@ def make_multiclass_steps(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
         out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
         check_vma=False))
 
-    def step_k(bins, scores, g, h, bag, fmask, k):
+    def step_k(bins, scores, g, h, bag, feat_info, k):
         gk = jnp.take(g, k, axis=1)
         hk = jnp.take(h, k, axis=1)
         gh = jnp.stack([gk * bag, hk * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, fmask, cfg)
+        tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
         delta = lr * tree.leaf_value[row_leaf]
         scores = scores + delta[:, None] * jax.nn.one_hot(
             k, num_class, dtype=scores.dtype)[None, :]
@@ -132,7 +132,7 @@ def make_multiclass_steps(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
         step_k, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS, None),
                   P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS),
-                  P(FEATURE_AXIS), P()),
+                  P(FEATURE_AXIS, None), P()),
         out_specs=(P(), P(DATA_AXIS, None)),
         check_vma=False), donate_argnums=(1,))
     return grads_mapped, step_mapped

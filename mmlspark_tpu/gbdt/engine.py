@@ -55,6 +55,11 @@ class TrainParams:
     other_rate: float = 0.1
     histogram_method: str = "auto"
     verbosity: int = 1
+    #: categorical split knobs (LightGBM names)
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
     #: raw passthrough params recorded into the model file (parity with the
     #: reference's passThroughArgs; engine-known keys override these)
     pass_through: Dict[str, str] = field(default_factory=dict)
@@ -62,12 +67,12 @@ class TrainParams:
 
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"),
                    donate_argnums=(1,))
-def _boost_step(bins, scores, labels, weights, bag_mask, feature_mask,
+def _boost_step(bins, scores, labels, weights, bag_mask, feat_info,
                 obj: Objective, cfg: GrowerConfig, lr: float):
     """One boosting iteration for a single tree (single-class)."""
     g, h = obj.grad_hess(scores, labels, weights)
     gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
-    tree, row_leaf = _grow_tree_impl(bins, gh, feature_mask, cfg)
+    tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
     scores = scores + lr * tree.leaf_value[row_leaf]
     tree = apply_shrinkage(tree, lr)
     return tree, scores
@@ -81,7 +86,7 @@ def _grad_hess_jit(scores, labels, weights, obj: Objective):
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr", "k1", "k2",
                                              "amp"),
                    donate_argnums=(1,))
-def _boost_step_goss(bins, scores, labels, weights, key, feature_mask,
+def _boost_step_goss(bins, scores, labels, weights, key, feat_info,
                      obj: Objective, cfg: GrowerConfig, lr: float,
                      k1: int, k2: int, amp: float):
     """One GOSS iteration: grow the tree on top-|g·h| rows plus an amplified
@@ -106,7 +111,7 @@ def _boost_step_goss(bins, scores, labels, weights, key, feature_mask,
     gh = jnp.stack([jnp.take(g, idx) * amp_vec,
                     jnp.take(h, idx) * amp_vec,
                     jnp.ones(k1 + k2, jnp.float32)], axis=1)
-    tree, _ = _grow_tree_impl(bins_g, gh, feature_mask, cfg)
+    tree, _ = _grow_tree_impl(bins_g, gh, feat_info, cfg)
     scores = scores + lr * predict_tree_binned(tree, bins, cfg.num_leaves)
     tree = apply_shrinkage(tree, lr)
     return tree, scores
@@ -114,7 +119,7 @@ def _boost_step_goss(bins, scores, labels, weights, key, feature_mask,
 
 @functools.partial(jax.jit, static_argnames=("cfg", "lr", "k"),
                    donate_argnums=(1,))
-def _boost_step_class_k(bins, scores, g, h, bag_mask, feature_mask,
+def _boost_step_class_k(bins, scores, g, h, bag_mask, feat_info,
                         cfg: GrowerConfig, lr: float, k: int):
     """Grow class k's tree from grad/hess computed ONCE per iteration.
 
@@ -123,7 +128,7 @@ def _boost_step_class_k(bins, scores, g, h, bag_mask, feature_mask,
     re-deriving gradients after earlier classes' score updates.
     """
     gh = jnp.stack([g[:, k] * bag_mask, h[:, k] * bag_mask, bag_mask], axis=1)
-    tree, row_leaf = _grow_tree_impl(bins, gh, feature_mask, cfg)
+    tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
     scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
     tree = apply_shrinkage(tree, lr)
     return tree, scores
@@ -148,13 +153,20 @@ def _pack_trees(trees: List[TreeArrays]) -> jnp.ndarray:
     """
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
     f32 = lambda a: a.astype(jnp.float32)  # noqa: E731
+    T = stacked.node_cat_bits.shape[0]
+    bits = stacked.node_cat_bits.reshape(T, -1)
+    # u32 words don't fit f32 exactly; ship two u16 halves (both exact)
+    bits_lo = f32(bits & jnp.uint32(0xFFFF))
+    bits_hi = f32(bits >> jnp.uint32(16))
     return jnp.concatenate([
         f32(stacked.num_leaves)[:, None],
         f32(stacked.node_feat), f32(stacked.node_bin),
         f32(stacked.node_left), f32(stacked.node_right),
         stacked.node_gain, stacked.node_value,
         stacked.node_weight, stacked.node_count,
+        f32(stacked.node_is_cat),
         stacked.leaf_value, stacked.leaf_weight, stacked.leaf_count,
+        bits_lo, bits_hi,
     ], axis=1)
 
 
@@ -170,11 +182,14 @@ def _fetch_host_trees(trees_dev: List[TreeArrays], num_leaves: int,
     packed = np.asarray(_pack_trees(
         trees_dev + [trees_dev[0]] * (bucket - T)))[:T]
     L, m = num_leaves, num_leaves - 1
-    offs = np.cumsum([1] + [m] * 8 + [L] * 3)
+    W = trees_dev[0].node_cat_bits.shape[-1]
+    offs = np.cumsum([1] + [m] * 9 + [L] * 3 + [m * W] * 2)
     cols = [packed[:, a:b] for a, b in zip([0] + list(offs), offs)]
     nls = cols[0][:, 0].astype(np.int64)
     out = []
     for i in range(packed.shape[0]):
+        bits = (cols[13][i].astype(np.uint32)
+                | (cols[14][i].astype(np.uint32) << np.uint32(16)))
         tree = TreeArrays(
             node_feat=cols[1][i].astype(np.int32),
             node_bin=cols[2][i].astype(np.int32),
@@ -182,8 +197,10 @@ def _fetch_host_trees(trees_dev: List[TreeArrays], num_leaves: int,
             node_right=cols[4][i].astype(np.int32),
             node_gain=cols[5][i], node_value=cols[6][i],
             node_weight=cols[7][i], node_count=cols[8][i],
-            leaf_value=cols[9][i], leaf_weight=cols[10][i],
-            leaf_count=cols[11][i], num_leaves=nls[i])
+            node_is_cat=cols[9][i].astype(np.int32),
+            node_cat_bits=bits.reshape(m, W),
+            leaf_value=cols[10][i], leaf_weight=cols[11][i],
+            leaf_count=cols[12][i], num_leaves=nls[i])
         out.append(host_tree_from_arrays(tree, mapper, mapper.missing_bin))
     return out, nls
 
@@ -247,7 +264,11 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         lambda_l2=params.lambda_l2, min_data_in_leaf=params.min_data_in_leaf,
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
-        hist_method=params.histogram_method)
+        hist_method=params.histogram_method,
+        use_categorical=mapper.has_categorical,
+        cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
+        max_cat_threshold=params.max_cat_threshold,
+        max_cat_to_onehot=params.max_cat_to_onehot)
 
     if params.boosting not in ("gbdt", "goss"):
         raise NotImplementedError(
@@ -324,8 +345,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
 
     ones = jnp.ones(n, jnp.float32)
     bag_mask = ones
-    full_fmask = jnp.ones(f, jnp.float32)
-    fmask = full_fmask
+    fi_base = _feat_info_from_mapper(mapper, f)
+    fi = jnp.asarray(fi_base)
 
     trees_dev: List[TreeArrays] = []
     stop_iter = params.num_iterations
@@ -337,9 +358,10 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         if params.feature_fraction < 1.0:
             k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
             sel = rng.choice(f, size=k_keep, replace=False)
-            m = np.zeros(f, np.float32)
-            m[sel] = 1.0
-            fmask = jnp.asarray(m)
+            fi_it = fi_base.copy()
+            fi_it[:, 0] = 0.0
+            fi_it[sel, 0] = 1.0
+            fi = jnp.asarray(fi_it)
 
         if K > 1 and grad_fn_override is None:
             g_iter, h_iter = _grad_hess_jit(scores, labels_d, weights_d,
@@ -348,22 +370,22 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             if grad_fn_override is not None:
                 g, h = grad_fn_override(scores)
                 gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
-                tree, row_leaf = grow_tree(bins_d, gh, fmask, cfg)
+                tree, row_leaf = grow_tree(bins_d, gh, fi, cfg)
                 scores = scores + params.learning_rate * \
                     tree.leaf_value[row_leaf]
                 tree = apply_shrinkage(tree, params.learning_rate)
             elif K > 1:
                 tree, scores = _boost_step_class_k(
-                    bins_d, scores, g_iter, h_iter, bag_mask, fmask,
+                    bins_d, scores, g_iter, h_iter, bag_mask, fi,
                     cfg, params.learning_rate, k)
             elif use_goss:
                 tree, scores = _boost_step_goss(
                     bins_d, scores, labels_d, weights_d, goss_keys[it],
-                    fmask, objective, cfg, params.learning_rate,
+                    fi, objective, cfg, params.learning_rate,
                     k1, k2, goss_amp)
             else:
                 tree, scores = _boost_step(
-                    bins_d, scores, labels_d, weights_d, bag_mask, fmask,
+                    bins_d, scores, labels_d, weights_d, bag_mask, fi,
                     objective, cfg, params.learning_rate)
             trees_dev.append(tree)
             if has_val:
@@ -400,6 +422,16 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                                            params.verbosity)
     return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)
+
+
+def _feat_info_from_mapper(mapper: BinMapper, f: int) -> np.ndarray:
+    """(f, 3) [mask, is_cat, n_value_bins] from the fitted BinMapper."""
+    fi = np.zeros((f, 3), np.float32)
+    fi[:, 0] = 1.0
+    if mapper.has_categorical:
+        fi[:, 1] = mapper.categorical.astype(np.float32)
+        fi[:, 2] = [mapper.feature_num_bins(j) for j in range(f)]
+    return fi
 
 
 def _finalize_booster(trees, K, init, params, objective, mapper,
@@ -449,9 +481,9 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         np.asarray(w, np.float32), mesh, K, init, init_scores)
     f_padded = f + fp
 
-    fmask_full = np.zeros(f_padded, np.float32)
-    fmask_full[:f] = 1.0
-    fmask = jnp.asarray(fmask_full)
+    fi_base = np.zeros((f_padded, 3), np.float32)
+    fi_base[:f] = _feat_info_from_mapper(mapper, f)
+    fi = jnp.asarray(fi_base)
 
     trees_dev: List[TreeArrays] = []
     stop_iter = params.num_iterations
@@ -467,19 +499,20 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         if params.feature_fraction < 1.0:
             k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
             sel = rng.choice(f, size=k_keep, replace=False)
-            m = np.zeros(f_padded, np.float32)
-            m[sel] = 1.0
-            fmask = jnp.asarray(m)
+            fi_it = fi_base.copy()
+            fi_it[:, 0] = 0.0
+            fi_it[sel, 0] = 1.0
+            fi = jnp.asarray(fi_it)
 
         if K > 1:
             g_iter, h_iter = grads_fn(scores, labels_d, w_d)
         for k in range(K):
             if K > 1:
                 tree, scores = step(bins_d, scores, g_iter, h_iter, bag,
-                                    fmask, jnp.asarray(k, jnp.int32))
+                                    fi, jnp.asarray(k, jnp.int32))
             else:
                 tree, scores = step(bins_d, scores, labels_d, w_d, bag,
-                                    fmask, jnp.asarray(k, jnp.int32))
+                                    fi, jnp.asarray(k, jnp.int32))
             trees_dev.append(tree)
 
     trees, nls = _fetch_host_trees(trees_dev, params.num_leaves, mapper)

@@ -53,6 +53,18 @@ class GrowerConfig:
     hist_method: str = "auto"
     axis_name: Optional[str] = None          # data-parallel psum axis
     feature_axis_name: Optional[str] = None  # feature-parallel axis
+    #: categorical split finding (LightGBM Fisher-grouping analog); static
+    #: so the no-categorical compile pays zero cost for the extra machinery
+    use_categorical: bool = False
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+
+    @property
+    def cat_words(self) -> int:
+        """u32 words per per-node bin bitset."""
+        return max(1, (self.num_bins + 31) // 32)
 
 
 class TreeArrays(NamedTuple):
@@ -66,6 +78,8 @@ class TreeArrays(NamedTuple):
     node_value: jnp.ndarray   # (L-1,) f32 internal output (shrinkage applied)
     node_weight: jnp.ndarray  # (L-1,) f32 sum of hessians
     node_count: jnp.ndarray   # (L-1,) f32 row count
+    node_is_cat: jnp.ndarray  # (L-1,) i32 1 = categorical split
+    node_cat_bits: jnp.ndarray  # (L-1, W) u32 bin-bitset: bit set -> left
     leaf_value: jnp.ndarray   # (L,) f32 (shrinkage applied)
     leaf_weight: jnp.ndarray  # (L,) f32
     leaf_count: jnp.ndarray   # (L,) f32
@@ -84,6 +98,8 @@ class _GrowState(NamedTuple):
     best_gain: jnp.ndarray    # (L,) f32 (-inf when leaf can't split)
     best_feat: jnp.ndarray    # (L,) i32
     best_bin: jnp.ndarray     # (L,) i32
+    best_is_cat: jnp.ndarray  # (L,) i32
+    best_cat_bits: jnp.ndarray  # (L, W) u32
     tree: TreeArrays
 
 
@@ -101,16 +117,108 @@ def _leaf_output(g, h, cfg: GrowerConfig):
     return -t / (h + cfg.lambda_l2)
 
 
-def find_best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
-                    feature_mask: jnp.ndarray, depth_ok,
-                    cfg: GrowerConfig) -> Tuple[jnp.ndarray, jnp.ndarray,
-                                                jnp.ndarray]:
-    """Best (gain, feature, bin) over a (f, B, 3) histogram.
+def _leaf_gain_l2(g, h, l1, l2):
+    t = jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+    return jnp.square(t) / (h + l2)
 
-    Mirrors LightGBM's FindBestThreshold: left = bins <= b, validity by
-    min_data_in_leaf / min_sum_hessian, gain = ΔL over the parent leaf.
-    First-occurrence argmax reproduces LightGBM's ascending scan tie-break.
+
+def _pack_bin_mask(mask: jnp.ndarray, cfg: GrowerConfig) -> jnp.ndarray:
+    """(B,) bool bin subset -> (W,) u32 bitset (bit set = bin goes left)."""
+    B = mask.shape[0]
+    pos = jnp.arange(B)
+    vals = jnp.where(mask, jnp.uint32(1) << (pos % 32).astype(jnp.uint32),
+                     jnp.uint32(0))
+    return jax.ops.segment_sum(vals, pos // 32,
+                               num_segments=cfg.cat_words)
+
+
+def bin_in_bitset(bits: jnp.ndarray, col: jnp.ndarray) -> jnp.ndarray:
+    """Membership of bin indices ``col`` in a (W,) u32 bitset → bool."""
+    word = bits[col >> 5]
+    return ((word >> (col & 31).astype(jnp.uint32)) & 1).astype(bool)
+
+
+def _find_best_cat_split(hist, parent_g, parent_h, parent_c, cat_allowed,
+                         feat_nbins, cfg: GrowerConfig):
+    """Best categorical split: per-feature gradient-ratio-sorted subset scan
+    (LightGBM's Fisher-grouping sorted-histogram search) plus a one-vs-rest
+    scan for low-cardinality features (max_cat_to_onehot)."""
+    B = hist.shape[1]
+    g_b, h_b, c_b = hist[..., 0], hist[..., 1], hist[..., 2]
+    # The trailing missing bin (NaN + overflow categories) may never join a
+    # left subset: it must route RIGHT both in binned training and in raw
+    # prediction, where rare/unseen values fail the bitset test.  (LightGBM
+    # likewise sends unseen categories right.)
+    not_missing = (jnp.arange(B) != B - 1)[None, :]
+    nonzero = (c_b > 0) & not_missing
+    l2c = cfg.lambda_l2 + cfg.cat_l2
+    parent_gain = _leaf_gain_l2(parent_g, parent_h, cfg.lambda_l1, l2c)
+    md, mh = cfg.min_data_in_leaf, cfg.min_sum_hessian_in_leaf
+
+    # sorted-prefix scan: order bins by g/(h + cat_smooth), ascending;
+    # a prefix of the sorted order is the candidate left subset
+    ratio = jnp.where(nonzero, g_b / (h_b + cfg.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1)                       # (f, B)
+    hist_s = jnp.take_along_axis(hist, order[:, :, None], axis=1)
+    cums = jnp.cumsum(hist_s, axis=1)
+    gls, hls, cls = cums[..., 0], cums[..., 1], cums[..., 2]
+    grs, hrs, crs = parent_g - gls, parent_h - hls, parent_c - cls
+    nz_cnt = jnp.sum(nonzero, axis=1).astype(jnp.float32)    # (f,)
+    used_left = (jnp.arange(B) + 1).astype(jnp.float32)[None, :]
+    used_right = nz_cnt[:, None] - used_left
+    valid_s = ((cls >= md) & (crs >= md) & (hls >= mh) & (hrs >= mh)
+               & (used_right >= 1)
+               & (jnp.minimum(used_left, used_right)
+                  <= cfg.max_cat_threshold))
+    gains_s = (_leaf_gain_l2(gls, hls, cfg.lambda_l1, l2c)
+               + _leaf_gain_l2(grs, hrs, cfg.lambda_l1, l2c) - parent_gain)
+    gains_s = jnp.where(valid_s, gains_s, -jnp.inf)
+
+    # one-vs-rest scan for small-cardinality features (missing bin is
+    # excluded via `nonzero`)
+    gr1, hr1, cr1 = parent_g - g_b, parent_h - h_b, parent_c - c_b
+    valid_1 = (nonzero & (c_b >= md) & (cr1 >= md) & (h_b >= mh)
+               & (hr1 >= mh) & (nz_cnt[:, None] >= 2))
+    gains_1 = (_leaf_gain_l2(g_b, h_b, cfg.lambda_l1, l2c)
+               + _leaf_gain_l2(gr1, hr1, cfg.lambda_l1, l2c) - parent_gain)
+    gains_1 = jnp.where(valid_1, gains_1, -jnp.inf)
+
+    use_onehot = (feat_nbins <= cfg.max_cat_to_onehot)       # (f,)
+    gains_cat = jnp.where(use_onehot[:, None], gains_1, gains_s)
+    gains_cat = jnp.where(cat_allowed[:, None], gains_cat, -jnp.inf)
+    flat = gains_cat.reshape(-1)
+    idx = jnp.argmax(flat)
+    gain = flat[idx]
+    feat = (idx // B).astype(jnp.int32)
+    k = (idx % B).astype(jnp.int32)
+
+    onehot_win = use_onehot[feat]
+    mask_onehot = jnp.arange(B) == k
+    prefix = jnp.arange(B) <= k                  # positions in sorted order
+    mask_sorted = jnp.zeros(B, bool).at[order[feat]].set(prefix)
+    mask_bins = jnp.where(onehot_win, mask_onehot, mask_sorted)
+    return gain, feat, k, _pack_bin_mask(mask_bins, cfg)
+
+
+def find_best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
+                    feat_info: jnp.ndarray, depth_ok,
+                    cfg: GrowerConfig) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                                jnp.ndarray, jnp.ndarray,
+                                                jnp.ndarray]:
+    """Best split over a (f, B, 3) histogram.
+
+    ``feat_info``: (f, 3) float32 — [:, 0] feature mask, [:, 1] categorical
+    flag, [:, 2] per-feature value-bin count.  Returns ``(gain, feature,
+    bin, is_cat, cat_bits)`` where ``cat_bits`` is the (W,) u32 left-subset
+    bin bitset (zeros for numeric splits).
+
+    Numeric path mirrors LightGBM's FindBestThreshold: left = bins <= b,
+    validity by min_data_in_leaf / min_sum_hessian, gain = ΔL over the
+    parent leaf; first-occurrence argmax reproduces LightGBM's ascending
+    scan tie-break.  Categorical path: :func:`_find_best_cat_split`.
     """
+    feature_mask = feat_info[:, 0]
+    is_cat_f = feat_info[:, 1] > 0
     cum = jnp.cumsum(hist, axis=1)           # (f, B, 3)
     gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
     gr = parent_g - gl
@@ -123,13 +231,28 @@ def find_best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     valid = valid & (jnp.arange(hist.shape[1]) < hist.shape[1] - 1)[None, :]
     parent_gain = _leaf_gain(parent_g, parent_h, cfg)
     gains = (_leaf_gain(gl, hl, cfg) + _leaf_gain(gr, hr, cfg) - parent_gain)
-    gains = jnp.where(valid & (feature_mask[:, None] > 0) & depth_ok,
+    num_allowed = (feature_mask > 0) & (~is_cat_f if cfg.use_categorical
+                                        else True)
+    gains = jnp.where(valid & num_allowed[:, None] & depth_ok,
                       gains, -jnp.inf)
     flat = gains.reshape(-1)
     idx = jnp.argmax(flat)
     best_gain = flat[idx]
     feat = (idx // hist.shape[1]).astype(jnp.int32)
     b = (idx % hist.shape[1]).astype(jnp.int32)
+    is_cat = jnp.asarray(0, jnp.int32)
+    cat_bits = jnp.zeros(cfg.cat_words, jnp.uint32)
+    if cfg.use_categorical:
+        cat_allowed = is_cat_f & (feature_mask > 0) & depth_ok
+        cat_gain, cat_feat, _, cat_bits_w = _find_best_cat_split(
+            hist, parent_g, parent_h, parent_c, cat_allowed,
+            feat_info[:, 2], cfg)
+        cat_wins = cat_gain > best_gain
+        best_gain = jnp.maximum(best_gain, cat_gain)
+        feat = jnp.where(cat_wins, cat_feat, feat)
+        b = jnp.where(cat_wins, 0, b)
+        is_cat = cat_wins.astype(jnp.int32)
+        cat_bits = jnp.where(cat_wins, cat_bits_w, cat_bits)
     if cfg.feature_axis_name is not None:
         # feature-parallel learner: each shard scanned its feature slice;
         # allgather candidate splits and pick the global winner
@@ -138,13 +261,18 @@ def find_best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         gains_all = jax.lax.all_gather(best_gain, ax)       # (S,)
         feats_all = jax.lax.all_gather(feat, ax)
         bins_all = jax.lax.all_gather(b, ax)
+        cats_all = jax.lax.all_gather(is_cat, ax)
+        bits_all = jax.lax.all_gather(cat_bits, ax)         # (S, W)
         shard = jnp.argmax(gains_all)
         n_local = jnp.asarray(hist.shape[0], jnp.int32)
         best_gain = gains_all[shard]
         feat = feats_all[shard] + shard.astype(jnp.int32) * n_local
         b = bins_all[shard]
+        is_cat = cats_all[shard]
+        cat_bits = bits_all[shard]
     gain_ok = best_gain > jnp.maximum(cfg.min_gain_to_split, EPS_GAIN)
-    return jnp.where(gain_ok, best_gain, -jnp.inf), feat, b
+    return (jnp.where(gain_ok, best_gain, -jnp.inf), feat, b, is_cat,
+            cat_bits)
 
 
 def _hist(bins, gh, cfg: GrowerConfig):
@@ -162,22 +290,37 @@ def _totals_from_hist(hist):
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def grow_tree(bins: jnp.ndarray, gh: jnp.ndarray,
-              feature_mask: jnp.ndarray,
+              feat_info: jnp.ndarray,
               cfg: GrowerConfig) -> Tuple[TreeArrays, jnp.ndarray]:
-    """Grow one tree.  ``gh``: (n, 3) masked (grad, hess, count)."""
-    return _grow_tree_impl(bins, gh, feature_mask, cfg)
+    """Grow one tree.  ``gh``: (n, 3) masked (grad, hess, count);
+    ``feat_info``: (f, 3) [mask, is_cat, n_value_bins] (see
+    :func:`make_feat_info`)."""
+    return _grow_tree_impl(bins, gh, feat_info, cfg)
 
 
-def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
+def make_feat_info(f: int, feature_mask=None, is_cat=None, nbins=None):
+    """Assemble the (f, 3) feature-info array the grower consumes."""
+    import numpy as np
+    out = np.zeros((f, 3), np.float32)
+    out[:, 0] = 1.0 if feature_mask is None else feature_mask
+    if is_cat is not None:
+        out[:, 1] = is_cat
+    if nbins is not None:
+        out[:, 2] = nbins
+    return out
+
+
+def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
     n, f = bins.shape
     L = cfg.num_leaves
+    W = cfg.cat_words
     neg_inf = jnp.float32(-jnp.inf)
 
     hist0 = _hist(bins, gh, cfg)
     g0, h0, c0 = _totals_from_hist(hist0)
     depth0_ok = (cfg.max_depth <= 0) | (0 < cfg.max_depth)
-    bg0, bf0, bb0 = find_best_split(hist0, g0, h0, c0, feature_mask,
-                                    jnp.asarray(depth0_ok), cfg)
+    bg0, bf0, bb0, bc0, bits0 = find_best_split(
+        hist0, g0, h0, c0, feat_info, jnp.asarray(depth0_ok), cfg)
 
     tree = TreeArrays(
         node_feat=jnp.zeros(L - 1, jnp.int32),
@@ -188,6 +331,8 @@ def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
         node_value=jnp.zeros(L - 1, jnp.float32),
         node_weight=jnp.zeros(L - 1, jnp.float32),
         node_count=jnp.zeros(L - 1, jnp.float32),
+        node_is_cat=jnp.zeros(L - 1, jnp.int32),
+        node_cat_bits=jnp.zeros((L - 1, W), jnp.uint32),
         leaf_value=jnp.zeros(L, jnp.float32).at[0].set(
             _leaf_output(g0, h0, cfg)),
         leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(h0),
@@ -207,6 +352,8 @@ def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
         best_gain=jnp.full(L, neg_inf).at[0].set(bg0),
         best_feat=jnp.zeros(L, jnp.int32).at[0].set(bf0),
         best_bin=jnp.zeros(L, jnp.int32).at[0].set(bb0),
+        best_is_cat=jnp.zeros(L, jnp.int32).at[0].set(bc0),
+        best_cat_bits=jnp.zeros((L, W), jnp.uint32).at[0].set(bits0),
         tree=tree,
     )
 
@@ -236,7 +383,14 @@ def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
             else:
                 col = jnp.take(bins, feat, axis=1)
             in_leaf = state.row_leaf == l
-            go_right = in_leaf & (col > thr)
+            if cfg.use_categorical:
+                go_left_val = jnp.where(
+                    state.best_is_cat[l] > 0,
+                    bin_in_bitset(state.best_cat_bits[l], col),
+                    col <= thr)
+                go_right = in_leaf & ~go_left_val
+            else:
+                go_right = in_leaf & (col > thr)
             row_leaf = jnp.where(go_right, new_id, state.row_leaf)
 
             hist_r = _hist(bins, gh * go_right[:, None], cfg)
@@ -249,10 +403,10 @@ def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
             child_depth = state.leaf_depth[l] + 1
             depth_ok = jnp.asarray(
                 (cfg.max_depth <= 0), bool) | (child_depth < cfg.max_depth)
-            bg_l, bf_l, bb_l = find_best_split(
-                hist_l, g_l, h_l, c_l, feature_mask, depth_ok, cfg)
-            bg_r, bf_r, bb_r = find_best_split(
-                hist_r, g_r, h_r, c_r, feature_mask, depth_ok, cfg)
+            bg_l, bf_l, bb_l, bc_l, bits_l = find_best_split(
+                hist_l, g_l, h_l, c_l, feat_info, depth_ok, cfg)
+            bg_r, bf_r, bb_r, bc_r, bits_r = find_best_split(
+                hist_r, g_r, h_r, c_r, feat_info, depth_ok, cfg)
 
             t = state.tree
             # link the new internal node into its parent
@@ -267,6 +421,9 @@ def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
             tree = t._replace(
                 node_feat=t.node_feat.at[i].set(feat),
                 node_bin=t.node_bin.at[i].set(thr),
+                node_is_cat=t.node_is_cat.at[i].set(state.best_is_cat[l]),
+                node_cat_bits=t.node_cat_bits.at[i].set(
+                    state.best_cat_bits[l]),
                 node_left=node_left.at[i].set(-(l + 1)),
                 node_right=node_right.at[i].set(-(new_id + 1)),
                 node_gain=t.node_gain.at[i].set(gain),
@@ -300,6 +457,10 @@ def _grow_tree_impl(bins, gh, feature_mask, cfg: GrowerConfig):
                                          .at[new_id].set(bf_r),
                 best_bin=state.best_bin.at[l].set(bb_l)
                                        .at[new_id].set(bb_r),
+                best_is_cat=state.best_is_cat.at[l].set(bc_l)
+                                             .at[new_id].set(bc_r),
+                best_cat_bits=state.best_cat_bits.at[l].set(bits_l)
+                                                 .at[new_id].set(bits_r),
                 tree=tree,
             )
 
@@ -328,7 +489,14 @@ def predict_tree_binned(tree: TreeArrays, bins: jnp.ndarray,
         thr = tree.node_bin[safe]
         val = jnp.take_along_axis(
             bins, feat[:, None], axis=1)[:, 0]
-        nxt = jnp.where(val <= thr, tree.node_left[safe],
+        go_left = val <= thr
+        # categorical nodes: left iff the row's bin is in the subset bitset
+        words = jnp.take_along_axis(tree.node_cat_bits[safe],
+                                    (val >> 5)[:, None], axis=1)[:, 0]
+        left_cat = ((words >> (val & 31).astype(jnp.uint32)) & 1
+                    ).astype(bool)
+        go_left = jnp.where(tree.node_is_cat[safe] > 0, left_cat, go_left)
+        nxt = jnp.where(go_left, tree.node_left[safe],
                         tree.node_right[safe])
         return jnp.where(is_leaf, node, nxt)
 

@@ -1,0 +1,146 @@
+"""Categorical feature splits end-to-end (VERDICT r1 item #4).
+
+Reference parity target: LightGBM's categorical handling reached through
+``categoricalSlotIndexes`` (lightgbm/LightGBMParams.scala categorical
+params + LightGBMDataset categorical path, expected, UNVERIFIED):
+gradient-ratio-sorted subset search, decision_type bit0 + cat_threshold
+bitsets in the model text, one-vs-rest for tiny cardinalities.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import DataTable
+from mmlspark_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.gbdt.booster import Booster
+from mmlspark_tpu.train.metrics import roc_auc
+
+
+def _interleaved_cat_data(n=4000, n_cats=24, seed=5):
+    """Category ids deliberately interleaved so no single numeric threshold
+    separates the classes: membership in a scattered subset drives y."""
+    rng = np.random.default_rng(seed)
+    cat = rng.integers(0, n_cats, size=n)
+    good = set(range(1, n_cats, 3)) | {0, 8}
+    base = np.isin(cat, sorted(good)).astype(np.float64)
+    noise = rng.normal(size=n) * 0.18
+    y = (base + noise > 0.5).astype(np.float64)
+    X = np.stack([cat.astype(np.float64), rng.normal(size=n)], axis=1)
+    return X, y, sorted(good)
+
+
+class TestCategoricalTraining:
+    def test_categorical_beats_numeric_treatment(self):
+        """The categorical learner must beat treating the same column as
+        numeric, with few leaves (a numeric split can't express a scattered
+        subset; one-hot would need ~n_cats depth)."""
+        X, y, _ = _interleaved_cat_data()
+        t = DataTable({"features": X, "label": y})
+        kw = dict(numIterations=8, numLeaves=4, minDataInLeaf=20)
+        m_cat = LightGBMClassifier(categoricalSlotIndexes=[0], **kw).fit(t)
+        m_num = LightGBMClassifier(**kw).fit(t)
+        auc_cat = roc_auc(y, np.asarray(
+            m_cat.transform(t)["probability"])[:, 1])
+        auc_num = roc_auc(y, np.asarray(
+            m_num.transform(t)["probability"])[:, 1])
+        assert auc_cat > 0.95
+        assert auc_cat > auc_num + 0.03, (auc_cat, auc_num)
+
+    def test_root_split_recovers_subset(self):
+        X, y, good = _interleaved_cat_data()
+        t = DataTable({"features": X, "label": y})
+        model = LightGBMClassifier(categoricalSlotIndexes=[0],
+                                   numIterations=1, numLeaves=3,
+                                   minDataInLeaf=20).fit(t)
+        ht = model.getModel().trees[0]
+        assert ht.num_cat >= 1
+        assert ht.decision_type[0] & 1
+        # decode the root bitset -> raw categories going left
+        j = int(ht.threshold[0])
+        b0, b1 = ht.cat_boundaries[j], ht.cat_boundaries[j + 1]
+        words = ht.cat_threshold[b0:b1]
+        cats_left = [c for c in range(32 * len(words))
+                     if (words[c >> 5] >> (c & 31)) & 1]
+        # left subset must be exactly the planted set or its complement
+        n_cats = 24
+        comp = sorted(set(range(n_cats)) - set(good))
+        assert cats_left in (good, comp), (cats_left, good)
+
+    def test_regressor_categorical(self):
+        rng = np.random.default_rng(3)
+        n = 3000
+        cat = rng.integers(0, 12, size=n)
+        means = rng.normal(size=12) * 3
+        y = means[cat] + rng.normal(size=n) * 0.1
+        X = np.stack([cat.astype(np.float64), rng.normal(size=n)], axis=1)
+        t = DataTable({"features": X, "label": y})
+        model = LightGBMRegressor(categoricalSlotIndexes=[0],
+                                  numIterations=40, numLeaves=12,
+                                  minDataInLeaf=20).fit(t)
+        pred = np.asarray(model.transform(t)["prediction"])
+        r2 = 1 - np.sum((y - pred) ** 2) / np.sum((y - y.mean()) ** 2)
+        assert r2 > 0.98
+
+    def test_categorical_slot_names_unknown_rejected(self):
+        X, y, _ = _interleaved_cat_data(n=800)
+        with pytest.raises(ValueError, match="not found"):
+            LightGBMClassifier(categoricalSlotNames=["nope"],
+                               numIterations=2).fit(
+                {"features": X, "label": y})
+
+    def test_negative_category_rejected(self):
+        X = np.stack([np.array([-1.0, 2.0, 3.0, 1.0] * 10),
+                      np.arange(40.0)], axis=1)
+        y = (np.arange(40) % 2).astype(np.float64)
+        with pytest.raises(ValueError, match="non-negative"):
+            LightGBMClassifier(categoricalSlotIndexes=[0],
+                               numIterations=2).fit(
+                {"features": X, "label": y})
+
+
+class TestCategoricalModelIO:
+    def test_native_roundtrip_predictions(self, tmp_path):
+        X, y, _ = _interleaved_cat_data(n=2000)
+        t = DataTable({"features": X, "label": y})
+        model = LightGBMClassifier(categoricalSlotIndexes=[0],
+                                   numIterations=6, numLeaves=6,
+                                   minDataInLeaf=20).fit(t)
+        booster = model.getModel()
+        text = booster.save_native_model_string()
+        assert "num_cat=" in text and "cat_threshold=" in text
+        loaded = Booster.load_native_model_string(text)
+        p1 = np.asarray(booster.predict(X))
+        p2 = np.asarray(loaded.predict(X))
+        np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-7)
+        # re-export parses identically (emitter/parser fixed point)
+        text2 = loaded.save_native_model_string()
+        assert text.split("feature_importances")[0].strip() == \
+            text2.split("feature_importances")[0].strip()
+
+    def test_unseen_category_routes_right_nan_default(self):
+        X, y, _ = _interleaved_cat_data(n=2000)
+        t = DataTable({"features": X, "label": y})
+        model = LightGBMClassifier(categoricalSlotIndexes=[0],
+                                   numIterations=3, numLeaves=4,
+                                   minDataInLeaf=20).fit(t)
+        booster = model.getModel()
+        Xq = X[:4].copy()
+        Xq[0, 0] = 9999.0     # unseen category
+        Xq[1, 0] = np.nan     # missing
+        out = np.asarray(booster.predict(Xq))
+        assert np.isfinite(out).all()
+
+    def test_leaf_index_consistency(self):
+        """predict_leaf_index walks cat nodes the same way as predict."""
+        X, y, _ = _interleaved_cat_data(n=1000)
+        t = DataTable({"features": X, "label": y})
+        model = LightGBMClassifier(categoricalSlotIndexes=[0],
+                                   numIterations=2, numLeaves=5,
+                                   minDataInLeaf=10).fit(t)
+        booster = model.getModel()
+        leaves = np.asarray(booster.predict_leaf_index(X))
+        margins = np.asarray(booster.predict_margin(X))
+        acc = np.zeros(len(X))
+        for ti, ht in enumerate(booster.trees):
+            acc += ht.leaf_value[leaves[:, ti]]
+        np.testing.assert_allclose(acc, margins, rtol=1e-5, atol=1e-6)

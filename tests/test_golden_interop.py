@@ -1,0 +1,184 @@
+"""Golden-file interop with the LightGBM v3 text model format.
+
+VERDICT r1 item #5: the round-1 suite only checked our emitter against our
+own parser.  This suite pins the *format itself* with a vendored,
+hand-verified LightGBM v3 model file (tests/golden/lightgbm_v3_golden.txt,
+written against the public format spec: numeric splits, a categorical
+bitset split, sigmoid objective) and an independent pure-numpy tree walker
+implemented here — so a bug shared by our emitter and parser cannot hide.
+
+Reference contract: lightgbm/LightGBMBooster.scala saveNativeModel /
+loadNativeModel (expected path, UNVERIFIED; SURVEY.md §5.4).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.gbdt.booster import Booster
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "lightgbm_v3_golden.txt")
+
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _walk_tree_reference(kv, x):
+    """Independent LightGBM-semantics walker over one parsed tree block.
+
+    kv: dict of raw strings from the golden file; x: (f,) raw features.
+    Implements: numerical `x <= threshold` (missing NaN per decision_type),
+    categorical membership via cat_boundaries/cat_threshold bitsets.
+    """
+    split_feature = np.fromstring(kv["split_feature"], sep=" ", dtype=int) \
+        if kv.get("split_feature") else np.zeros(0, int)
+    if len(split_feature) == 0:
+        return float(kv["leaf_value"].split()[0])
+    threshold = np.fromstring(kv["threshold"], sep=" ")
+    decision_type = np.fromstring(kv["decision_type"], sep=" ", dtype=int)
+    left = np.fromstring(kv["left_child"], sep=" ", dtype=int)
+    right = np.fromstring(kv["right_child"], sep=" ", dtype=int)
+    leaf_value = np.fromstring(kv["leaf_value"], sep=" ")
+    cat_boundaries = np.fromstring(kv.get("cat_boundaries", "0"), sep=" ",
+                                   dtype=int)
+    cat_threshold = np.fromstring(kv.get("cat_threshold", ""), sep=" ",
+                                  dtype=np.uint64).astype(np.uint32)
+
+    node = 0
+    while True:
+        f = split_feature[node]
+        dt = decision_type[node]
+        v = x[f]
+        if dt & 1:  # categorical
+            if np.isnan(v):
+                go_left = bool(dt & 2)
+            else:
+                c = int(v)
+                j = int(threshold[node])
+                b0, b1 = cat_boundaries[j], cat_boundaries[j + 1]
+                widx = b0 + (c >> 5)
+                go_left = (c >= 0 and widx < b1
+                           and bool((cat_threshold[widx] >> (c & 31)) & 1))
+        else:
+            if np.isnan(v):
+                # missing_type NaN (bits 2-3 == 2) routes by default_left
+                go_left = bool(dt & 2) if (dt >> 2) & 3 == 2 else False
+            else:
+                go_left = v <= threshold[node]
+        node = left[node] if go_left else right[node]
+        if node < 0:
+            return float(leaf_value[~node])
+
+
+def _reference_predict(text, X):
+    """Sum all trees with the independent walker; apply sigmoid."""
+    body = text.split("end of trees")[0]
+    blocks = []
+    for chunk in body.split("Tree=")[1:]:
+        kv = {}
+        for line in chunk.splitlines()[1:]:
+            if "=" in line:
+                k, _, v = line.partition("=")
+                kv[k.strip()] = v.strip()
+        blocks.append(kv)
+    out = np.zeros(len(X))
+    for kv in blocks:
+        out += np.array([_walk_tree_reference(kv, x) for x in X])
+    return _sigmoid(out)
+
+
+@pytest.fixture(scope="module")
+def golden_text():
+    with open(GOLDEN) as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def query_points():
+    # rows exercising: both numeric branches, categorical membership and
+    # non-membership, unseen category, NaN in numeric and categorical slots
+    return np.array([
+        [30.0, 50000.0, 1.0],    # age<=42.5, income<=100000.5, city in set
+        [30.0, 150000.0, 2.0],   # income right, city not in set
+        [60.0, 50000.0, 7.0],    # age right, city in set
+        [42.5, 100000.5, 0.0],   # exact threshold boundaries (both left)
+        [43.0, 50000.0, 5.0],
+        [30.0, 50000.0, 999.0],  # unseen category -> right
+        [np.nan, 50000.0, 4.0],  # NaN age: missing NaN + default_left
+        [30.0, 50000.0, np.nan],  # NaN city: cat, no default_left -> right
+    ])
+
+
+def test_golden_loads_and_matches_reference_walker(golden_text,
+                                                   query_points):
+    booster = Booster.load_native_model_string(golden_text)
+    assert booster.num_class == 1
+    assert booster.objective_str.startswith("binary")
+    assert len(booster.trees) == 2
+    assert booster.trees[1].num_cat == 1
+
+    want = _reference_predict(golden_text, query_points)
+    got = np.asarray(booster.predict(query_points))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_golden_expected_values_pinned(golden_text):
+    """Hand-computed expectations for two rows (belt and braces: catches a
+    shared bug in walker + booster)."""
+    booster = Booster.load_native_model_string(golden_text)
+    # row A: age=30,income=50000,city=1 -> T0 leaf0 0.55; city 1 in {1,4,5,7}
+    #   -> T1: age<=30.0000...4 -> leaf0 0.3; margin 0.85
+    # row B: age=60,income=0,city=0 -> T0: age>42.5 -> leaf2 0.4;
+    #   city 0 not in set -> T1 leaf2 0.15; margin 0.55
+    X = np.array([[30.0, 50000.0, 1.0], [60.0, 0.0, 0.0]])
+    got = np.asarray(booster.predict(X, raw_score=True))
+    np.testing.assert_allclose(got, [0.85, 0.55], rtol=1e-6)
+
+
+def test_golden_reexport_fixed_point(golden_text, query_points):
+    """Export of the loaded model re-parses to identical predictions, and
+    the tree structure section survives byte-for-byte semantics."""
+    booster = Booster.load_native_model_string(golden_text)
+    text2 = booster.save_native_model_string()
+    booster2 = Booster.load_native_model_string(text2)
+    np.testing.assert_allclose(
+        np.asarray(booster.predict(query_points)),
+        np.asarray(booster2.predict(query_points)), rtol=1e-7)
+    # structural fields preserved
+    for t1, t2 in zip(booster.trees, booster2.trees):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.decision_type, t2.decision_type)
+        np.testing.assert_array_equal(t1.left_child, t2.left_child)
+        np.testing.assert_array_equal(t1.cat_threshold, t2.cat_threshold)
+        np.testing.assert_allclose(t1.threshold, t2.threshold)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value)
+
+
+def test_golden_tree_sizes_are_exact(golden_text):
+    """tree_sizes must equal the byte length of each tree block — stock
+    LightGBM seeks by these offsets, so a drifting emitter breaks interop."""
+    header, _, rest = golden_text.partition("Tree=0")
+    sizes = [int(v) for v in
+             [ln for ln in header.splitlines()
+              if ln.startswith("tree_sizes=")][0].split("=")[1].split()]
+    body = ("Tree=0" + rest).split("end of trees")[0]
+    i1 = body.index("Tree=1")
+    blocks = [body[:i1], body[i1:]]
+    assert [len(b.encode()) for b in blocks] == sizes
+
+
+def test_our_emitter_writes_exact_tree_sizes(golden_text):
+    """Our exporter's tree_sizes must match its own emitted block lengths."""
+    booster = Booster.load_native_model_string(golden_text)
+    text = booster.save_native_model_string()
+    header, _, rest = text.partition("Tree=0")
+    sizes = [int(v) for v in
+             [ln for ln in header.splitlines()
+              if ln.startswith("tree_sizes=")][0].split("=")[1].split()]
+    body = ("Tree=0" + rest).split("end of trees")[0]
+    i1 = body.index("Tree=1")
+    blocks = [body[:i1], body[i1:]]
+    assert [len(b.encode()) for b in blocks] == sizes
