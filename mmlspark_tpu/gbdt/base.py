@@ -246,21 +246,13 @@ class LightGBMBase(Estimator, LightGBMParams):
         mapper = fit_bin_mapper(X[train_idx], max_bin=self.getMaxBin(),
                                 seed=self.getSeed(),
                                 categorical_features=cat_idx or None)
-        bins = mapper.transform(X[train_idx])
         y_train = y[train_idx]
         w_train = w[train_idx] if w is not None else None
         iscol = self.getInitScoreCol()
         init_scores = (np.asarray(table[iscol], np.float64)[train_idx]
                        if iscol else None)
 
-        val_kwargs = {}
-        if val_mask is not None and val_mask.any():
-            val_kwargs = dict(
-                val_bins=mapper.transform(X[val_mask]),
-                val_labels=y[val_mask],
-                val_weights=w[val_mask] if w is not None else None,
-                val_metric=self._val_metric_fn(table, val_mask),
-            )
+        has_val = val_mask is not None and val_mask.any()
 
         params = self._train_params()
         grad_override = self._grad_fn_override(table, train_idx, y_train,
@@ -269,12 +261,23 @@ class LightGBMBase(Estimator, LightGBMParams):
         # reference trains across all executors (SURVEY.md §3.1); the
         # parallelism param picks the axis layout.
         mesh = getattr(self, "_mesh", None)
-        if mesh is None and grad_override is None and not val_kwargs \
+        if mesh is None and grad_override is None and not has_val \
                 and self.getBoostingType() != "goss":
             import jax
             if jax.device_count() > 1:
                 from .distributed import resolve_mesh
                 mesh = resolve_mesh(self.getParallelism())
+
+        bins = mapper.transform_packed(X[train_idx])
+
+        val_kwargs = {}
+        if has_val:
+            val_kwargs = dict(
+                val_bins=mapper.transform_packed(X[val_mask]),
+                val_labels=y[val_mask],
+                val_weights=w[val_mask] if w is not None else None,
+                val_metric=self._val_metric_fn(table, val_mask),
+            )
         booster = train(
             bins, y_train, w_train, mapper, objective, params,
             feature_names=feature_names,
@@ -304,13 +307,34 @@ class LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
     def getNativeModel(self) -> str:
         return self._booster.save_native_model_string()
 
-    def saveNativeModel(self, path: str) -> None:
-        """Save in LightGBM text format, loadable by stock LightGBM."""
+    def saveNativeModel(self, path: str, overwrite: bool = True) -> None:
+        """Save in LightGBM text format, loadable by stock LightGBM.
+
+        ``overwrite=False`` refuses to clobber an existing file, matching
+        the reference's ``saveNativeModel(filename, overwrite)``
+        (src/main/scala LightGBMClassifier.scala model save API).
+        """
+        import os
+        if not overwrite and os.path.exists(path):
+            raise FileExistsError(
+                f"{path} exists and overwrite=False")
         self._booster.save_native_model(path)
 
     @classmethod
     def loadNativeModel(cls, path: str) -> "LightGBMModelBase":
         return cls(booster=Booster.load_native_model(path))
+
+    @classmethod
+    def loadNativeModelFromFile(cls, path: str) -> "LightGBMModelBase":
+        """Reference-parity alias (LightGBMClassificationModel.
+        loadNativeModelFromFile)."""
+        return cls.loadNativeModel(path)
+
+    @classmethod
+    def loadNativeModelFromString(cls, model_str: str
+                                  ) -> "LightGBMModelBase":
+        """Reference-parity alias: parse a LightGBM model text blob."""
+        return cls(booster=Booster.load_native_model_string(model_str))
 
     def getFeatureImportances(self, importance_type: str = "split"):
         return list(self._booster.feature_importances(importance_type))

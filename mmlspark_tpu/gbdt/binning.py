@@ -59,6 +59,46 @@ class BinMapper:
             return len(self.cat_values[j])
         return len(self.upper_bounds[j]) + 1
 
+    @property
+    def bin_dtype(self) -> np.dtype:
+        """Narrowest integer dtype that holds every bin index (numpy dtype;
+        jnp.asarray accepts it directly).  256 bins fit uint8 exactly — 4x
+        less transfer/gather traffic than int32 in the training hot loop
+        (grower gathers, histogram chunk reads)."""
+        return np.dtype(np.uint8 if self.num_total_bins <= 256
+                        else np.int32)
+
+    def transform_packed(self, X: np.ndarray) -> np.ndarray:
+        """:meth:`transform` into the narrowest dtype, using torch's batched
+        ``searchsorted`` when available (~25% faster than the per-feature
+        numpy loop on one core).  The uint8 output is what ships over the
+        host↔device link: 4x fewer bytes than int32, which dominates fit
+        startup on a tunneled TPU (~25-100 MB/s link; see BENCH_SWEEP.md).
+
+        Shipping X and binning on-device loses: the raw f32 matrix is 4x
+        the bytes of the binned u8 one, and the link is the bottleneck —
+        measured 4-11s for 80 MB vs ~0.5s for the 20 MB binned form.
+        """
+        dt = self.bin_dtype
+        if self.has_categorical:
+            return self.transform(X).astype(dt)
+        try:
+            import torch
+        except Exception:  # pragma: no cover - torch is baked into the image
+            return self.transform(X).astype(dt)
+        f = self.num_features
+        maxlen = max((len(ub) for ub in self.upper_bounds), default=0)
+        bounds = np.full((f, max(maxlen, 1)), np.inf, np.float64)
+        for j, ub in enumerate(self.upper_bounds):
+            bounds[j, :len(ub)] = ub
+        Xt = torch.from_numpy(np.ascontiguousarray(X.T, dtype=np.float64))
+        out = torch.searchsorted(torch.from_numpy(bounds), Xt, side="left")
+        out = out.numpy().T.astype(dt)
+        nan_mask = np.isnan(X)
+        if nan_mask.any():
+            out[nan_mask] = self.missing_bin
+        return out
+
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Map raw features to bin indices ``(n, f)``, NaN → missing_bin."""
         n, f = X.shape

@@ -72,70 +72,83 @@ def _sharded_cfg(mesh: Mesh, cfg: GrowerConfig) -> GrowerConfig:
     })
 
 
-def make_boost_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
-                    num_class: int = 1):
-    """Build the jitted shard_mapped boost step for this mesh.
+def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
+                    bag_sharded: bool):
+    """Chunked distributed boosting: a ``lax.scan`` over iterations INSIDE
+    the shard_map, so a whole chunk of trees trains in one launch with all
+    histogram psums compiler-scheduled onto ICI (the reference's per-
+    iteration socket allreduce, amortized to one program).
 
-    Single-class: returns ``step(bins, scores, labels, weights, bag, fmask,
-    k) -> (tree, scores)`` fusing grad/hess + growth + score update.
-
-    Arrays are global (jit handles sharding); the returned tree is replicated
-    — identical on every shard by construction, because split decisions are
-    computed from psum-reduced histograms.
+    ``real``: (n,) row-validity mask sharded over ``data`` (zeros on pad
+    rows), folded into every iteration's mask.  ``bags``: (C, n) bagging
+    masks sharded over ``data`` when ``bag_sharded``, else a constant
+    (C, 1) broadcast — so a padded no-bagging fit costs one (n,) mask, not
+    a (C, n) stack of identical copies.  Returns stacked replicated trees
+    and the final sharded scores.
     """
     cfg = _sharded_cfg(mesh, cfg)
 
-    def step(bins, scores, labels, weights, bag, feat_info, k):
-        del k
-        g, h = obj.grad_hess(scores, labels, weights)
-        gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
-        scores = scores + lr * tree.leaf_value[row_leaf]
-        return apply_shrinkage(tree, lr), scores
+    def steps(bins, scores, labels, weights, real, bags, fis):
+        def body(scores, xs):
+            bag, fi = xs
+            bag = jnp.broadcast_to(bag, scores.shape) * real
+            g, h = obj.grad_hess(scores, labels, weights)
+            gh = jnp.stack([g * bag, h * bag, bag], axis=1)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+            scores = scores + lr * tree.leaf_value[row_leaf]
+            return scores, apply_shrinkage(tree, lr)
 
+        scores, trees = jax.lax.scan(body, scores, (bags, fis))
+        return trees, scores
+
+    bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
     mapped = jax.shard_map(
-        step, mesh=mesh,
+        steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(DATA_AXIS), P(FEATURE_AXIS, None), P()),
+                  P(DATA_AXIS), P(DATA_AXIS), bag_spec,
+                  P(None, FEATURE_AXIS, None)),
         out_specs=(P(), P(DATA_AXIS)),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(1,))
 
 
-def make_multiclass_steps(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
-                          lr: float, num_class: int):
-    """Multiclass distributed training: grad/hess computed ONCE per
-    iteration for all K trees (LightGBM semantics), then one grow step per
-    class consuming the fixed gradients."""
+def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
+                         lr: float, num_class: int, bag_sharded: bool):
+    """Multiclass distributed chunk: grad/hess once per iteration for all K
+    trees (LightGBM softmax semantics), K grow steps per scan iteration.
+    Trees come back stacked (C*K, ...), iteration-major."""
     cfg = _sharded_cfg(mesh, cfg)
+    K = num_class
 
-    def grads(scores, labels, weights):
-        return obj.grad_hess(scores, labels, weights)
+    def steps(bins, scores, labels, weights, real, bags, fis):
+        def body(scores, xs):
+            bag, fi = xs
+            bag = jnp.broadcast_to(bag, (scores.shape[0],)) * real
+            g, h = obj.grad_hess(scores, labels, weights)
+            trees_k = []
+            for k in range(K):
+                gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
+                tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+                scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
+                trees_k.append(apply_shrinkage(tree, lr))
+            trees = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *trees_k)
+            return scores, trees
 
-    grads_mapped = jax.jit(jax.shard_map(
-        grads, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
-        check_vma=False))
+        scores, trees = jax.lax.scan(body, scores, (bags, fis))
+        trees = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), trees)
+        return trees, scores
 
-    def step_k(bins, scores, g, h, bag, feat_info, k):
-        gk = jnp.take(g, k, axis=1)
-        hk = jnp.take(h, k, axis=1)
-        gh = jnp.stack([gk * bag, hk * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
-        delta = lr * tree.leaf_value[row_leaf]
-        scores = scores + delta[:, None] * jax.nn.one_hot(
-            k, num_class, dtype=scores.dtype)[None, :]
-        return apply_shrinkage(tree, lr), scores
-
-    step_mapped = jax.jit(jax.shard_map(
-        step_k, mesh=mesh,
+    bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
+    mapped = jax.shard_map(
+        steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS, None),
-                  P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS),
-                  P(FEATURE_AXIS, None), P()),
+                  P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), bag_spec,
+                  P(None, FEATURE_AXIS, None)),
         out_specs=(P(), P(DATA_AXIS, None)),
-        check_vma=False), donate_argnums=(1,))
-    return grads_mapped, step_mapped
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(1,))
 
 
 def prepare_arrays(bins: np.ndarray, labels: np.ndarray, weights: np.ndarray,
@@ -166,7 +179,7 @@ def prepare_arrays(bins: np.ndarray, labels: np.ndarray, weights: np.ndarray,
         [np.ones(n, np.float32), np.zeros(rp, np.float32)])
 
     bins_d = jax.device_put(
-        jnp.asarray(bins, jnp.int32),
+        jnp.asarray(bins),   # dtype preserved (uint8 when B <= 256)
         NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS)))
     lab_d = jax.device_put(
         jnp.asarray(labels, jnp.int32 if num_class > 1 else jnp.float32),

@@ -78,80 +78,144 @@ def _boost_step(bins, scores, labels, weights, bag_mask, feat_info,
     return tree, scores
 
 
-@functools.partial(jax.jit, static_argnames=("obj",))
-def _grad_hess_jit(scores, labels, weights, obj: Objective):
-    return obj.grad_hess(scores, labels, weights)
+def _dummy_val(K: int):
+    return jnp.zeros((0,) if K == 1 else (0, K), jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr", "k1", "k2",
-                                             "amp"),
-                   donate_argnums=(1,))
-def _boost_step_goss(bins, scores, labels, weights, key, feat_info,
-                     obj: Objective, cfg: GrowerConfig, lr: float,
-                     k1: int, k2: int, amp: float):
-    """One GOSS iteration: grow the tree on top-|g·h| rows plus an amplified
-    random sample of the rest (Ke et al. 2017; LightGBM boosting=goss).
+@functools.partial(jax.jit,
+                   static_argnames=("obj", "cfg", "lr", "has_val"),
+                   donate_argnums=(1, 7))
+def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
+                val_bins, val_scores, obj: Objective, cfg: GrowerConfig,
+                lr: float, has_val: bool):
+    """A chunk of boosting iterations inside ONE compiled program.
 
-    The histogram work shrinks to ``(topRate + otherRate)·n`` rows — the
-    LightGBM-native answer to the hot loop's cost, and the one that maps
-    best to TPUs (a gather instead of sparse masking).  Scores still update
-    for every row via a full binned traversal of the new tree.
+    ``bag_masks``: (C, n) bagging masks, or (C, 1) broadcast when bagging
+    is off; ``fi_stack``: (C, f, 3) per-iteration feature info.  Returns
+    (stacked shrunk trees, scores, val_scores, per-iter val scores).
+
+    One launch per chunk instead of per iteration: on a tunneled TPU every
+    dispatch pays a ~ms RPC floor (BENCH_SWEEP.md), so the loop-of-steps
+    formulation spent more wall-clock in launch gaps than on device.  The
+    scan also lets XLA pipeline tree t's tail with tree t+1's head.  This
+    is the TPU-shaped analog of the reference keeping the whole iteration
+    loop behind one JNI call (SURVEY.md §3.1).
     """
-    g, h = obj.grad_hess(scores, labels, weights)
-    n = g.shape[0]
-    rank = jnp.argsort(-jnp.abs(g * h))          # descending influence
-    top_idx = rank[:k1]
-    rest = rank[k1:]
-    rk = jax.random.uniform(key, (n - k1,))
-    other_idx = jnp.take(rest, jnp.argsort(rk)[:k2])
-    idx = jnp.concatenate([top_idx, other_idx])
-    amp_vec = jnp.concatenate([
-        jnp.ones(k1, jnp.float32), jnp.full(k2, amp, jnp.float32)])
-    bins_g = jnp.take(bins, idx, axis=0)
-    gh = jnp.stack([jnp.take(g, idx) * amp_vec,
-                    jnp.take(h, idx) * amp_vec,
-                    jnp.ones(k1 + k2, jnp.float32)], axis=1)
-    tree, _ = _grow_tree_impl(bins_g, gh, feat_info, cfg)
-    scores = scores + lr * predict_tree_binned(tree, bins, cfg.num_leaves)
-    tree = apply_shrinkage(tree, lr)
-    return tree, scores
+    def body(carry, xs):
+        scores, val_scores = carry
+        bag, fi = xs
+        bag = jnp.broadcast_to(bag, scores.shape)
+        g, h = obj.grad_hess(scores, labels, weights)
+        gh = jnp.stack([g * bag, h * bag, bag], axis=1)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+        scores = scores + lr * tree.leaf_value[row_leaf]
+        tree = apply_shrinkage(tree, lr)
+        if has_val:
+            val_scores = val_scores + predict_tree_binned(
+                tree, val_bins, cfg.num_leaves)
+            out_val = val_scores
+        else:
+            out_val = _dummy_val(1)
+        return (scores, val_scores), (tree, out_val)
+
+    (scores, val_scores), (trees, val_hist) = jax.lax.scan(
+        body, (scores, val_scores), (bag_masks, fi_stack))
+    return trees, scores, val_scores, val_hist
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "lr", "k"),
-                   donate_argnums=(1,))
-def _boost_step_class_k(bins, scores, g, h, bag_mask, feat_info,
-                        cfg: GrowerConfig, lr: float, k: int):
-    """Grow class k's tree from grad/hess computed ONCE per iteration.
+@functools.partial(jax.jit,
+                   static_argnames=("obj", "cfg", "lr", "k1", "k2", "amp",
+                                    "has_val"),
+                   donate_argnums=(1, 7))
+def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
+                     val_bins, val_scores, obj: Objective, cfg: GrowerConfig,
+                     lr: float, k1: int, k2: int, amp: float, has_val: bool):
+    """GOSS chunk: each iteration grows its tree on the top-|g·h| rows plus
+    an amplified random sample of the rest (Ke et al. 2017; LightGBM
+    boosting=goss).  Histogram work shrinks to ``(topRate + otherRate)·n``
+    rows via a gather; scores still update for every row via a full binned
+    traversal of the new tree."""
+    def body(carry, xs):
+        scores, val_scores = carry
+        key, fi = xs
+        g, h = obj.grad_hess(scores, labels, weights)
+        n = g.shape[0]
+        rank = jnp.argsort(-jnp.abs(g * h))          # descending influence
+        top_idx = rank[:k1]
+        rest = rank[k1:]
+        rk = jax.random.uniform(key, (n - k1,))
+        other_idx = jnp.take(rest, jnp.argsort(rk)[:k2])
+        idx = jnp.concatenate([top_idx, other_idx])
+        amp_vec = jnp.concatenate([
+            jnp.ones(k1, jnp.float32), jnp.full(k2, amp, jnp.float32)])
+        bins_g = jnp.take(bins, idx, axis=0)
+        gh = jnp.stack([jnp.take(g, idx) * amp_vec,
+                        jnp.take(h, idx) * amp_vec,
+                        jnp.ones(k1 + k2, jnp.float32)], axis=1)
+        tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
+        scores = scores + lr * predict_tree_binned(tree, bins,
+                                                   cfg.num_leaves)
+        tree = apply_shrinkage(tree, lr)
+        if has_val:
+            val_scores = val_scores + predict_tree_binned(
+                tree, val_bins, cfg.num_leaves)
+            out_val = val_scores
+        else:
+            out_val = _dummy_val(1)
+        return (scores, val_scores), (tree, out_val)
 
-    LightGBM computes softmax gradients once per iteration for all K trees;
-    taking precomputed (g, h) here preserves that semantics instead of
-    re-deriving gradients after earlier classes' score updates.
-    """
-    gh = jnp.stack([g[:, k] * bag_mask, h[:, k] * bag_mask, bag_mask], axis=1)
-    tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
-    scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
-    tree = apply_shrinkage(tree, lr)
-    return tree, scores
+    (scores, val_scores), (trees, val_hist) = jax.lax.scan(
+        body, (scores, val_scores), (keys, fi_stack))
+    return trees, scores, val_scores, val_hist
 
 
-@functools.partial(jax.jit, static_argnames=("max_steps",))
-def _update_val_scores(tree: TreeArrays, val_bins, val_scores, lr,
-                       max_steps: int):
-    return val_scores + lr * predict_tree_binned(tree, val_bins, max_steps)
+@functools.partial(jax.jit,
+                   static_argnames=("obj", "cfg", "lr", "K", "has_val"),
+                   donate_argnums=(1, 7))
+def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
+                      val_bins, val_scores, obj: Objective,
+                      cfg: GrowerConfig, lr: float, K: int, has_val: bool):
+    """Multiclass chunk: grad/hess computed ONCE per iteration for all K
+    trees (LightGBM softmax semantics), then K grow steps consume the fixed
+    gradients.  Emits trees flattened to (C*K, ...), iteration-major,
+    class-minor — the order the model file expects."""
+    def body(carry, xs):
+        scores, val_scores = carry
+        bag, fi = xs
+        bag = jnp.broadcast_to(bag, (scores.shape[0],))
+        g, h = obj.grad_hess(scores, labels, weights)
+        trees_k = []
+        for k in range(K):
+            gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+            scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
+            tree = apply_shrinkage(tree, lr)
+            if has_val:
+                val_scores = val_scores.at[:, k].add(predict_tree_binned(
+                    tree, val_bins, cfg.num_leaves))
+            trees_k.append(tree)
+        trees = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees_k)
+        out_val = val_scores if has_val else _dummy_val(K)
+        return (scores, val_scores), (trees, out_val)
+
+    (scores, val_scores), (trees, val_hist) = jax.lax.scan(
+        body, (scores, val_scores), (bag_masks, fi_stack))
+    trees = jax.tree_util.tree_map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), trees)
+    return trees, scores, val_scores, val_hist
 
 
 @jax.jit
-def _pack_trees(trees: List[TreeArrays]) -> jnp.ndarray:
-    """Flatten a list of TreeArrays into one (T, P) f32 buffer.
+def _pack_trees_stacked(stacked: TreeArrays) -> jnp.ndarray:
+    """Flatten stacked (T, ...) TreeArrays into one (T, P) f32 buffer.
 
     Device→host latency dominates on a tunneled TPU (each transfer costs
     ~the round-trip time regardless of size), so the whole forest crosses
     in ONE transfer instead of 12 per tree.  int fields fit f32 exactly
     (node/feature/bin ids ≪ 2^24); counts are already f32 on device.
-    Stacking happens *inside* jit so trees produced under shard_map (multi-
+    Packing happens *inside* jit so trees produced under shard_map (multi-
     device, replicated) are legal inputs — XLA inserts the resharding.
     """
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
     f32 = lambda a: a.astype(jnp.float32)  # noqa: E731
     T = stacked.node_cat_bits.shape[0]
     bits = stacked.node_cat_bits.reshape(T, -1)
@@ -170,19 +234,18 @@ def _pack_trees(trees: List[TreeArrays]) -> jnp.ndarray:
     ], axis=1)
 
 
-def _fetch_host_trees(trees_dev: List[TreeArrays], num_leaves: int,
+def _fetch_host_trees(chunks: List[TreeArrays], num_leaves: int,
                       mapper: BinMapper) -> Tuple[List[HostTree], np.ndarray]:
-    """One batched device→host transfer → per-tree HostTrees + leaf counts."""
-    if not trees_dev:
+    """Batched device→host transfers → per-tree HostTrees + leaf counts.
+
+    ``chunks``: stacked (C_i, ...) TreeArrays pytrees as produced by the
+    scan steps — one packed transfer per chunk (typically one per fit)."""
+    if not chunks:
         return [], np.zeros(0, np.int64)
-    # Pad the list to a power-of-two bucket so _pack_trees compiles once per
-    # bucket size instead of once per distinct forest size.
-    T = len(trees_dev)
-    bucket = max(8, 1 << (T - 1).bit_length())
-    packed = np.asarray(_pack_trees(
-        trees_dev + [trees_dev[0]] * (bucket - T)))[:T]
+    packed = np.concatenate(
+        [np.asarray(_pack_trees_stacked(c)) for c in chunks])
     L, m = num_leaves, num_leaves - 1
-    W = trees_dev[0].node_cat_bits.shape[-1]
+    W = chunks[0].node_cat_bits.shape[-1]
     offs = np.cumsum([1] + [m] * 9 + [L] * 3 + [m * W] * 2)
     cols = [packed[:, a:b] for a, b in zip([0] + list(offs), offs)]
     nls = cols[0][:, 0].astype(np.int64)
@@ -324,7 +387,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             bins, labels, w, mapper, objective, params, cfg, mesh,
             feature_names, init, rng, bag_rng, init_scores)
 
-    bins_d = jnp.asarray(bins, jnp.int32)
+    bins_d = jnp.asarray(bins, mapper.bin_dtype)
     labels_d = jnp.asarray(labels,
                            jnp.int32 if K > 1 else jnp.float32)
     weights_d = jnp.asarray(w, jnp.float32)
@@ -337,87 +400,164 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
 
     has_val = val_bins is not None and val_metric is not None
     if has_val:
-        val_bins_d = jnp.asarray(val_bins, jnp.int32)
+        val_bins_d = jnp.asarray(val_bins, mapper.bin_dtype)
         val_scores = jnp.full(
             (val_bins.shape[0], K) if K > 1 else (val_bins.shape[0],),
             init, jnp.float32)
-        best_metric, best_iter = np.inf, -1
+        val_labels_np = np.asarray(val_labels)
+    else:
+        val_bins_d = jnp.zeros((1, f), mapper.bin_dtype)
+        val_scores = jnp.zeros((1, K) if K > 1 else (1,), jnp.float32)
+    best_metric, best_iter = np.inf, -1
 
-    ones = jnp.ones(n, jnp.float32)
-    bag_mask = ones
     fi_base = _feat_info_from_mapper(mapper, f)
-    fi = jnp.asarray(fi_base)
+    T = params.num_iterations
+    esr = params.early_stopping_round
+    use_bag = params.bagging_freq > 0 and params.bagging_fraction < 1.0
+    use_ff = params.feature_fraction < 1.0
+    cur_bag = np.ones(n, np.float32)
 
-    trees_dev: List[TreeArrays] = []
-    stop_iter = params.num_iterations
-    for it in range(params.num_iterations):
-        if params.bagging_freq > 0 and params.bagging_fraction < 1.0 \
-                and it % params.bagging_freq == 0:
-            keep = bag_rng.random(n) < params.bagging_fraction
-            bag_mask = jnp.asarray(keep.astype(np.float32))
-        if params.feature_fraction < 1.0:
-            k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
-            sel = rng.choice(f, size=k_keep, replace=False)
-            fi_it = fi_base.copy()
-            fi_it[:, 0] = 0.0
-            fi_it[sel, 0] = 1.0
-            fi = jnp.asarray(fi_it)
+    def iter_fi(_gi):
+        """Per-iteration feature-fraction mask (serial draw order)."""
+        if not use_ff:
+            return fi_base
+        k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
+        sel = rng.choice(f, size=k_keep, replace=False)
+        fi_it = fi_base.copy()
+        fi_it[:, 0] = 0.0
+        fi_it[sel, 0] = 1.0
+        return fi_it
 
-        if K > 1 and grad_fn_override is None:
-            g_iter, h_iter = _grad_hess_jit(scores, labels_d, weights_d,
-                                            objective)
-        for k in range(K):
-            if grad_fn_override is not None:
-                g, h = grad_fn_override(scores)
-                gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
-                tree, row_leaf = grow_tree(bins_d, gh, fi, cfg)
-                scores = scores + params.learning_rate * \
-                    tree.leaf_value[row_leaf]
-                tree = apply_shrinkage(tree, params.learning_rate)
-            elif K > 1:
-                tree, scores = _boost_step_class_k(
-                    bins_d, scores, g_iter, h_iter, bag_mask, fi,
-                    cfg, params.learning_rate, k)
-            elif use_goss:
-                tree, scores = _boost_step_goss(
-                    bins_d, scores, labels_d, weights_d, goss_keys[it],
-                    fi, objective, cfg, params.learning_rate,
-                    k1, k2, goss_amp)
-            else:
-                tree, scores = _boost_step(
-                    bins_d, scores, labels_d, weights_d, bag_mask, fi,
-                    objective, cfg, params.learning_rate)
-            trees_dev.append(tree)
+    # Chunking: iterations run on-device in lax.scan chunks; the host only
+    # syncs between chunks, where early stopping and callbacks live.  With
+    # no per-iteration host decision the whole fit is ONE launch.
+    if has_val:
+        # bounded regardless of esr: the scan stacks (chunk, n_val[, K])
+        # per-iteration val scores, which must not grow with T or esr
+        # (best_iter persists across chunks, so stopping stays correct)
+        chunk = min(T, max(min(esr, 64), 8) if esr > 0 else 64)
+    elif callbacks:
+        chunk = min(T, 8)
+    else:
+        chunk = T
+    if use_bag:
+        # bag_masks are (chunk, n): bound the chunk so per-fit device
+        # memory stays O(n), not O(T*n)
+        chunk = min(chunk, 64)
+
+    trees_chunks: List[TreeArrays] = []
+    stop_iter = T
+
+    if grad_fn_override is not None:
+        # Per-iteration host loop: the ranking gradient closes over query
+        # structure on the host (not a hashable static), so it can't ride
+        # the scan.  Trees still cross to the host as one packed chunk.
+        trees_list: List[TreeArrays] = []
+        for it in range(T):
+            if use_bag and it % params.bagging_freq == 0:
+                cur_bag = (bag_rng.random(n) < params.bagging_fraction
+                           ).astype(np.float32)
+            bag_mask = jnp.asarray(cur_bag)
+            fi = jnp.asarray(iter_fi(it))
+            g, h = grad_fn_override(scores)
+            gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
+            tree, row_leaf = grow_tree(bins_d, gh, fi, cfg)
+            scores = scores + params.learning_rate * \
+                tree.leaf_value[row_leaf]
+            tree = apply_shrinkage(tree, params.learning_rate)
+            trees_list.append(tree)
             if has_val:
-                # trees are already shrunk (apply_shrinkage inside the boost
-                # step), so val scores add leaf values at lr=1.0
-                if K == 1:
-                    val_scores = _update_val_scores(
-                        tree, val_bins_d, val_scores, 1.0, params.num_leaves)
-                else:
-                    val_scores = val_scores.at[:, k].set(_update_val_scores(
-                        tree, val_bins_d, val_scores[:, k],
-                        1.0, params.num_leaves))
-
-        if has_val:
-            metric = float(val_metric(np.asarray(val_scores),
-                                      np.asarray(val_labels), val_weights))
-            if metric < best_metric - 1e-12:
-                best_metric, best_iter = metric, it
-            elif params.early_stopping_round > 0 and \
-                    it - best_iter >= params.early_stopping_round:
-                if params.verbosity > 0:
-                    log.info("Early stopping at iteration %d "
-                             "(best %d, metric %.6f)", it, best_iter,
-                             best_metric)
-                stop_iter = best_iter + 1
-                trees_dev = trees_dev[:stop_iter * K]
+                # trees are already shrunk, so val scores add at lr=1.0
+                val_scores = val_scores + predict_tree_binned(
+                    tree, val_bins_d, params.num_leaves)
+                metric = float(val_metric(np.asarray(val_scores),
+                                          val_labels_np, val_weights))
+                if metric < best_metric - 1e-12:
+                    best_metric, best_iter = metric, it
+                elif esr > 0 and it - best_iter >= esr:
+                    if params.verbosity > 0:
+                        log.info("Early stopping at iteration %d "
+                                 "(best %d, metric %.6f)", it, best_iter,
+                                 best_metric)
+                    stop_iter = best_iter + 1
+                    trees_list = trees_list[:stop_iter]
+                    break
+            if callbacks:
+                for cb in callbacks:
+                    cb(it, trees_list)
+        if trees_list:
+            trees_chunks = [jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *trees_list)]
+    else:
+        cb_list: List[TreeArrays] = []
+        it = 0
+        while it < T:
+            C = min(chunk, T - it)
+            if use_bag:
+                rows = []
+                for j in range(C):
+                    if (it + j) % params.bagging_freq == 0:
+                        cur_bag = (bag_rng.random(n) <
+                                   params.bagging_fraction
+                                   ).astype(np.float32)
+                    rows.append(cur_bag)
+                bag_masks = jnp.asarray(np.stack(rows))
+            else:
+                bag_masks = jnp.ones((C, 1), jnp.float32)
+            if use_ff:
+                fi_stack = jnp.asarray(
+                    np.stack([iter_fi(it + j) for j in range(C)]))
+            else:
+                fi_stack = jnp.asarray(np.broadcast_to(
+                    fi_base, (C,) + fi_base.shape))
+            if use_goss:
+                trees_st, scores, val_scores, val_hist = _boost_scan_goss(
+                    bins_d, scores, labels_d, weights_d,
+                    goss_keys[it:it + C], fi_stack, val_bins_d, val_scores,
+                    objective, cfg, params.learning_rate, k1, k2, goss_amp,
+                    has_val)
+            elif K > 1:
+                trees_st, scores, val_scores, val_hist = _boost_scan_multi(
+                    bins_d, scores, labels_d, weights_d, bag_masks,
+                    fi_stack, val_bins_d, val_scores, objective, cfg,
+                    params.learning_rate, K, has_val)
+            else:
+                trees_st, scores, val_scores, val_hist = _boost_scan(
+                    bins_d, scores, labels_d, weights_d, bag_masks,
+                    fi_stack, val_bins_d, val_scores, objective, cfg,
+                    params.learning_rate, has_val)
+            trees_chunks.append(trees_st)
+            stop = False
+            if has_val:
+                vh = np.asarray(val_hist)        # (C, n_val[, K])
+                for j in range(C):
+                    metric = float(val_metric(vh[j], val_labels_np,
+                                              val_weights))
+                    gi = it + j
+                    if metric < best_metric - 1e-12:
+                        best_metric, best_iter = metric, gi
+                    elif esr > 0 and gi - best_iter >= esr:
+                        if params.verbosity > 0:
+                            log.info("Early stopping at iteration %d "
+                                     "(best %d, metric %.6f)", gi,
+                                     best_iter, best_metric)
+                        stop_iter = best_iter + 1
+                        stop = True
+                        break
+            if callbacks:
+                upto = stop_iter if stop else it + C
+                for j in range(upto - it):
+                    for k in range(K):
+                        cb_list.append(jax.tree_util.tree_map(
+                            lambda a, j=j, k=k: a[j * K + k], trees_st))
+                    for cb in callbacks:
+                        cb(it + j, cb_list)
+            if stop:
                 break
-        if callbacks:
-            for cb in callbacks:
-                cb(it, trees_dev)
+            it += C
 
-    trees, nls = _fetch_host_trees(trees_dev, params.num_leaves, mapper)
+    trees, nls = _fetch_host_trees(trees_chunks, params.num_leaves, mapper)
+    trees, nls = trees[:stop_iter * K], nls[:stop_iter * K]
     trees, stop_iter = _truncate_no_growth(trees, nls, K, stop_iter,
                                            params.verbosity)
     return _finalize_booster(trees, K, init, params, objective, mapper,
@@ -464,59 +604,78 @@ def _finalize_booster(trees, K, init, params, objective, mapper,
 def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                        feature_names, init, rng, bag_rng,
                        init_scores=None) -> Booster:
-    """Distributed boosting loop: one shard_mapped jit step per tree."""
-    from .distributed import (make_boost_step, make_multiclass_steps,
+    """Distributed boosting: the whole iteration loop is ONE shard_mapped
+    ``lax.scan`` launch (no per-iteration host round-trips)."""
+    from .distributed import (make_boost_scan, make_multiclass_scan,
                               prepare_arrays)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..core.mesh import DATA_AXIS, pad_to_multiple
 
     n, f = bins.shape
     K = objective.num_model_per_iteration
+    T = params.num_iterations
+    use_bag = params.bagging_freq > 0 and params.bagging_fraction < 1.0
+    use_ff = params.feature_fraction < 1.0
     if K > 1:
-        grads_fn, step = make_multiclass_steps(
-            mesh, objective, cfg, params.learning_rate, K)
+        step = make_multiclass_scan(
+            mesh, objective, cfg, params.learning_rate, K, use_bag)
     else:
-        grads_fn = None
-        step = make_boost_step(mesh, objective, cfg, params.learning_rate)
+        step = make_boost_scan(
+            mesh, objective, cfg, params.learning_rate, use_bag)
     bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
-        np.asarray(bins, np.int32), np.asarray(labels),
+        np.asarray(bins, mapper.bin_dtype), np.asarray(labels),
         np.asarray(w, np.float32), mesh, K, init, init_scores)
     f_padded = f + fp
 
     fi_base = np.zeros((f_padded, 3), np.float32)
     fi_base[:f] = _feat_info_from_mapper(mapper, f)
-    fi = jnp.asarray(fi_base)
 
-    trees_dev: List[TreeArrays] = []
-    stop_iter = params.num_iterations
-    bag = real
-    for it in range(params.num_iterations):
-        if params.bagging_freq > 0 and params.bagging_fraction < 1.0 \
-                and it % params.bagging_freq == 0:
-            # draw exactly n randoms so the stream matches a serial run
-            # with the same baggingSeed, then pad
-            keep = (bag_rng.random(n) < params.bagging_fraction)
-            keep = np.concatenate([keep, np.zeros(rp, bool)])
-            bag = real * jnp.asarray(keep.astype(np.float32))
-        if params.feature_fraction < 1.0:
-            k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
-            sel = rng.choice(f, size=k_keep, replace=False)
-            fi_it = fi_base.copy()
-            fi_it[:, 0] = 0.0
-            fi_it[sel, 0] = 1.0
-            fi = jnp.asarray(fi_it)
+    def iter_fi_dist(_gi):
+        if not use_ff:
+            return fi_base
+        k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
+        sel = rng.choice(f, size=k_keep, replace=False)
+        fi_it = fi_base.copy()
+        fi_it[:, 0] = 0.0
+        fi_it[sel, 0] = 1.0
+        return fi_it
 
-        if K > 1:
-            g_iter, h_iter = grads_fn(scores, labels_d, w_d)
-        for k in range(K):
-            if K > 1:
-                tree, scores = step(bins_d, scores, g_iter, h_iter, bag,
-                                    fi, jnp.asarray(k, jnp.int32))
-            else:
-                tree, scores = step(bins_d, scores, labels_d, w_d, bag,
-                                    fi, jnp.asarray(k, jnp.int32))
-            trees_dev.append(tree)
+    # Chunk only when bagging materializes per-iteration (chunk, n) masks;
+    # otherwise the whole fit is one launch with a constant (T, 1) mask
+    # (pad rows ride the (n,) `real` mask inside the step).
+    chunk = min(T, 64) if use_bag else T
+    cur = np.ones(n, np.float32)
+    chunks: List[TreeArrays] = []
+    it = 0
+    while it < T:
+        C = min(chunk, T - it)
+        if use_bag:
+            rows = []
+            for j in range(C):
+                if (it + j) % params.bagging_freq == 0:
+                    # draw exactly n randoms so the stream matches a
+                    # serial run with the same baggingSeed, then pad
+                    cur = (bag_rng.random(n) < params.bagging_fraction
+                           ).astype(np.float32)
+                rows.append(np.concatenate([cur, np.zeros(rp, np.float32)]))
+            bags = jax.device_put(jnp.asarray(np.stack(rows)),
+                                  NamedSharding(mesh, P(None, DATA_AXIS)))
+        else:
+            bags = jnp.ones((C, 1), jnp.float32)
+        if use_ff:
+            fi_stack = jnp.asarray(
+                np.stack([iter_fi_dist(it + j) for j in range(C)]))
+        else:
+            fi_stack = jnp.asarray(np.broadcast_to(fi_base,
+                                                   (C,) + fi_base.shape))
+        trees_st, scores = step(bins_d, scores, labels_d, w_d, real, bags,
+                                fi_stack)
+        chunks.append(trees_st)
+        it += C
 
-    trees, nls = _fetch_host_trees(trees_dev, params.num_leaves, mapper)
-    trees, stop_iter = _truncate_no_growth(trees, nls, K, stop_iter,
+    trees, nls = _fetch_host_trees(chunks, params.num_leaves, mapper)
+    trees, stop_iter = _truncate_no_growth(trees, nls, K, T,
                                            params.verbosity)
     return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)

@@ -41,10 +41,39 @@ import numpy as np
 GH_CHANNELS = 3  # grad, hess, count
 
 
-def _auto_method() -> str:
-    # dot16 currently beats the pallas kernel on v5e (the B·3/128² output
-    # bound caps both; XLA's scan pipelines better) — keep pallas opt-in.
-    return "dot16" if jax.default_backend() in ("tpu", "axon") else "segment"
+_SWEEP_CACHE: dict = {}
+
+
+def _load_sweep(backend: str) -> Optional[dict]:
+    """Measured winner-by-rows table for this backend (see
+    tools/sweep_histogram.py), or None if never swept."""
+    if backend not in _SWEEP_CACHE:
+        import json
+        import os
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"_sweep_{backend}.json")
+        table = None
+        try:
+            with open(path) as fh:
+                table = json.load(fh).get("winner_by_rows") or None
+        except (OSError, ValueError):
+            pass
+        _SWEEP_CACHE[backend] = table
+    return _SWEEP_CACHE[backend]
+
+
+def _auto_method(n_rows: Optional[int] = None) -> str:
+    """Pick the histogram formulation for a call site of ``n_rows`` rows
+    from this backend's measured sweep table; fall back to segment (CPU) /
+    dot16 (accelerators) where no table exists."""
+    backend = jax.default_backend()
+    table = _load_sweep(backend)
+    if table and n_rows:
+        for s in sorted(int(k) for k in table):
+            if n_rows <= s:
+                return table[str(s)]
+        return table[str(max(int(k) for k in table))]
+    return "dot16" if backend in ("tpu", "axon") else "segment"
 
 
 def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
@@ -64,7 +93,7 @@ def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
       ``(f, num_bins, 3)`` float32 histogram.
     """
     if method == "auto":
-        method = _auto_method()
+        method = _auto_method(bins.shape[0])
     if method == "segment":
         return _hist_segment(bins, gh, num_bins)
     if method == "dot16":
@@ -76,7 +105,7 @@ def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         if num_bins > BMAX:   # kernel folds 16x16 nibbles; fall back
             return _hist_dot16(bins, gh, num_bins, row_chunk)
         return histogram_pallas(
-            bins, gh.astype(jnp.float32), num_bins,
+            bins.astype(jnp.int32), gh.astype(jnp.float32), num_bins,
             row_chunk=min(row_chunk, 4096),   # VMEM ceiling for the kernel
             accum="bfloat16" if method == "pallas_bf16" else "float32",
             interpret=jax.default_backend() == "cpu")
@@ -87,7 +116,8 @@ def _hist_segment(bins, gh, num_bins):
     gh = gh.astype(jnp.float32)
 
     def per_feature(col):
-        return jax.ops.segment_sum(gh, col, num_segments=num_bins)
+        return jax.ops.segment_sum(gh, col.astype(jnp.int32),
+                                   num_segments=num_bins)
 
     # vmap over features: (f, n) -> (f, B, 3)
     return jax.vmap(per_feature)(bins.T)
@@ -106,6 +136,7 @@ def _hist_onehot(bins, gh, num_bins, row_chunk):
 
     def step(acc, args):
         b, g = args
+        b = b.astype(jnp.int32)   # bins may arrive uint8; cast per chunk
         onehot = (b[:, :, None] == jnp.arange(num_bins)[None, None, :])
         acc = acc + jnp.einsum("nfb,nc->fbc", onehot.astype(jnp.float32), g)
         return acc, None
@@ -132,6 +163,7 @@ def _hist_dot16(bins, gh, num_bins, row_chunk):
 
     def step(acc, args):
         b, g = args                      # (c, f) int, (c, 3) f32
+        b = b.astype(jnp.int32)          # bins may arrive uint8
         lo = b % 16                      # (c, f)
         hi = b // 16
         lo_oh = (lo[:, :, None] == lo_iota).astype(jnp.float32)   # (c, f, 16)

@@ -283,19 +283,19 @@ class TestValScoreScale:
         t = dict(binary_table)
         t["valid"] = vmask.astype(np.float64)
         captured = {}
-        orig = eng._update_val_scores
+        orig = eng._boost_scan
 
-        def spy(tree, vb, vs, lr, ms):
-            out = orig(tree, vb, vs, lr, ms)
-            captured["val"] = np.asarray(out)
+        def spy(*args, **kw):
+            out = orig(*args, **kw)
+            captured["val"] = np.asarray(out[2])   # final val_scores carry
             return out
-        eng._update_val_scores = spy
+        eng._boost_scan = spy
         try:
             m = LightGBMClassifier(
                 numIterations=3, validationIndicatorCol="valid",
                 earlyStoppingRound=100, verbosity=0).fit(t)
         finally:
-            eng._update_val_scores = orig
+            eng._boost_scan = orig
         margins = np.asarray(m.getModel().predict_margin(
             np.asarray(binary_table["features"])[vmask]))
         assert np.allclose(captured["val"], margins, atol=1e-4)

@@ -1,0 +1,163 @@
+"""On-device histogram-method sweep → BENCH_SWEEP.md + auto-method table.
+
+Measures every histogram formulation in :mod:`mmlspark_tpu.ops.histogram`
+across the row-bucket sizes the compacting grower actually issues
+(2048 … 2^⌈lg n⌉), on whatever backend jax selects.
+
+Timing is **in-program**: each method runs R times inside one compiled
+``lax.scan`` and once inside another, and the per-call time is the slope
+``(t_R - t_1) / (R - 1)``.  A per-launch wall-clock measurement would be
+useless here — on a tunneled TPU every dispatch pays a ~2-3 ms RPC floor
+that swamps sub-millisecond kernels (this is exactly the artifact that made
+round-2's "dot16 beats pallas" folk wisdom unverifiable).
+
+Writes:
+
+* ``BENCH_SWEEP.md`` — the human-readable sweep table (committed artifact;
+  VERDICT r1 item #2 / r2 item #2).
+* ``mmlspark_tpu/ops/_sweep_<backend>.json`` — winner per bucket size,
+  consumed by ``_auto_method`` so ``hist_method="auto"`` picks from
+  measured data for this backend.  ``pallas_bf16`` is reported but
+  excluded from the winner table: "auto" must not silently change
+  numerics (bf16 operand rounding); it stays opt-in.
+
+Usage:  python tools/sweep_histogram.py [--features 50] [--bins 256]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXACT_METHODS = ["segment", "dot16", "onehot", "pallas"]
+ALL_METHODS = EXACT_METHODS + ["pallas_bf16"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--features", type=int, default=50)
+    ap.add_argument("--bins", type=int, default=256)
+    ap.add_argument("--reps", type=int, default=17,
+                    help="in-program repetitions for the slope measurement")
+    ap.add_argument("--out", default="BENCH_SWEEP.md")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mmlspark_tpu.ops.histogram import compute_histogram
+
+    backend = jax.default_backend()
+    f, B, R = args.features, args.bins, args.reps
+    sizes = [2048, 4096, 8192, 16384, 32768, 65536, 131072, 262144, 524288]
+    rng = np.random.default_rng(0)
+
+    def timed_per_call(method, bins, gh_stack):
+        """Per-call seconds via the two-point in-program slope."""
+        n = bins.shape[0]
+
+        def make(reps):
+            @jax.jit
+            def run(bins, gh_stack):
+                def body(acc, gh):
+                    out = compute_histogram(bins, gh, B, method=method)
+                    return acc + out, None
+                acc, _ = jax.lax.scan(
+                    body, jnp.zeros((f, B, 3), jnp.float32),
+                    gh_stack[:reps])
+                return acc
+            return run
+
+        run_r, run_1 = make(R), make(1)
+        out = run_r(bins, gh_stack); out.block_until_ready()
+        out = run_1(bins, gh_stack); out.block_until_ready()
+        best = np.inf
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = run_r(bins, gh_stack); out.block_until_ready()
+            t_r = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out = run_1(bins, gh_stack); out.block_until_ready()
+            t_1 = time.perf_counter() - t0
+            best = min(best, (t_r - t_1) / (R - 1))
+        return max(best, 0.0)
+
+    rows = []
+    winners = {}
+    for n in sizes:
+        bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.uint8)
+        gh_stack = jnp.asarray(rng.normal(size=(R, n, 3)), jnp.float32)
+        ref = None
+        times = {}
+        for m in ALL_METHODS:
+            try:
+                out = jax.jit(
+                    lambda b, g, m=m: compute_histogram(b, g, B, method=m)
+                )(bins, gh_stack[0])
+                out.block_until_ready()
+                if ref is None:
+                    ref = np.asarray(out)
+                else:
+                    err = float(np.max(np.abs(np.asarray(out) - ref)))
+                    scale = float(np.max(np.abs(ref))) or 1.0
+                    assert err / scale < 2e-2, f"{m} mismatch {err}"
+                times[m] = timed_per_call(m, bins, gh_stack) * 1e6
+            except Exception as e:  # noqa: BLE001
+                times[m] = None
+                print(f"  n={n} {m}: FAIL {type(e).__name__}: {e}",
+                      file=sys.stderr)
+        ok = {k: v for k, v in times.items()
+              if v is not None and k in EXACT_METHODS}
+        best = min(ok, key=ok.get) if ok else "dot16"
+        winners[str(n)] = best
+        rows.append((n, times, best))
+        print(f"n={n:7d} " + " ".join(
+            f"{m}={times[m]:.0f}us" if times[m] is not None else f"{m}=FAIL"
+            for m in ALL_METHODS) + f"  -> {best}")
+
+    lines = [
+        "# Histogram-method sweep",
+        "",
+        f"Backend: **{backend}** ({jax.devices()[0].device_kind}); "
+        f"shapes: (n, {f}) uint8 bins, {B} bins, 3 gradient channels.  "
+        f"Per-call microseconds via the in-program slope "
+        f"(R={args.reps} scan reps vs 1; best of 3) — per-launch timing "
+        "is meaningless on a tunneled TPU where every dispatch pays a "
+        "~2-3 ms RPC floor.",
+        "",
+        "| rows | " + " | ".join(ALL_METHODS) + " | winner (f32-exact) |",
+        "|---:|" + "---:|" * (len(ALL_METHODS) + 1),
+    ]
+    for n, times, best in rows:
+        cells = [f"{times[m]:.0f}" if times[m] is not None else "—"
+                 for m in ALL_METHODS]
+        lines.append(f"| {n} | " + " | ".join(cells) + f" | **{best}** |")
+    lines += [
+        "",
+        "`compute_histogram(method='auto')` consults the per-backend winner "
+        f"table (`mmlspark_tpu/ops/_sweep_{backend}.json`, written by this "
+        "script) keyed by the static row count of each call site — the "
+        "compacting grower's bucket branches each get the method measured "
+        "fastest at that size.  Backends without a table fall back to "
+        "segment (CPU) / dot16 (accelerators).  `pallas_bf16` is excluded "
+        "from 'auto' (numerics) and stays opt-in.",
+        "",
+    ]
+    with open(args.out, "w") as fh:
+        fh.write("\n".join(lines))
+    sweep_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "mmlspark_tpu", "ops", f"_sweep_{backend}.json")
+    with open(sweep_path, "w") as fh:
+        json.dump({"backend": backend,
+                   "device_kind": jax.devices()[0].device_kind,
+                   "features": f, "num_bins": B,
+                   "winner_by_rows": winners}, fh, indent=1)
+    print(f"wrote {args.out} and {sweep_path}")
+
+
+if __name__ == "__main__":
+    main()
