@@ -101,9 +101,19 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
     seed = Param("seed", "Random seed", default=42,
                  typeConverter=TypeConverters.toInt)
     boostingType = Param("boostingType",
-                         "gbdt (plain boosting) or goss (gradient-based "
-                         "one-side sampling)", default="gbdt",
+                         "gbdt (plain boosting), goss (gradient-based "
+                         "one-side sampling), dart (dropout boosting) or "
+                         "rf (random forest)", default="gbdt",
                          typeConverter=TypeConverters.toString)
+    dropRate = Param("dropRate", "dart: per-tree dropout probability",
+                     default=0.1, typeConverter=TypeConverters.toFloat)
+    maxDrop = Param("maxDrop", "dart: max trees dropped per iteration",
+                    default=50, typeConverter=TypeConverters.toInt)
+    skipDrop = Param("skipDrop", "dart: probability of skipping dropout "
+                     "for an iteration", default=0.5,
+                     typeConverter=TypeConverters.toFloat)
+    dropSeed = Param("dropSeed", "dart: dropout random seed", default=4,
+                     typeConverter=TypeConverters.toInt)
     topRate = Param("topRate",
                     "GOSS: fraction of rows kept by largest gradient",
                     default=0.2, typeConverter=TypeConverters.toFloat)
@@ -135,6 +145,10 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
     maxCatToOnehot = Param(
         "maxCatToOnehot", "Cardinality at or below which one-vs-rest "
         "splits are used", default=4, typeConverter=TypeConverters.toInt)
+    topK = Param("topK",
+                 "voting parallelism (PV-Tree): features each worker "
+                 "votes per split (reference LightGBMParams.topK)",
+                 default=20, typeConverter=TypeConverters.toInt)
     passThroughArgs = Param("passThroughArgs",
                             "Raw 'key=value key=value' LightGBM param string "
                             "recorded into the model file",
@@ -167,8 +181,14 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
             boosting=self.getBoostingType(),
             top_rate=self.getTopRate(),
             other_rate=self.getOtherRate(),
+            drop_rate=self.getDropRate(),
+            max_drop=self.getMaxDrop(),
+            skip_drop=self.getSkipDrop(),
+            drop_seed=self.getDropSeed(),
             histogram_method=self.getHistogramMethod(),
             verbosity=self.getVerbosity(),
+            parallelism=self.getParallelism(),
+            top_k=self.getTopK(),
             cat_smooth=self.getCatSmooth(),
             cat_l2=self.getCatL2(),
             max_cat_threshold=self.getMaxCatThreshold(),
@@ -200,6 +220,11 @@ class LightGBMBase(Estimator, LightGBMParams):
         raise NotImplementedError
 
     def _grad_fn_override(self, table: DataTable, train_idx, y, w):
+        return None
+
+    def _ranking_info(self, table: DataTable, train_idx):
+        """Structured query info for the mesh-sharded lambdarank path
+        (rankers override; see engine._train_distributed_ranking)."""
         return None
 
     def _val_metric(self):
@@ -255,14 +280,23 @@ class LightGBMBase(Estimator, LightGBMParams):
         has_val = val_mask is not None and val_mask.any()
 
         params = self._train_params()
-        grad_override = self._grad_fn_override(table, train_idx, y_train,
-                                               w_train)
+        ranking_info = self._ranking_info(table, train_idx)
+        mesh = getattr(self, "_mesh", None)
+        mesh_multi = mesh is not None and int(np.prod(
+            [mesh.shape[a] for a in mesh.axis_names])) > 1
+        if mesh_multi and ranking_info is not None:
+            # the mesh lambdarank path consumes ranking_info directly;
+            # don't build (and device-transfer) the serial gradient
+            # closure just to discard it
+            grad_override = None
+        else:
+            grad_override = self._grad_fn_override(table, train_idx,
+                                                   y_train, w_train)
         # Distributed by default when a mesh is available, like the
         # reference trains across all executors (SURVEY.md §3.1); the
         # parallelism param picks the axis layout.
-        mesh = getattr(self, "_mesh", None)
-        if mesh is None and grad_override is None and not has_val \
-                and self.getBoostingType() != "goss":
+        if mesh is None and grad_override is None and ranking_info is None \
+                and self.getBoostingType() not in ("goss", "dart", "rf"):
             import jax
             if jax.device_count() > 1:
                 from .distributed import resolve_mesh
@@ -284,6 +318,7 @@ class LightGBMBase(Estimator, LightGBMParams):
             grad_fn_override=grad_override,
             mesh=mesh,
             init_scores=init_scores,
+            ranking_info=ranking_info,
             **val_kwargs)
         model = self._make_model(booster)
         model.setParams(**{k: v for k, v in self._iterSetParams()

@@ -35,7 +35,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.mesh import DATA_AXIS, FEATURE_AXIS
-from .grower import GrowerConfig, TreeArrays, _grow_tree_impl, apply_shrinkage
+from .grower import (GrowerConfig, TreeArrays, _grow_tree_impl,
+                     apply_shrinkage, predict_tree_binned)
 from .objectives import Objective
 
 
@@ -73,7 +74,7 @@ def _sharded_cfg(mesh: Mesh, cfg: GrowerConfig) -> GrowerConfig:
 
 
 def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
-                    bag_sharded: bool):
+                    bag_sharded: bool, has_val: bool = False):
     """Chunked distributed boosting: a ``lax.scan`` over iterations INSIDE
     the shard_map, so a whole chunk of trees trains in one launch with all
     histogram psums compiler-scheduled onto ICI (the reference's per-
@@ -83,45 +84,69 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
     rows), folded into every iteration's mask.  ``bags``: (C, n) bagging
     masks sharded over ``data`` when ``bag_sharded``, else a constant
     (C, 1) broadcast — so a padded no-bagging fit costs one (n,) mask, not
-    a (C, n) stack of identical copies.  Returns stacked replicated trees
-    and the final sharded scores.
+    a (C, n) stack of identical copies.
+
+    ``has_val``: validation rows ride the mesh too — ``val_bins`` is
+    sharded over ``data`` with ALL features per shard (trees are
+    replicated, so each shard scores its own validation slice), and the
+    per-iteration validation margins come back as a (C, n_val) array for
+    host-side metric replay / early stopping (the reference's executor-
+    side eval, SURVEY.md §3.1).
+
+    Returns (stacked replicated trees, sharded scores, sharded val_scores,
+    per-iteration val history).
     """
     cfg = _sharded_cfg(mesh, cfg)
 
-    def steps(bins, scores, labels, weights, real, bags, fis):
-        def body(scores, xs):
+    def steps(bins, scores, labels, weights, real, bags, fis,
+              val_bins, val_scores):
+        def body(carry, xs):
+            scores, val_scores = carry
             bag, fi = xs
             bag = jnp.broadcast_to(bag, scores.shape) * real
             g, h = obj.grad_hess(scores, labels, weights)
             gh = jnp.stack([g * bag, h * bag, bag], axis=1)
             tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
             scores = scores + lr * tree.leaf_value[row_leaf]
-            return scores, apply_shrinkage(tree, lr)
+            tree = apply_shrinkage(tree, lr)
+            if has_val:
+                val_scores = val_scores + predict_tree_binned(
+                    tree, val_bins, cfg.num_leaves)
+                out_v = val_scores
+            else:
+                out_v = jnp.zeros((0,), jnp.float32)
+            return (scores, val_scores), (tree, out_v)
 
-        scores, trees = jax.lax.scan(body, scores, (bags, fis))
-        return trees, scores
+        (scores, val_scores), (trees, val_hist) = jax.lax.scan(
+            body, (scores, val_scores), (bags, fis))
+        return trees, scores, val_scores, val_hist
 
     bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
+    val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
     mapped = jax.shard_map(
         steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), bag_spec,
-                  P(None, FEATURE_AXIS, None)),
-        out_specs=(P(), P(DATA_AXIS)),
+                  P(None, FEATURE_AXIS, None),
+                  P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), val_hist_spec),
         check_vma=False)
-    return jax.jit(mapped, donate_argnums=(1,))
+    return jax.jit(mapped, donate_argnums=(1, 8))
 
 
 def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
-                         lr: float, num_class: int, bag_sharded: bool):
+                         lr: float, num_class: int, bag_sharded: bool,
+                         has_val: bool = False):
     """Multiclass distributed chunk: grad/hess once per iteration for all K
     trees (LightGBM softmax semantics), K grow steps per scan iteration.
     Trees come back stacked (C*K, ...), iteration-major."""
     cfg = _sharded_cfg(mesh, cfg)
     K = num_class
 
-    def steps(bins, scores, labels, weights, real, bags, fis):
-        def body(scores, xs):
+    def steps(bins, scores, labels, weights, real, bags, fis,
+              val_bins, val_scores):
+        def body(carry, xs):
+            scores, val_scores = carry
             bag, fi = xs
             bag = jnp.broadcast_to(bag, (scores.shape[0],)) * real
             g, h = obj.grad_hess(scores, labels, weights)
@@ -130,25 +155,94 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
                 gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
                 tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
                 scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
-                trees_k.append(apply_shrinkage(tree, lr))
+                tree = apply_shrinkage(tree, lr)
+                if has_val:
+                    val_scores = val_scores.at[:, k].add(
+                        predict_tree_binned(tree, val_bins,
+                                            cfg.num_leaves))
+                trees_k.append(tree)
             trees = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *trees_k)
-            return scores, trees
+            out_v = val_scores if has_val else jnp.zeros((0, K), jnp.float32)
+            return (scores, val_scores), (trees, out_v)
 
-        scores, trees = jax.lax.scan(body, scores, (bags, fis))
+        (scores, val_scores), (trees, val_hist) = jax.lax.scan(
+            body, (scores, val_scores), (bags, fis))
         trees = jax.tree_util.tree_map(
             lambda a: a.reshape((-1,) + a.shape[2:]), trees)
-        return trees, scores
+        return trees, scores, val_scores, val_hist
 
     bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
+    val_hist_spec = P(None, DATA_AXIS, None) if has_val else P(None, None)
     mapped = jax.shard_map(
         steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS, None),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), bag_spec,
-                  P(None, FEATURE_AXIS, None)),
-        out_specs=(P(), P(DATA_AXIS, None)),
+                  P(None, FEATURE_AXIS, None),
+                  P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS, None),
+                   val_hist_spec),
         check_vma=False)
-    return jax.jit(mapped, donate_argnums=(1,))
+    return jax.jit(mapped, donate_argnums=(1, 8))
+
+
+def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
+                      sigma: float, trunc: int, has_val: bool = False):
+    """Mesh-sharded lambdarank boosting (SURVEY.md §3.1 distributed
+    lambdarank, BASELINE config MSLR): rows arrive query-packed per data
+    shard (see :func:`mmlspark_tpu.gbdt.ranking.shard_queries`), so the
+    pairwise ΔNDCG gradients are shard-LOCAL — no collective touches the
+    (c, G, G) lambda tensors; only the histogram psum crosses ICI, exactly
+    like the classifier path.
+
+    ``qidx/qmask/gains/labq`` are (D*n_chunks, chunk, G) and ``invmax``
+    (D*n_chunks, chunk), sharded over ``data`` on the leading axis;
+    ``real`` masks pad rows.  Validation margins ride the mesh as in
+    :func:`make_boost_scan`.
+    """
+    from .ranking import lambda_grad_sorted
+
+    cfg = _sharded_cfg(mesh, cfg)
+
+    def steps(bins, scores, real, wmul, qidx, qmask, gains, labq, invmax,
+              fis, val_bins, val_scores):
+        nl = scores.shape[0]
+
+        def body(carry, fi):
+            scores, val_scores = carry
+            g, h = lambda_grad_sorted(scores, qidx, qmask, gains, labq,
+                                      invmax, sigma, trunc, nl)
+            h = jnp.maximum(h, 1e-9)
+            # wmul = row weight * validity (LightGBM ranker weightCol
+            # semantics); the count channel carries plain validity
+            gh = jnp.stack([g * wmul, h * wmul, real], axis=1)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+            scores = scores + lr * tree.leaf_value[row_leaf]
+            tree = apply_shrinkage(tree, lr)
+            if has_val:
+                val_scores = val_scores + predict_tree_binned(
+                    tree, val_bins, cfg.num_leaves)
+                out_v = val_scores
+            else:
+                out_v = jnp.zeros((0,), jnp.float32)
+            return (scores, val_scores), (tree, out_v)
+
+        (scores, val_scores), (trees, val_hist) = jax.lax.scan(
+            body, (scores, val_scores), fis)
+        return trees, scores, val_scores, val_hist
+
+    val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
+    mapped = jax.shard_map(
+        steps, mesh=mesh,
+        in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(DATA_AXIS, None, None),
+                  P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                  P(DATA_AXIS, None, None), P(DATA_AXIS, None),
+                  P(None, FEATURE_AXIS, None),
+                  P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), val_hist_spec),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(1, 11))
 
 
 def prepare_arrays(bins: np.ndarray, labels: np.ndarray, weights: np.ndarray,

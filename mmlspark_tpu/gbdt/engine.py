@@ -49,10 +49,21 @@ class TrainParams:
     boost_from_average: bool = True
     seed: int = 42
     bagging_seed: int = 3
-    #: "gbdt" or "goss" (gradient-based one-side sampling)
+    #: "gbdt", "goss" (gradient-based one-side sampling), "dart"
+    #: (dropout-boosting, Rashmi & Gilad-Bachrach 2015), or "rf"
+    #: (random forest: bagged unshrunk trees, averaged)
     boosting: str = "gbdt"
     top_rate: float = 0.2
     other_rate: float = 0.1
+    #: dart knobs (LightGBM names/defaults)
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    drop_seed: int = 4
+    #: mesh-axis layout ("serial"/"data"/"feature"/"data+feature"/"voting")
+    parallelism: str = "data"
+    #: PV-Tree voting: features voted per shard (LightGBM top_k)
+    top_k: int = 20
     histogram_method: str = "auto"
     verbosity: int = 1
     #: categorical split knobs (LightGBM names)
@@ -78,16 +89,29 @@ def _boost_step(bins, scores, labels, weights, bag_mask, feat_info,
     return tree, scores
 
 
+def _draw_feature_fraction(rng, fi_base: np.ndarray, f: int,
+                           feature_fraction: float) -> np.ndarray:
+    """One per-iteration featureFraction mask draw.  Every training path
+    (serial, mesh, mesh-ranking) consumes the SAME rng stream through this
+    helper, preserving the serial draw-order reproducibility contract."""
+    k_keep = max(1, int(np.ceil(f * feature_fraction)))
+    sel = rng.choice(f, size=k_keep, replace=False)
+    fi_it = fi_base.copy()
+    fi_it[:, 0] = 0.0
+    fi_it[sel, 0] = 1.0
+    return fi_it
+
+
 def _dummy_val(K: int):
     return jnp.zeros((0,) if K == 1 else (0, K), jnp.float32)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("obj", "cfg", "lr", "has_val"),
+                   static_argnames=("obj", "cfg", "lr", "has_val", "rf"),
                    donate_argnums=(1, 7))
 def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
                 val_bins, val_scores, obj: Objective, cfg: GrowerConfig,
-                lr: float, has_val: bool):
+                lr: float, has_val: bool, rf: bool = False):
     """A chunk of boosting iterations inside ONE compiled program.
 
     ``bag_masks``: (C, n) bagging masks, or (C, 1) broadcast when bagging
@@ -108,8 +132,11 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
         g, h = obj.grad_hess(scores, labels, weights)
         gh = jnp.stack([g * bag, h * bag, bag], axis=1)
         tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
-        scores = scores + lr * tree.leaf_value[row_leaf]
-        tree = apply_shrinkage(tree, lr)
+        if not rf:
+            # rf (random forest): every tree fits the gradient at the
+            # CONSTANT init scores, unshrunk; averaging happens at export
+            scores = scores + lr * tree.leaf_value[row_leaf]
+            tree = apply_shrinkage(tree, lr)
         if has_val:
             val_scores = val_scores + predict_tree_binned(
                 tree, val_bins, cfg.num_leaves)
@@ -121,6 +148,20 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
     (scores, val_scores), (trees, val_hist) = jax.lax.scan(
         body, (scores, val_scores), (bag_masks, fi_stack))
     return trees, scores, val_scores, val_hist
+
+
+@functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"))
+def _dart_step(bins, s_minus, labels, weights, bag, fi, obj: Objective,
+               cfg: GrowerConfig, lr: float):
+    """One dart iteration body: fit a tree to the gradient at the dropped-
+    out score vector; returns the lr-shrunk tree and its base contribution
+    (the host applies the 1/(k+1) dart normalization)."""
+    g, h = obj.grad_hess(s_minus, labels, weights)
+    gh = jnp.stack([g * bag, h * bag, bag], axis=1)
+    tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+    tree = apply_shrinkage(tree, lr)
+    b_new = tree.leaf_value[row_leaf]
+    return tree, b_new
 
 
 @functools.partial(jax.jit,
@@ -293,7 +334,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
           grad_fn_override=None,
           callbacks: Optional[List[Callable]] = None,
           mesh=None,
-          init_scores: Optional[np.ndarray] = None) -> Booster:
+          init_scores: Optional[np.ndarray] = None,
+          ranking_info: Optional[Dict] = None) -> Booster:
     """Train a forest.  ``bins``: (n, f) int32 pre-binned features.
 
     ``grad_fn_override``: optional ``(scores) -> (g, h)`` replacing the
@@ -321,6 +363,11 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     init = objective.init_score(np.asarray(labels), w) \
         if params.boost_from_average and init_scores is None else 0.0
 
+    use_voting = params.parallelism == "voting"
+    if use_voting and mapper.has_categorical:
+        raise NotImplementedError(
+            "parallelism='voting' does not support categorical features "
+            "yet; use parallelism='data'")
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
         num_bins=mapper.num_total_bins, lambda_l1=params.lambda_l1,
@@ -328,16 +375,40 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
         hist_method=params.histogram_method,
+        voting_k=params.top_k if use_voting else 0,
         use_categorical=mapper.has_categorical,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
         max_cat_threshold=params.max_cat_threshold,
         max_cat_to_onehot=params.max_cat_to_onehot)
 
-    if params.boosting not in ("gbdt", "goss"):
+    if params.boosting not in ("gbdt", "goss", "dart", "rf"):
         raise NotImplementedError(
             f"boostingType={params.boosting!r} is not supported; "
-            "use 'gbdt' or 'goss' (dart/rf not yet implemented)")
+            "use 'gbdt', 'goss', 'dart' or 'rf'")
     use_goss = params.boosting == "goss"
+    use_dart = params.boosting == "dart"
+    use_rf = params.boosting == "rf"
+    if use_rf:
+        if not (params.bagging_freq > 0 and
+                0.0 < params.bagging_fraction < 1.0):
+            raise ValueError(
+                "boostingType='rf' requires bagging: set "
+                "baggingFraction in (0,1) and baggingFreq > 0 "
+                "(as in LightGBM)")
+        if grad_fn_override is not None or K > 1:
+            raise NotImplementedError(
+                "boostingType='rf' currently supports single-model "
+                "objectives (binary/regression)")
+    if use_dart:
+        if K > 1 or grad_fn_override is not None:
+            raise NotImplementedError(
+                "boostingType='dart' currently supports single-model "
+                "objectives (binary/regression)")
+        if params.early_stopping_round > 0:
+            raise NotImplementedError(
+                "boostingType='dart' does not support early stopping "
+                "(dropped-tree rescaling is not invertible by truncation); "
+                "unset earlyStoppingRound")
     if use_goss:
         if K > 1 or grad_fn_override is not None:
             raise NotImplementedError(
@@ -370,22 +441,34 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     use_mesh = mesh is not None and int(np.prod(
         [mesh.shape[a] for a in mesh.axis_names])) > 1
     if use_mesh:
+        if ranking_info is not None:
+            if use_goss or use_dart or use_rf:
+                raise NotImplementedError(
+                    f"boostingType={params.boosting!r} with a mesh "
+                    "lambdarank is not supported")
+            return _train_distributed_ranking(
+                bins, labels, w, mapper, objective, params, cfg, mesh,
+                feature_names, init, rng, ranking_info,
+                val_bins=val_bins, val_labels=val_labels,
+                val_weights=val_weights, val_metric=val_metric)
         if grad_fn_override is not None:
             raise NotImplementedError(
-                "ranking objectives are single-mesh-axis for now; train "
-                "the ranker without a mesh")
-        if use_goss:
+                "custom gradient overrides are not supported with a "
+                "mesh (only lambdarank, which provides ranking_info)")
+        if use_goss or use_dart or use_rf:
             raise NotImplementedError(
-                "boostingType='goss' with an explicit mesh is not yet "
-                "supported; drop setMesh(...) or use boostingType='gbdt'")
-        if val_bins is not None or callbacks:
+                f"boostingType={params.boosting!r} with an explicit mesh "
+                "is not yet supported; drop setMesh(...) or use "
+                "boostingType='gbdt'")
+        if callbacks:
             raise NotImplementedError(
-                "validation/early stopping and callbacks are not yet "
-                "supported with an explicit mesh; drop setMesh(...) or the "
-                "validationIndicatorCol")
+                "callbacks are not yet supported with an explicit mesh; "
+                "drop setMesh(...)")
         return _train_distributed(
             bins, labels, w, mapper, objective, params, cfg, mesh,
-            feature_names, init, rng, bag_rng, init_scores)
+            feature_names, init, rng, bag_rng, init_scores,
+            val_bins=val_bins, val_labels=val_labels,
+            val_weights=val_weights, val_metric=val_metric)
 
     bins_d = jnp.asarray(bins, mapper.bin_dtype)
     labels_d = jnp.asarray(labels,
@@ -421,12 +504,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         """Per-iteration feature-fraction mask (serial draw order)."""
         if not use_ff:
             return fi_base
-        k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
-        sel = rng.choice(f, size=k_keep, replace=False)
-        fi_it = fi_base.copy()
-        fi_it[:, 0] = 0.0
-        fi_it[sel, 0] = 1.0
-        return fi_it
+        return _draw_feature_fraction(rng, fi_base, f,
+                                      params.feature_fraction)
 
     # Chunking: iterations run on-device in lax.scan chunks; the host only
     # syncs between chunks, where early stopping and callbacks live.  With
@@ -488,6 +567,73 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         if trees_list:
             trees_chunks = [jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *trees_list)]
+    elif use_dart:
+        # Dart (Rashmi & Gilad-Bachrach 2015; LightGBM boosting=dart):
+        # each iteration drops a random subset of the ensemble, fits the
+        # new tree against the dropped-out scores, then renormalizes —
+        # the new tree joins at weight 1/(k+1) and the k dropped trees
+        # shrink by k/(k+1), preserving the ensemble total.  Per-tree
+        # weights are tracked on host and baked into the exported trees.
+        dart_rng = np.random.default_rng(params.drop_seed)
+        trees_list = []
+        scales: List[float] = []
+        L_steps = params.num_leaves
+        for it in range(T):
+            if use_bag and it % params.bagging_freq == 0:
+                cur_bag = (bag_rng.random(n) < params.bagging_fraction
+                           ).astype(np.float32)
+            bag_mask = jnp.asarray(cur_bag)
+            fi = jnp.asarray(iter_fi(it))
+            if trees_list and dart_rng.random() >= params.skip_drop:
+                sel = np.nonzero(
+                    dart_rng.random(len(trees_list)) < params.drop_rate)[0]
+                # maxDrop <= 0 means "no limit" (LightGBM max_drop docs)
+                if params.max_drop > 0 and len(sel) > params.max_drop:
+                    sel = dart_rng.choice(sel, size=params.max_drop,
+                                          replace=False)
+            else:
+                sel = np.zeros(0, np.int64)
+            k = len(sel)
+            if k:
+                P = scales[sel[0]] * predict_tree_binned(
+                    trees_list[sel[0]], bins_d, L_steps)
+                for i in sel[1:]:
+                    P = P + scales[i] * predict_tree_binned(
+                        trees_list[i], bins_d, L_steps)
+                s_minus = scores - P
+            else:
+                s_minus = scores
+            tree, b_new = _dart_step(bins_d, s_minus, labels_d, weights_d,
+                                     bag_mask, fi, objective, cfg,
+                                     params.learning_rate)
+            norm = 1.0 / (k + 1)
+            scores = s_minus + norm * b_new
+            if k:
+                scores = scores + (k * norm) * P
+                if has_val:
+                    P_val = scales[sel[0]] * predict_tree_binned(
+                        trees_list[sel[0]], val_bins_d, L_steps)
+                    for i in sel[1:]:
+                        P_val = P_val + scales[i] * predict_tree_binned(
+                            trees_list[i], val_bins_d, L_steps)
+                    val_scores = val_scores - norm * P_val
+                for i in sel:
+                    scales[i] *= k * norm
+            if has_val:
+                val_scores = val_scores + norm * predict_tree_binned(
+                    tree, val_bins_d, L_steps)
+                metric = float(val_metric(np.asarray(val_scores),
+                                          val_labels_np, val_weights))
+                if metric < best_metric - 1e-12:
+                    best_metric, best_iter = metric, it
+            trees_list.append(tree)
+            scales.append(norm)
+            if callbacks:
+                for cb in callbacks:
+                    cb(it, trees_list)
+        if trees_list:
+            trees_chunks = [jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *trees_list)]
     else:
         cb_list: List[TreeArrays] = []
         it = 0
@@ -525,13 +671,19 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 trees_st, scores, val_scores, val_hist = _boost_scan(
                     bins_d, scores, labels_d, weights_d, bag_masks,
                     fi_stack, val_bins_d, val_scores, objective, cfg,
-                    params.learning_rate, has_val)
+                    params.learning_rate, has_val, use_rf)
             trees_chunks.append(trees_st)
             stop = False
             if has_val:
                 vh = np.asarray(val_hist)        # (C, n_val[, K])
                 for j in range(C):
-                    metric = float(val_metric(vh[j], val_labels_np,
+                    # rf: trees are unshrunk raw fits; the ensemble margin
+                    # at iteration j is init + running AVERAGE of the tree
+                    # outputs (val_scores start at init, which must not be
+                    # divided down)
+                    margins = (init + (vh[j] - init) / (it + j + 1)
+                               if use_rf else vh[j])
+                    metric = float(val_metric(margins, val_labels_np,
                                               val_weights))
                     gi = it + j
                     if metric < best_metric - 1e-12:
@@ -560,7 +712,143 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     trees, nls = trees[:stop_iter * K], nls[:stop_iter * K]
     trees, stop_iter = _truncate_no_growth(trees, nls, K, stop_iter,
                                            params.verbosity)
+    if use_dart:
+        # bake the final per-tree dart weights into the exported trees
+        for t, s in zip(trees, scales):
+            t.leaf_value = t.leaf_value * s
+            t.internal_value = t.internal_value * s
+            t.shrinkage = s
+    elif use_rf and trees:
+        # random forest: the model output is the AVERAGE of the raw trees
+        avg = 1.0 / (len(trees) // K)
+        for t in trees:
+            t.leaf_value = t.leaf_value * avg
+            t.internal_value = t.internal_value * avg
+            t.shrinkage = avg
     return _finalize_booster(trees, K, init, params, objective, mapper,
+                             feature_names, f, stop_iter)
+
+
+def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
+                               cfg, mesh, feature_names, init, rng,
+                               ranking_info, val_bins=None, val_labels=None,
+                               val_weights=None, val_metric=None) -> Booster:
+    """Mesh-sharded lambdarank: whole queries are packed per data shard
+    (ranking.shard_queries), pairwise gradients stay shard-local, tree
+    growth is data-parallel psum — the distributed MSLR configuration
+    (SURVEY.md §3.1; BASELINE config 5)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..core.mesh import DATA_AXIS, FEATURE_AXIS, pad_to_multiple
+    from .distributed import make_ranking_scan
+    from .ranking import shard_queries
+
+    if params.bagging_freq > 0 and params.bagging_fraction < 1.0:
+        raise NotImplementedError(
+            "bagging with mesh lambdarank is not yet supported; drop "
+            "setMesh(...) or unset baggingFraction/baggingFreq")
+
+    n, f = bins.shape
+    T = params.num_iterations
+    esr = params.early_stopping_round
+    use_ff = params.feature_fraction < 1.0
+    dn = int(mesh.shape[DATA_AXIS])
+    fn_shards = int(mesh.shape[FEATURE_AXIS])
+    has_val = val_bins is not None and val_metric is not None
+
+    perm, real, (qidx, qmask, gains, labq, invmax) = shard_queries(
+        np.asarray(labels), ranking_info["query_ids"], dn,
+        ranking_info["truncation_level"])
+    npk = len(perm)                     # packed rows (D * S)
+    valid = perm >= 0
+    fp = pad_to_multiple(f, fn_shards) - f
+    f_padded = f + fp
+    bins_np = np.asarray(bins, mapper.bin_dtype)
+    bins_packed = np.zeros((npk, f_padded), mapper.bin_dtype)
+    bins_packed[valid, :f] = bins_np[perm[valid]]
+    wmul = np.zeros(npk, np.float32)
+    wmul[valid] = np.asarray(w, np.float32)[perm[valid]]
+
+    shard = lambda a, spec: jax.device_put(  # noqa: E731
+        jnp.asarray(a), NamedSharding(mesh, spec))
+    bins_d = shard(bins_packed, P(DATA_AXIS, FEATURE_AXIS))
+    scores = shard(np.full(npk, init, np.float32), P(DATA_AXIS))
+    real_d = shard(real, P(DATA_AXIS))
+    wmul_d = shard(wmul, P(DATA_AXIS))
+    qidx_d = shard(qidx, P(DATA_AXIS, None, None))
+    qmask_d = shard(qmask, P(DATA_AXIS, None, None))
+    gains_d = shard(gains, P(DATA_AXIS, None, None))
+    labq_d = shard(labq, P(DATA_AXIS, None, None))
+    invmax_d = shard(invmax, P(DATA_AXIS, None))
+
+    if has_val:
+        nv = val_bins.shape[0]
+        vrp = pad_to_multiple(nv, dn) - nv
+        vb = np.asarray(val_bins, mapper.bin_dtype)
+        if vrp:
+            vb = np.concatenate([vb, np.zeros((vrp, f), vb.dtype)], axis=0)
+        val_bins_d = shard(vb, P(DATA_AXIS, None))
+        val_scores = shard(np.full(nv + vrp, init, np.float32),
+                           P(DATA_AXIS))
+        val_labels_np = np.asarray(val_labels)
+    else:
+        val_bins_d = shard(np.zeros((dn, f), mapper.bin_dtype),
+                           P(DATA_AXIS, None))
+        val_scores = shard(np.zeros(dn, np.float32), P(DATA_AXIS))
+
+    fi_base = np.zeros((f_padded, 3), np.float32)
+    fi_base[:f] = _feat_info_from_mapper(mapper, f)
+    step = make_ranking_scan(mesh, cfg, params.learning_rate,
+                             ranking_info["sigma"],
+                             ranking_info["truncation_level"], has_val)
+
+    chunk = T
+    if has_val:
+        chunk = min(chunk, max(min(esr, 64), 8) if esr > 0 else 64)
+    chunks: List[TreeArrays] = []
+    best_metric, best_iter = np.inf, -1
+    stop_iter = T
+    it = 0
+    while it < T:
+        C = min(chunk, T - it)
+        if use_ff:
+            fi_stack = jnp.asarray(np.stack([
+                _draw_feature_fraction(rng, fi_base, f,
+                                       params.feature_fraction)
+                for _ in range(C)]))
+        else:
+            fi_stack = jnp.asarray(np.broadcast_to(fi_base,
+                                                   (C,) + fi_base.shape))
+        trees_st, scores, val_scores, val_hist = step(
+            bins_d, scores, real_d, wmul_d, qidx_d, qmask_d, gains_d,
+            labq_d, invmax_d, fi_stack, val_bins_d, val_scores)
+        chunks.append(trees_st)
+        stop = False
+        if has_val:
+            vh = np.asarray(val_hist)[:, :nv]
+            for j in range(C):
+                metric = float(val_metric(vh[j], val_labels_np,
+                                          val_weights))
+                gi = it + j
+                if metric < best_metric - 1e-12:
+                    best_metric, best_iter = metric, gi
+                elif esr > 0 and gi - best_iter >= esr:
+                    if params.verbosity > 0:
+                        log.info("Early stopping at iteration %d "
+                                 "(best %d, metric %.6f)", gi, best_iter,
+                                 best_metric)
+                    stop_iter = best_iter + 1
+                    stop = True
+                    break
+        if stop:
+            break
+        it += C
+
+    trees, nls = _fetch_host_trees(chunks, params.num_leaves, mapper)
+    trees, nls = trees[:stop_iter], nls[:stop_iter]
+    trees, stop_iter = _truncate_no_growth(trees, nls, 1, stop_iter,
+                                           params.verbosity)
+    return _finalize_booster(trees, 1, init, params, objective, mapper,
                              feature_names, f, stop_iter)
 
 
@@ -603,9 +891,12 @@ def _finalize_booster(trees, K, init, params, objective, mapper,
 
 def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                        feature_names, init, rng, bag_rng,
-                       init_scores=None) -> Booster:
+                       init_scores=None, val_bins=None, val_labels=None,
+                       val_weights=None, val_metric=None) -> Booster:
     """Distributed boosting: the whole iteration loop is ONE shard_mapped
-    ``lax.scan`` launch (no per-iteration host round-trips)."""
+    ``lax.scan`` launch (no per-iteration host round-trips); with a
+    validation set the loop chunks and the host replays per-iteration
+    metrics for early stopping, exactly like the serial path."""
     from .distributed import (make_boost_scan, make_multiclass_scan,
                               prepare_arrays)
 
@@ -615,14 +906,17 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     n, f = bins.shape
     K = objective.num_model_per_iteration
     T = params.num_iterations
+    esr = params.early_stopping_round
     use_bag = params.bagging_freq > 0 and params.bagging_fraction < 1.0
     use_ff = params.feature_fraction < 1.0
+    has_val = val_bins is not None and val_metric is not None
     if K > 1:
         step = make_multiclass_scan(
-            mesh, objective, cfg, params.learning_rate, K, use_bag)
+            mesh, objective, cfg, params.learning_rate, K, use_bag,
+            has_val)
     else:
         step = make_boost_scan(
-            mesh, objective, cfg, params.learning_rate, use_bag)
+            mesh, objective, cfg, params.learning_rate, use_bag, has_val)
     bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
         np.asarray(bins, mapper.bin_dtype), np.asarray(labels),
         np.asarray(w, np.float32), mesh, K, init, init_scores)
@@ -631,22 +925,51 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     fi_base = np.zeros((f_padded, 3), np.float32)
     fi_base[:f] = _feat_info_from_mapper(mapper, f)
 
+    dn = int(mesh.shape[DATA_AXIS])
+    if has_val:
+        nv = val_bins.shape[0]
+        vrp = pad_to_multiple(nv, dn) - nv
+        vb = np.asarray(val_bins, mapper.bin_dtype)
+        if vrp:
+            vb = np.concatenate(
+                [vb, np.zeros((vrp, f), vb.dtype)], axis=0)
+        # all features per shard (trees are replicated; each data shard
+        # scores its own validation slice)
+        val_bins_d = jax.device_put(
+            jnp.asarray(vb), NamedSharding(mesh, P(DATA_AXIS, None)))
+        vshape = (nv + vrp, K) if K > 1 else (nv + vrp,)
+        vspec = P(DATA_AXIS, None) if K > 1 else P(DATA_AXIS)
+        val_scores = jax.device_put(
+            jnp.full(vshape, init, jnp.float32), NamedSharding(mesh, vspec))
+        val_labels_np = np.asarray(val_labels)
+    else:
+        val_bins_d = jax.device_put(
+            jnp.zeros((dn, f_padded), mapper.bin_dtype),
+            NamedSharding(mesh, P(DATA_AXIS, None)))
+        val_scores = jax.device_put(
+            jnp.zeros((dn, K) if K > 1 else (dn,), jnp.float32),
+            NamedSharding(mesh, P(DATA_AXIS, None) if K > 1
+                          else P(DATA_AXIS)))
+
     def iter_fi_dist(_gi):
         if not use_ff:
             return fi_base
-        k_keep = max(1, int(np.ceil(f * params.feature_fraction)))
-        sel = rng.choice(f, size=k_keep, replace=False)
-        fi_it = fi_base.copy()
-        fi_it[:, 0] = 0.0
-        fi_it[sel, 0] = 1.0
-        return fi_it
+        return _draw_feature_fraction(rng, fi_base, f,
+                                      params.feature_fraction)
 
-    # Chunk only when bagging materializes per-iteration (chunk, n) masks;
+    # Chunk when bagging materializes per-iteration (chunk, n) masks or a
+    # validation set stacks per-iteration (chunk, n_val) margins;
     # otherwise the whole fit is one launch with a constant (T, 1) mask
     # (pad rows ride the (n,) `real` mask inside the step).
-    chunk = min(T, 64) if use_bag else T
+    chunk = T
+    if use_bag:
+        chunk = min(chunk, 64)
+    if has_val:
+        chunk = min(chunk, max(min(esr, 64), 8) if esr > 0 else 64)
     cur = np.ones(n, np.float32)
     chunks: List[TreeArrays] = []
+    best_metric, best_iter = np.inf, -1
+    stop_iter = T
     it = 0
     while it < T:
         C = min(chunk, T - it)
@@ -669,13 +992,34 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         else:
             fi_stack = jnp.asarray(np.broadcast_to(fi_base,
                                                    (C,) + fi_base.shape))
-        trees_st, scores = step(bins_d, scores, labels_d, w_d, real, bags,
-                                fi_stack)
+        trees_st, scores, val_scores, val_hist = step(
+            bins_d, scores, labels_d, w_d, real, bags, fi_stack,
+            val_bins_d, val_scores)
         chunks.append(trees_st)
+        stop = False
+        if has_val:
+            vh = np.asarray(val_hist)[:, :nv]    # drop val pad rows
+            for j in range(C):
+                metric = float(val_metric(vh[j], val_labels_np,
+                                          val_weights))
+                gi = it + j
+                if metric < best_metric - 1e-12:
+                    best_metric, best_iter = metric, gi
+                elif esr > 0 and gi - best_iter >= esr:
+                    if params.verbosity > 0:
+                        log.info("Early stopping at iteration %d "
+                                 "(best %d, metric %.6f)", gi, best_iter,
+                                 best_metric)
+                    stop_iter = best_iter + 1
+                    stop = True
+                    break
+        if stop:
+            break
         it += C
 
     trees, nls = _fetch_host_trees(chunks, params.num_leaves, mapper)
-    trees, stop_iter = _truncate_no_growth(trees, nls, K, T,
+    trees, nls = trees[:stop_iter * K], nls[:stop_iter * K]
+    trees, stop_iter = _truncate_no_growth(trees, nls, K, stop_iter,
                                            params.verbosity)
     return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)

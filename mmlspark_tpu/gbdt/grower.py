@@ -59,6 +59,13 @@ class GrowerConfig:
     compact_rows: bool = True
     #: smallest compaction bucket (rows); buckets double up to 2^ceil(lg n)
     min_bucket: int = 2048
+    #: PV-Tree voting parallelism (Meng et al. 2016; LightGBM
+    #: tree_learner=voting, top_k): > 0 with ``axis_name`` set keeps leaf
+    #: histograms SHARD-LOCAL; each shard votes its top-k features by
+    #: local gain, votes are allgathered, and only the 2k winning
+    #: features' histograms are psum-reduced — comm per split drops from
+    #: O(f*B) to O(k*B + votes).
+    voting_k: int = 0
     axis_name: Optional[str] = None          # data-parallel psum axis
     feature_axis_name: Optional[str] = None  # feature-parallel axis
     #: categorical split finding (LightGBM Fisher-grouping analog); static
@@ -289,11 +296,70 @@ def find_best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
             cat_bits)
 
 
+def _is_voting(cfg: GrowerConfig) -> bool:
+    return cfg.axis_name is not None and cfg.voting_k > 0
+
+
 def _hist(bins, gh, cfg: GrowerConfig):
     h = compute_histogram(bins, gh, cfg.num_bins, method=cfg.hist_method)
-    if cfg.axis_name is not None:
+    if cfg.axis_name is not None and not _is_voting(cfg):
+        # voting mode keeps histograms shard-local; only the voted
+        # candidate slices are ever reduced (find_best_split_voting)
         h = jax.lax.psum(h, cfg.axis_name)
     return h
+
+
+def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
+                           feat_info, depth_ok, cfg: GrowerConfig):
+    """PV-Tree split finding (Meng et al. 2016; LightGBM
+    tree_learner=voting): each data shard scores every feature on its
+    LOCAL histogram against its LOCAL totals, votes its top-k features,
+    votes are allgathered, and only the globally top-2k voted features'
+    histograms are psum-reduced for the exact global decision.
+
+    Numeric features only (the engine guards categorical + voting).
+    Returns the same tuple as :func:`find_best_split`.
+    """
+    f, B = hist_local.shape[0], hist_local.shape[1]
+    feature_mask = feat_info[:, 0]
+    md, mh = cfg.min_data_in_leaf, cfg.min_sum_hessian_in_leaf
+
+    def per_feature_gains(hist, pg, ph, pc, mask_cols):
+        cum = jnp.cumsum(hist, axis=1)
+        gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
+        gr, hr, cr = pg - gl, ph - hl, pc - cl
+        valid = ((cl >= md) & (cr >= md) & (hl >= mh) & (hr >= mh)
+                 & (jnp.arange(B) < B - 1)[None, :])
+        parent_gain = _leaf_gain(pg, ph, cfg)
+        gains = (_leaf_gain(gl, hl, cfg) + _leaf_gain(gr, hr, cfg)
+                 - parent_gain)
+        return jnp.where(valid & mask_cols & depth_ok, gains, -jnp.inf)
+
+    # 1. local votes: top-k features by local best gain vs local totals
+    s_loc = jnp.sum(hist_local[0], axis=0)
+    gains_loc = per_feature_gains(hist_local, s_loc[0], s_loc[1], s_loc[2],
+                                  (feature_mask > 0)[:, None])
+    k = min(cfg.voting_k, f)
+    _, votes = jax.lax.top_k(jnp.max(gains_loc, axis=1), k)
+    votes_all = jax.lax.all_gather(votes, cfg.axis_name)        # (S, k)
+    counts = jnp.zeros(f, jnp.int32).at[votes_all.reshape(-1)].add(1)
+    # 2. global candidates: top-2k by vote count (feature id tie-break
+    #    keeps every shard's selection identical and deterministic)
+    k2 = min(2 * k, f)
+    key = counts * f + (f - 1 - jnp.arange(f, dtype=jnp.int32))
+    _, cand = jax.lax.top_k(key, k2)                             # (k2,)
+    # 3. exact decision over the psum-reduced candidate histograms
+    hist_cand = jax.lax.psum(hist_local[cand], cfg.axis_name)   # (k2, B, 3)
+    gains_cand = per_feature_gains(hist_cand, parent_g, parent_h, parent_c,
+                                   (feature_mask[cand] > 0)[:, None])
+    flat = gains_cand.reshape(-1)
+    idx = jnp.argmax(flat)
+    best_gain = flat[idx]
+    feat = cand[(idx // B).astype(jnp.int32)]
+    b = (idx % B).astype(jnp.int32)
+    gain_ok = best_gain > jnp.maximum(cfg.min_gain_to_split, EPS_GAIN)
+    return (jnp.where(gain_ok, best_gain, -jnp.inf), feat, b,
+            jnp.asarray(0, jnp.int32), jnp.zeros(cfg.cat_words, jnp.uint32))
 
 
 def _bucket_sizes(n: int, cfg: GrowerConfig):
@@ -404,6 +470,21 @@ def _totals_from_hist(hist):
     return s[0], s[1], s[2]
 
 
+def _global_totals(g, h, c, cfg: GrowerConfig):
+    """Leaf totals are global quantities; under voting the histograms stay
+    local, so the (3,) totals are psum-reduced explicitly."""
+    if _is_voting(cfg):
+        tot = jax.lax.psum(jnp.stack([g, h, c]), cfg.axis_name)
+        return tot[0], tot[1], tot[2]
+    return g, h, c
+
+
+def _find_split(hist, pg, ph, pc, fi, depth_ok, cfg: GrowerConfig):
+    if _is_voting(cfg):
+        return find_best_split_voting(hist, pg, ph, pc, fi, depth_ok, cfg)
+    return find_best_split(hist, pg, ph, pc, fi, depth_ok, cfg)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def grow_tree(bins: jnp.ndarray, gh: jnp.ndarray,
               feat_info: jnp.ndarray,
@@ -439,9 +520,9 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
     binsT = bins.T
 
     hist0 = _hist(bins, gh, cfg)
-    g0, h0, c0 = _totals_from_hist(hist0)
+    g0, h0, c0 = _global_totals(*_totals_from_hist(hist0), cfg)
     depth0_ok = (cfg.max_depth <= 0) | (0 < cfg.max_depth)
-    bg0, bf0, bb0, bc0, bits0 = find_best_split(
+    bg0, bf0, bb0, bc0, bits0 = _find_split(
         hist0, g0, h0, c0, feat_info, jnp.asarray(depth0_ok), cfg)
 
     tree = TreeArrays(
@@ -545,7 +626,9 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
                 child_cnt = jnp.where(use_right, cnt_r_p, cnt_l_p)
                 hist_small = _segment_hist(bins, gh, row_order, child_off,
                                            child_cnt, n, sizes, cfg)
-                if cfg.axis_name is not None:
+                if cfg.axis_name is not None and not _is_voting(cfg):
+                    # voting keeps per-leaf histograms local; only voted
+                    # candidate slices are reduced inside _find_split
                     hist_small = jax.lax.psum(hist_small, cfg.axis_name)
                 parent_hist = state.leaf_hist[l]
                 hist_r = jnp.where(use_right, hist_small,
@@ -572,7 +655,7 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
                 row_order = state.row_order
                 leaf_start = state.leaf_start
                 leaf_cnt = state.leaf_cnt
-            g_r, h_r, c_r = _totals_from_hist(hist_r)
+            g_r, h_r, c_r = _global_totals(*_totals_from_hist(hist_r), cfg)
             g_l = state.leaf_g[l] - g_r
             h_l = state.leaf_h[l] - h_r
             c_l = state.leaf_c[l] - c_r
@@ -580,9 +663,9 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
             child_depth = state.leaf_depth[l] + 1
             depth_ok = jnp.asarray(
                 (cfg.max_depth <= 0), bool) | (child_depth < cfg.max_depth)
-            bg_l, bf_l, bb_l, bc_l, bits_l = find_best_split(
+            bg_l, bf_l, bb_l, bc_l, bits_l = _find_split(
                 hist_l, g_l, h_l, c_l, feat_info, depth_ok, cfg)
-            bg_r, bf_r, bb_r, bc_r, bits_r = find_best_split(
+            bg_r, bf_r, bb_r, bc_r, bits_r = _find_split(
                 hist_r, g_r, h_r, c_r, feat_info, depth_ok, cfg)
 
             t = state.tree

@@ -56,6 +56,70 @@ def _dcg_discount(rank):
     return 1.0 / jnp.log2(2.0 + rank)
 
 
+def query_tensors(labels_sorted: np.ndarray, qidx: np.ndarray,
+                  qmask: np.ndarray, truncation_level: int,
+                  max_label: int = 31):
+    """Host-side static per-query tensors: gains, padded labels, and the
+    inverse ideal DCG (shared by the serial and mesh-sharded lambdarank
+    paths)."""
+    Q, G = qidx.shape
+    gains_row = (2.0 ** np.minimum(labels_sorted, max_label) - 1.0)
+    lab_q = labels_sorted[qidx] * qmask - (1.0 - qmask)   # pad -> -1
+    gains_q = gains_row[qidx] * qmask
+    ideal = -np.sort(-gains_q, axis=1)
+    k = min(truncation_level, G)
+    disc = 1.0 / np.log2(2.0 + np.arange(G))
+    max_dcg = (ideal[:, :k] * disc[:k]).sum(axis=1)
+    inv_max_dcg = np.where(max_dcg > 0,
+                           1.0 / np.maximum(max_dcg, 1e-12), 0.0)
+    return (gains_q.astype(np.float32), lab_q.astype(np.float32),
+            inv_max_dcg.astype(np.float32))
+
+
+def lambda_grad_sorted(s_sorted, qidx_c, qmask_c, gains_c, labq_c, invmax_c,
+                       sigma: float, trunc: int, n: int):
+    """(n,) lambdarank grad/hess for scores already sorted by query.
+
+    Query tensors arrive pre-chunked ``(n_chunks, c, G)``; a ``lax.scan``
+    over chunks bounds the transient (c, G, G) pairwise tensors.  Pure
+    function of jax arrays — usable inside shard_map (each shard passes
+    its LOCAL query structures and local sorted scores)."""
+    sig, tr = float(sigma), int(trunc)
+
+    def chunk_step(carry, args):
+        g_acc, h_acc = carry
+        qi, qm, gains, labs, invmax = args         # (c, G, ...)
+        s = s_sorted[qi] * qm - 1e9 * (1.0 - qm)   # pad to -inf-ish
+        # ranks within query from current scores (descending)
+        rank_order = jnp.argsort(-s, axis=1)
+        ranks = jnp.argsort(rank_order, axis=1).astype(jnp.float32)
+        disc = _dcg_discount(ranks)                # (c, G)
+        # pairwise tensors (c, G, G): i vs j
+        better = (labs[:, :, None] > labs[:, None, :])
+        in_trunc = (ranks[:, :, None] < tr) | (ranks[:, None, :] < tr)
+        pair_mask = (better & in_trunc).astype(jnp.float32) * \
+            qm[:, :, None] * qm[:, None, :]
+        dgain = jnp.abs(gains[:, :, None] - gains[:, None, :])
+        ddisc = jnp.abs(disc[:, :, None] - disc[:, None, :])
+        delta = dgain * ddisc * invmax[:, None, None]
+        sdiff = s[:, :, None] - s[:, None, :]
+        p = jax.nn.sigmoid(-sig * sdiff)           # P(j beats i)
+        lam = -sig * p * delta * pair_mask         # grad for i (winner)
+        hes = sig * sig * p * (1.0 - p) * delta * pair_mask
+        g_q = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
+        h_q = jnp.sum(hes, axis=2) + jnp.sum(hes, axis=1)
+        # scatter back into sorted row order (pad slots -> dropped)
+        flat_qi = jnp.where(qm > 0, qi.astype(jnp.int32), n).reshape(-1)
+        g_acc = g_acc.at[flat_qi].add((g_q * qm).reshape(-1), mode="drop")
+        h_acc = h_acc.at[flat_qi].add((h_q * qm).reshape(-1), mode="drop")
+        return (g_acc, h_acc), None
+
+    init = (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32))
+    (g_s, h_s), _ = jax.lax.scan(
+        chunk_step, init, (qidx_c, qmask_c, gains_c, labq_c, invmax_c))
+    return g_s, h_s
+
+
 def make_lambdarank_grad_fn(labels: np.ndarray, query_ids: np.ndarray,
                             sigma: float = 1.0,
                             truncation_level: int = 30,
@@ -78,23 +142,19 @@ def make_lambdarank_grad_fn(labels: np.ndarray, query_ids: np.ndarray,
         qmask = np.concatenate([qmask, np.zeros((pad_q, G), np.float32)])
 
     labels_sorted = np.asarray(labels, np.float32)[order]
-    gains_row = (2.0 ** np.minimum(labels_sorted, max_label) - 1.0)
-
-    # ideal DCG per query (labels are static, so compute on host)
-    lab_q = labels_sorted[qidx] * qmask - (1.0 - qmask)   # pad -> -1
-    gains_q = gains_row[qidx] * qmask
-    ideal = -np.sort(-gains_q, axis=1)
-    k = min(truncation_level, G)
-    disc = 1.0 / np.log2(2.0 + np.arange(G))
-    max_dcg = (ideal[:, :k] * disc[:k]).sum(axis=1)
-    inv_max_dcg = np.where(max_dcg > 0, 1.0 / np.maximum(max_dcg, 1e-12), 0.0)
+    gains_q, lab_q, inv_max_dcg = query_tensors(
+        labels_sorted, qidx[:Q], qmask[:Q], truncation_level, max_label)
+    if pad_q:
+        gains_q = np.concatenate([gains_q, np.zeros((pad_q, G), np.float32)])
+        lab_q = np.concatenate([lab_q, -np.ones((pad_q, G), np.float32)])
+        inv_max_dcg = np.concatenate([inv_max_dcg,
+                                      np.zeros(pad_q, np.float32)])
 
     qidx_d = jnp.asarray(qidx.reshape(-1, chunk, G))
     qmask_d = jnp.asarray(qmask.reshape(-1, chunk, G))
-    gains_d = jnp.asarray(gains_q.reshape(-1, chunk, G), jnp.float32)
-    labq_d = jnp.asarray(lab_q.reshape(-1, chunk, G), jnp.float32)
-    invmax_d = jnp.asarray(
-        inv_max_dcg.reshape(-1, chunk).astype(np.float32))
+    gains_d = jnp.asarray(gains_q.reshape(-1, chunk, G))
+    labq_d = jnp.asarray(lab_q.reshape(-1, chunk, G))
+    invmax_d = jnp.asarray(inv_max_dcg.reshape(-1, chunk))
     order_d = jnp.asarray(order)
     w_d = None if weights is None else jnp.asarray(weights, jnp.float32)
     sig = float(sigma)
@@ -103,37 +163,9 @@ def make_lambdarank_grad_fn(labels: np.ndarray, query_ids: np.ndarray,
     @jax.jit
     def grad_fn(scores):
         s_sorted = scores[order_d]                     # (n,) sorted by query
-
-        def chunk_step(carry, args):
-            g_acc, h_acc = carry
-            qi, qm, gains, labs, invmax = args         # (c, G, ...)
-            s = s_sorted[qi] * qm - 1e9 * (1.0 - qm)   # pad to -inf-ish
-            # ranks within query from current scores (descending)
-            rank_order = jnp.argsort(-s, axis=1)
-            ranks = jnp.argsort(rank_order, axis=1).astype(jnp.float32)
-            disc = _dcg_discount(ranks)                # (c, G)
-            # pairwise tensors (c, G, G): i vs j
-            better = (labs[:, :, None] > labs[:, None, :])
-            in_trunc = (ranks[:, :, None] < trunc) | (ranks[:, None, :] < trunc)
-            pair_mask = (better & in_trunc).astype(jnp.float32) * \
-                qm[:, :, None] * qm[:, None, :]
-            dgain = jnp.abs(gains[:, :, None] - gains[:, None, :])
-            ddisc = jnp.abs(disc[:, :, None] - disc[:, None, :])
-            delta = dgain * ddisc * invmax[:, None, None]
-            sdiff = s[:, :, None] - s[:, None, :]
-            p = jax.nn.sigmoid(-sig * sdiff)           # P(j beats i)
-            lam = -sig * p * delta * pair_mask         # grad for i (winner)
-            hes = sig * sig * p * (1.0 - p) * delta * pair_mask
-            g_q = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
-            h_q = jnp.sum(hes, axis=2) + jnp.sum(hes, axis=1)
-            # scatter back into sorted row order
-            g_acc = g_acc.at[qi.reshape(-1)].add((g_q * qm).reshape(-1))
-            h_acc = h_acc.at[qi.reshape(-1)].add((h_q * qm).reshape(-1))
-            return (g_acc, h_acc), None
-
-        init = (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32))
-        (g_s, h_s), _ = jax.lax.scan(
-            chunk_step, init, (qidx_d, qmask_d, gains_d, labq_d, invmax_d))
+        g_s, h_s = lambda_grad_sorted(
+            s_sorted, qidx_d, qmask_d, gains_d, labq_d, invmax_d,
+            sig, trunc, n)
         # back to original row order
         g = jnp.zeros(n, jnp.float32).at[order_d].set(g_s)
         h = jnp.zeros(n, jnp.float32).at[order_d].set(h_s)
@@ -143,6 +175,78 @@ def make_lambdarank_grad_fn(labels: np.ndarray, query_ids: np.ndarray,
         return g, jnp.maximum(h, 1e-9)
 
     return grad_fn
+
+
+def shard_queries(labels: np.ndarray, query_ids: np.ndarray, n_shards: int,
+                  truncation_level: int, max_label: int = 31,
+                  query_chunk_pairs: int = 4_000_000):
+    """Partition whole queries across data shards (greedy row balancing).
+
+    The mesh-sharded lambdarank layout (SURVEY.md §3.1 distributed
+    lambdarank): rows are physically regrouped so every query lives on
+    exactly ONE data shard; the pairwise gradient then needs no cross-
+    shard communication, and tree growth stays plain data-parallel psum.
+
+    Returns ``(perm, real, qt)``: ``perm`` (D*S,) maps packed slot → source
+    row (-1 pad), ``real`` the 0/1 validity mask, and ``qt`` the per-shard
+    chunked query tensors (qidx, qmask, gains, labq, invmax) with shapes
+    (D*n_chunks, chunk, G)/(D*n_chunks, chunk) ready for a
+    ``P('data', ...)`` sharding — each shard's qidx indexes its LOCAL
+    packed rows.
+    """
+    q = np.asarray(query_ids)
+    order = np.argsort(q, kind="stable")
+    sorted_q = q[order]
+    _, starts, counts = np.unique(sorted_q, return_index=True,
+                                  return_counts=True)
+    D = n_shards
+    loads = np.zeros(D, np.int64)
+    assign = np.empty(len(starts), np.int32)
+    for i, c in enumerate(counts):       # greedy: least-loaded shard
+        s = int(np.argmin(loads))
+        assign[i] = s
+        loads[s] += c
+    S = int(loads.max())
+    G = int(counts.max())
+    qs_per_shard = np.bincount(assign, minlength=D)
+    Qs = int(qs_per_shard.max()) if len(starts) else 1
+    chunk = max(1, min(Qs, query_chunk_pairs // max(G * G, 1)))
+    Qp = Qs + ((-Qs) % chunk)
+
+    perm = np.full((D, S), -1, np.int64)
+    qidx = np.zeros((D, Qp, G), np.int32)
+    qmask = np.zeros((D, Qp, G), np.float32)
+    gains = np.zeros((D, Qp, G), np.float32)
+    labq = -np.ones((D, Qp, G), np.float32)
+    invmax = np.zeros((D, Qp), np.float32)
+
+    labels_sorted = np.asarray(labels, np.float32)[order]
+    fill_rows = np.zeros(D, np.int64)
+    fill_q = np.zeros(D, np.int64)
+    for i, (st, c) in enumerate(zip(starts, counts)):
+        d = assign[i]
+        r0 = fill_rows[d]
+        perm[d, r0:r0 + c] = order[st:st + c]
+        qi = fill_q[d]
+        qidx[d, qi, :c] = np.arange(r0, r0 + c)
+        qmask[d, qi, :c] = 1.0
+        g_q, l_q, im = query_tensors(
+            labels_sorted[st:st + c],
+            np.arange(c, dtype=np.int32)[None, :c],
+            np.ones((1, c), np.float32), truncation_level, max_label)
+        gains[d, qi, :c] = g_q[0]
+        labq[d, qi, :c] = l_q[0]
+        invmax[d, qi] = im[0]
+        fill_rows[d] += c
+        fill_q[d] += 1
+
+    real = (perm >= 0).astype(np.float32).reshape(-1)
+    qt = (qidx.reshape(D * (Qp // chunk), chunk, G),
+          qmask.reshape(D * (Qp // chunk), chunk, G),
+          gains.reshape(D * (Qp // chunk), chunk, G),
+          labq.reshape(D * (Qp // chunk), chunk, G),
+          invmax.reshape(D * (Qp // chunk), chunk))
+    return perm.reshape(-1), real, qt
 
 
 class LightGBMRanker(LightGBMBase):
@@ -169,6 +273,13 @@ class LightGBMRanker(LightGBMBase):
         return make_lambdarank_grad_fn(
             y, q, sigma=self.getSigma(),
             truncation_level=self.getMaxPosition(), weights=w)
+
+    def _ranking_info(self, table: DataTable, train_idx):
+        return {
+            "query_ids": np.asarray(table[self.getGroupCol()])[train_idx],
+            "sigma": self.getSigma(),
+            "truncation_level": self.getMaxPosition(),
+        }
 
     def _val_metric_fn(self, table: DataTable, val_mask):
         if val_mask is None or not val_mask.any():

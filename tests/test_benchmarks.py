@@ -1,0 +1,182 @@
+"""Benchmark-file metric regression harness (Benchmarks.scala pattern).
+
+The reference pins end-to-end model quality against checked-in expected
+metric files (src/test Benchmarks.scala, expected path, UNVERIFIED;
+SURVEY.md §4) so that any algorithmic drift turns the build red.  The five
+BASELINE.md evaluation configs are stood up as fixed-seed synthetic
+stand-ins (no dataset downloads in this sandbox); expected values live in
+``tests/benchmarks/expected_metrics.json`` with explicit tolerance bands.
+
+Regenerate intentionally-changed expectations with:
+    python -m tests.test_benchmarks --regen
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+EXPECTED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmarks", "expected_metrics.json")
+
+
+def _expected():
+    with open(EXPECTED_PATH) as fh:
+        return json.load(fh)
+
+
+def _check(name, value):
+    exp = _expected()[name]
+    lo, hi = exp["value"] - exp["tol"], exp["value"] + exp["tol"]
+    assert lo <= value <= hi, (
+        f"benchmark {name}: got {value:.6f}, expected "
+        f"{exp['value']:.6f} ± {exp['tol']} — metric drift; if the change "
+        f"is intentional, regenerate tests/benchmarks/expected_metrics.json")
+
+
+# ---- the five BASELINE.md configs as deterministic stand-ins -----------
+
+def config1_adult_binary():
+    """BASELINE config 1: LightGBMClassifier binary, adult-income shaped."""
+    from sklearn.metrics import roc_auc_score
+
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    rng = np.random.default_rng(101)
+    n = 4000
+    X = rng.normal(size=(n, 14)).astype(np.float32)
+    X[:, 3] = np.round(X[:, 3] * 2)            # low-cardinality "education"
+    logits = (X[:, 0] * 1.2 + X[:, 1] * X[:, 2] * 0.7 + np.sin(X[:, 3])
+              + rng.normal(size=n) * 0.7)
+    y = (logits > 0.2).astype(np.float64)
+    ntr = 3000
+    t_tr = {"features": X[:ntr], "label": y[:ntr]}
+    m = LightGBMClassifier(numIterations=60, numLeaves=31, learningRate=0.1,
+                           minDataInLeaf=20, verbosity=0, seed=42).fit(t_tr)
+    out = m.transform({"features": X[ntr:], "label": y[ntr:]})
+    return float(roc_auc_score(y[ntr:], np.asarray(out["probability"])[:, 1]))
+
+
+def config2_california_l2():
+    """BASELINE config 2: LightGBMRegressor regression_l2, california
+    housing shaped (8 features, skewed target)."""
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+    rng = np.random.default_rng(202)
+    n = 4000
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (2.0 + X[:, 0] * 0.8 + np.exp(X[:, 1] * 0.3)
+         + X[:, 2] * X[:, 3] * 0.4 + rng.normal(size=n) * 0.3)
+    ntr = 3000
+    m = LightGBMRegressor(numIterations=80, numLeaves=31, learningRate=0.1,
+                          minDataInLeaf=20, verbosity=0, seed=42).fit(
+        {"features": X[:ntr], "label": y[:ntr]})
+    pred = np.asarray(m.transform({"features": X[ntr:],
+                                   "label": y[ntr:]})["prediction"])
+    return float(np.sqrt(np.mean((pred - y[ntr:]) ** 2)))
+
+
+def config3_mslr_lambdarank():
+    """BASELINE config 3: LightGBMRanker lambdarank, MSLR-WEB30K shaped
+    (graded relevance 0-4, ~20 docs/query)."""
+    from mmlspark_tpu.gbdt import LightGBMRanker
+    from mmlspark_tpu.gbdt.ranking import ndcg_at_k
+    rng = np.random.default_rng(303)
+    rows = []
+    for q in range(120):
+        m = int(rng.integers(8, 25))
+        X = rng.normal(size=(m, 12))
+        rel = np.clip((X[:, 0] + 0.8 * X[:, 1] + rng.normal(size=m) * 0.4)
+                      * 1.1 + 1.5, 0, 4).astype(int)
+        rows.append((X, rel, np.full(m, q)))
+    X = np.concatenate([r[0] for r in rows]).astype(np.float32)
+    y = np.concatenate([r[1] for r in rows]).astype(np.float64)
+    q = np.concatenate([r[2] for r in rows]).astype(np.int64)
+    tr = q < 90
+    te = ~tr
+    model = LightGBMRanker(numIterations=40, numLeaves=15, minDataInLeaf=5,
+                           verbosity=0, seed=42).fit(
+        {"features": X[tr], "label": y[tr], "query": q[tr]})
+    pred = np.asarray(model.transform(
+        {"features": X[te], "label": y[te], "query": q[te]})["prediction"])
+    return float(ndcg_at_k(pred, y[te], q[te], k=10))
+
+
+def config4_image_featurizer():
+    """BASELINE config 4: ImageFeaturizer ResNet batch featurization,
+    CIFAR-shaped 32x32 RGB; pins the resize→normalize→CNN numerics via a
+    deterministic seeded network."""
+    import jax.numpy as jnp  # noqa: F401  (ensures backend forced by conftest)
+
+    from mmlspark_tpu.dnn import build_resnet, init_params
+    from mmlspark_tpu.image.featurizer import ImageFeaturizer
+    rng = np.random.default_rng(404)
+    imgs = rng.integers(0, 256, size=(8, 32, 32, 3)).astype(np.uint8)
+    variables = init_params(build_resnet("resnet18"), 32)
+    f = ImageFeaturizer(variables=variables, modelName="resnet18",
+                        imageHeight=32, imageWidth=32, miniBatchSize=4)
+    out = f.transform({"image": list(imgs)})
+    feats = np.stack(list(out["features"]))
+    assert feats.shape == (8, 512)
+    return float(np.mean(np.abs(feats)))
+
+
+def config5_criteo_distributed():
+    """BASELINE config 5: distributed LightGBMClassifier, Criteo-shaped
+    (wide, CTR-like imbalance) over the full 8-device data mesh with
+    psum histogram allreduce."""
+    from sklearn.metrics import roc_auc_score
+
+    from mmlspark_tpu.core.mesh import build_mesh
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    rng = np.random.default_rng(505)
+    n = 6000
+    X = rng.normal(size=(n, 26)).astype(np.float32)
+    logits = (X[:, 0] * 0.9 + X[:, 1] * X[:, 2] * 0.5
+              + (X[:, 3] > 1.0) * 1.5 + rng.normal(size=n) * 0.8 - 1.8)
+    y = (logits > 0).astype(np.float64)          # ~15% positives, CTR-ish
+    ntr = 4500
+    m = LightGBMClassifier(numIterations=50, numLeaves=31, learningRate=0.1,
+                           minDataInLeaf=20, verbosity=0, seed=42).setMesh(
+        build_mesh(data=8, feature=1)).fit(
+        {"features": X[:ntr], "label": y[:ntr]})
+    out = m.transform({"features": X[ntr:], "label": y[ntr:]})
+    return float(roc_auc_score(y[ntr:], np.asarray(out["probability"])[:, 1]))
+
+
+CONFIGS = {
+    "adult_binary_auc": config1_adult_binary,
+    "california_l2_rmse": config2_california_l2,
+    "mslr_lambdarank_ndcg10": config3_mslr_lambdarank,
+    "image_featurizer_meanabs": config4_image_featurizer,
+    "criteo_distributed_auc": config5_criteo_distributed,
+}
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_benchmark_metric(name):
+    _check(name, CONFIGS[name]())
+
+
+def _regen():
+    tols = {
+        "adult_binary_auc": 0.01,
+        "california_l2_rmse": 0.03,
+        "mslr_lambdarank_ndcg10": 0.02,
+        "image_featurizer_meanabs": 0.05,
+        "criteo_distributed_auc": 0.01,
+    }
+    out = {}
+    for name, fn in CONFIGS.items():
+        v = fn()
+        out[name] = {"value": round(v, 6), "tol": tols[name]}
+        print(f"{name}: {v:.6f}")
+    os.makedirs(os.path.dirname(EXPECTED_PATH), exist_ok=True)
+    with open(EXPECTED_PATH, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {EXPECTED_PATH}")
+
+
+if __name__ == "__main__":
+    import sys
+    if "--regen" in sys.argv:
+        _regen()

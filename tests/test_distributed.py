@@ -87,15 +87,17 @@ class TestDistributedParity:
 
 
 class TestDistributedGuards:
-    def test_mesh_plus_validation_raises(self, small_binary):
+    def test_mesh_plus_validation_trains(self, small_binary):
+        # mesh + validation/early stopping is supported since round 3
+        # (VERDICT r2 next #3); only callbacks still require no mesh
         import numpy as np
         d = dict(small_binary)
         d["isVal"] = np.arange(len(d["label"])) % 4 == 0
         est = LightGBMClassifier(numIterations=3, earlyStoppingRound=2,
-                                 validationIndicatorCol="isVal").setMesh(
-            build_mesh(data=8))
-        with pytest.raises(NotImplementedError):
-            est.fit(d)
+                                 validationIndicatorCol="isVal",
+                                 verbosity=0).setMesh(build_mesh(data=8))
+        model = est.fit(d)
+        assert len(model.getModel().trees) >= 1
 
     def test_bad_parallelism_raises(self):
         from mmlspark_tpu.gbdt.distributed import resolve_mesh
@@ -134,3 +136,202 @@ class TestDistributedGuards:
         a = base.getModel().save_native_model_string()
         b = warm.getModel().save_native_model_string()
         assert a != b  # init scores change the fit
+
+
+class TestDistributedValidation:
+    """Early stopping / validation under a mesh (VERDICT r2 next #3):
+    the mesh-sharded validation path must reproduce the serial path's
+    stopping decision and final model."""
+
+    @pytest.fixture(scope="class")
+    def val_table(self):
+        from sklearn.datasets import make_classification
+        X, y = make_classification(n_samples=901, n_features=10,
+                                   n_informative=6, random_state=11)
+        t = {"features": X, "label": y.astype(float)}
+        vmask = np.zeros(len(y), bool)
+        vmask[::4] = True
+        t["valid"] = vmask.astype(np.float64)
+        return t
+
+    def test_early_stopping_parity_with_serial(self, val_table):
+        kw = dict(numIterations=40, numLeaves=7, minDataInLeaf=5,
+                  validationIndicatorCol="valid", earlyStoppingRound=3,
+                  verbosity=0)
+        serial = LightGBMClassifier(**kw).setMesh(_serial_mesh()).fit(
+            val_table)
+        dp = LightGBMClassifier(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(val_table)
+        st, dt = serial.getModel().trees, dp.getModel().trees
+        # identical stopping iteration and identical tree structure
+        assert len(st) == len(dt)
+        for a, b in zip(st, dt):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=2e-3, atol=1e-5)
+
+    def test_early_stopping_triggers_under_mesh(self, val_table):
+        full = LightGBMClassifier(
+            numIterations=60, numLeaves=7, minDataInLeaf=5,
+            verbosity=0).setMesh(build_mesh(data=4, feature=2)).fit(
+            val_table)
+        stopped = LightGBMClassifier(
+            numIterations=60, numLeaves=7, minDataInLeaf=5,
+            validationIndicatorCol="valid", earlyStoppingRound=2,
+            verbosity=0).setMesh(build_mesh(data=4, feature=2)).fit(
+            val_table)
+        assert len(stopped.getModel().trees) < len(full.getModel().trees)
+
+    def test_2d_mesh_validation_parity(self, val_table):
+        kw = dict(numIterations=20, numLeaves=7, minDataInLeaf=5,
+                  validationIndicatorCol="valid", earlyStoppingRound=4,
+                  verbosity=0)
+        serial = LightGBMClassifier(**kw).setMesh(_serial_mesh()).fit(
+            val_table)
+        d2 = LightGBMClassifier(**kw).setMesh(
+            build_mesh(data=4, feature=2)).fit(val_table)
+        assert len(serial.getModel().trees) == len(d2.getModel().trees)
+        X = np.asarray(val_table["features"])
+        np.testing.assert_allclose(
+            np.asarray(serial.getModel().predict_margin(X)),
+            np.asarray(d2.getModel().predict_margin(X)),
+            rtol=5e-3, atol=1e-4)
+
+
+class TestDistributedRanking:
+    """Mesh-sharded lambdarank (VERDICT r2 next #3): whole queries packed
+    per data shard, pairwise gradients shard-local, psum histograms."""
+
+    @pytest.fixture(scope="class")
+    def rank_table(self):
+        rng = np.random.default_rng(17)
+        n_q, rows_q = 60, 15
+        rows = []
+        for q in range(n_q):
+            m = rng.integers(5, rows_q + 1)
+            X = rng.normal(size=(m, 8))
+            rel = np.clip((X[:, 0] * 1.2 + X[:, 1]
+                           + rng.normal(size=m) * 0.3) * 1.2 + 1.5,
+                          0, 4).astype(int)
+            rows.append((X, rel, np.full(m, q)))
+        X = np.concatenate([r[0] for r in rows])
+        y = np.concatenate([r[1] for r in rows]).astype(np.float64)
+        q = np.concatenate([r[2] for r in rows]).astype(np.int64)
+        return {"features": X, "label": y, "query": q}
+
+    def test_mesh_ranker_parity_with_serial(self, rank_table):
+        from mmlspark_tpu.gbdt import LightGBMRanker
+        kw = dict(numIterations=8, numLeaves=7, minDataInLeaf=3,
+                  verbosity=0)
+        serial = LightGBMRanker(**kw).fit(rank_table)
+        dist = LightGBMRanker(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(rank_table)
+        st, dt = serial.getModel().trees, dist.getModel().trees
+        assert len(st) == len(dt)
+        # query packing changes float summation order inside histograms;
+        # tree structure must match, leaf values to float tolerance
+        for a, b in zip(st, dt):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=5e-3, atol=1e-4)
+
+    def test_mesh_ranker_learns(self, rank_table):
+        from mmlspark_tpu.gbdt import LightGBMRanker
+        from mmlspark_tpu.gbdt.ranking import ndcg_at_k
+        m = LightGBMRanker(numIterations=20, numLeaves=15, minDataInLeaf=3,
+                           verbosity=0).setMesh(
+            build_mesh(data=4, feature=2)).fit(rank_table)
+        out = m.transform(rank_table)
+        ndcg = ndcg_at_k(np.asarray(out["prediction"]),
+                         np.asarray(rank_table["label"]),
+                         np.asarray(rank_table["query"]), k=10)
+        assert ndcg > 0.75
+
+    def test_mesh_ranker_early_stopping(self, rank_table):
+        from mmlspark_tpu.gbdt import LightGBMRanker
+        t = dict(rank_table)
+        q = np.asarray(t["query"])
+        vmask = (q % 5 == 0)          # whole queries go to validation
+        t["valid"] = vmask.astype(np.float64)
+        m = LightGBMRanker(numIterations=40, numLeaves=7, minDataInLeaf=3,
+                           validationIndicatorCol="valid",
+                           earlyStoppingRound=3, verbosity=0).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        assert 1 <= len(m.getModel().trees) <= 40
+
+
+class TestVotingParallel:
+    """True PV-Tree voting parallelism (VERDICT r2 next #4): per-shard
+    top-k feature votes, allgathered; full histograms psum-reduced ONLY
+    for the 2k voted candidates."""
+
+    @pytest.fixture(scope="class")
+    def wide_table(self):
+        from sklearn.datasets import make_classification
+        X, y = make_classification(n_samples=1200, n_features=24,
+                                   n_informative=6, n_redundant=2,
+                                   random_state=3, class_sep=1.5)
+        return {"features": X, "label": y.astype(float)}
+
+    def test_voting_full_k_identical_to_data_parallel(self, wide_table):
+        """top_k >= f votes every feature, so voting must reproduce the
+        data-parallel learner exactly."""
+        kw = dict(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                  verbosity=0)
+        dp = LightGBMClassifier(**kw, parallelism="data").setMesh(
+            build_mesh(data=8, feature=1)).fit(wide_table)
+        vt = LightGBMClassifier(**kw, parallelism="voting", topK=24
+                                ).setMesh(build_mesh(data=8, feature=1)
+                                          ).fit(wide_table)
+        st, vtr = dp.getModel().trees, vt.getModel().trees
+        assert len(st) == len(vtr)
+        for a, b in zip(st, vtr):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=2e-3, atol=1e-5)
+
+    def test_voting_small_k_matches_on_separable_data(self, wide_table):
+        """With clear top features, k=4 voting finds the same splits as
+        exact data-parallel (the PV-Tree accuracy claim)."""
+        kw = dict(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                  verbosity=0)
+        dp = LightGBMClassifier(**kw, parallelism="data").setMesh(
+            build_mesh(data=8, feature=1)).fit(wide_table)
+        vt = LightGBMClassifier(**kw, parallelism="voting", topK=4
+                                ).setMesh(build_mesh(data=8, feature=1)
+                                          ).fit(wide_table)
+        for a, b in zip(dp.getModel().trees, vt.getModel().trees):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+
+    def test_voting_reduces_allreduce_bytes(self):
+        """Compile the voting boost step and assert the histogram
+        all-reduce moves (2k, B, 3) — not (f, B, 3) — per split: the
+        PV-Tree communication claim, checked against the HLO."""
+        import jax.numpy as jnp
+        from mmlspark_tpu.core.mesh import build_mesh as bm
+        from mmlspark_tpu.gbdt.distributed import make_boost_scan
+        from mmlspark_tpu.gbdt.grower import GrowerConfig
+        from mmlspark_tpu.gbdt.objectives import BinaryObjective
+
+        f, B, k, n, C = 64, 64, 4, 1024, 2
+        mesh = bm(data=8, feature=1)
+        obj = BinaryObjective()
+        obj.prepare(np.zeros(8), np.ones(8))
+        cfg_v = GrowerConfig(num_leaves=7, num_bins=B, min_data_in_leaf=2,
+                             voting_k=k, hist_method="segment")
+        step = make_boost_scan(mesh, obj, cfg_v, 0.1, bag_sharded=False)
+        args = (jax.ShapeDtypeStruct((n, f), jnp.uint8),
+                jax.ShapeDtypeStruct((n,), jnp.float32),
+                jax.ShapeDtypeStruct((n,), jnp.float32),
+                jax.ShapeDtypeStruct((n,), jnp.float32),
+                jax.ShapeDtypeStruct((n,), jnp.float32),
+                jax.ShapeDtypeStruct((C, 1), jnp.float32),
+                jax.ShapeDtypeStruct((C, f, 3), jnp.float32),
+                jax.ShapeDtypeStruct((8, f), jnp.uint8),
+                jax.ShapeDtypeStruct((8,), jnp.float32))
+        hlo = step.lower(*args).compile().as_text()
+        import re
+        reduced = re.findall(r"all-reduce[^\n]*f32\[(\d+),%?(\d+),3\]", hlo)
+        shapes = {(int(a), int(b)) for a, b in reduced}
+        assert (2 * k, B) in shapes, shapes
+        assert (f, B) not in shapes, "full-histogram all-reduce present"

@@ -291,9 +291,12 @@ class TestValScoreScale:
             return out
         eng._boost_scan = spy
         try:
+            # parallelism="serial" pins the in-process _boost_scan path
+            # (the default would auto-resolve an 8-device mesh here)
             m = LightGBMClassifier(
                 numIterations=3, validationIndicatorCol="valid",
-                earlyStoppingRound=100, verbosity=0).fit(t)
+                earlyStoppingRound=100, parallelism="serial",
+                verbosity=0).fit(t)
         finally:
             eng._boost_scan = orig
         margins = np.asarray(m.getModel().predict_margin(
