@@ -245,6 +245,109 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
     return jax.jit(mapped, donate_argnums=(1, 11))
 
 
+def prepare_arrays_from_shards(bins_shards, label_shards, weight_shards,
+                               mesh: Mesh, num_class: int, init: float,
+                               bin_dtype, shard_rows=None, _piece_spy=None):
+    """Multi-host ingestion (SURVEY.md §7 hard part 4): assemble the global
+    sharded training arrays from PER-SHARD inputs without materializing the
+    full matrix on any single host.
+
+    ``bins_shards[d]`` is data-shard d's binned rows (n_d, f) — in a real
+    multi-host deployment the per-host Arrow reader output.  Shards are
+    padded to the max shard length with zero-weight rows; every device
+    piece is produced by ``jax.make_array_from_callback``, which asks only
+    for the ADDRESSABLE devices' (S, f_shard) blocks, so peak host memory
+    is this host's shards, not D of them.  On a multi-controller
+    deployment pass ``None`` in the non-local slots of the three shard
+    lists plus ``shard_rows`` (the global per-shard row counts, small
+    metadata every host knows); the callback never touches non-local
+    slots.  Returns the same tuple as :func:`prepare_arrays`
+    (rp = total pad rows across shards).
+    """
+    D = int(mesh.shape[DATA_AXIS])
+    fn = int(mesh.shape[FEATURE_AXIS])
+    if len(bins_shards) != D:
+        raise ValueError(
+            f"need exactly one shard slot per data-mesh slice: got "
+            f"{len(bins_shards)} slots for data={D}")
+    from ..core.mesh import pad_to_multiple
+    local = [d for d in range(D) if bins_shards[d] is not None]
+    if not local:
+        raise ValueError("no local shards (every slot is None)")
+    f = bins_shards[local[0]].shape[1]
+    for d in local:
+        if bins_shards[d].shape[1] != f:
+            raise ValueError(
+                f"shard {d} has {bins_shards[d].shape[1]} features, "
+                f"shard {local[0]} has {f}: all shards must agree")
+        nl = len(label_shards[d])
+        nw = len(weight_shards[d]) if weight_shards[d] is not None else nl
+        if not (bins_shards[d].shape[0] == nl == nw):
+            raise ValueError(
+                f"shard {d}: bins rows {bins_shards[d].shape[0]}, labels "
+                f"{nl}, weights {nw} must all match")
+    f_padded = pad_to_multiple(f, fn)
+    if shard_rows is not None:
+        sizes = list(shard_rows)
+        for d in local:
+            if sizes[d] != bins_shards[d].shape[0]:
+                raise ValueError(
+                    f"shard_rows[{d}]={sizes[d]} does not match the local "
+                    f"shard's {bins_shards[d].shape[0]} rows")
+    elif len(local) == D:
+        sizes = [b.shape[0] for b in bins_shards]
+    else:
+        raise ValueError("shard_rows is required when some shard slots "
+                         "are None (multi-controller)")
+    S = max(sizes)
+    n_global = D * S
+
+    def make(spec, dtype, fill, shard_source, width=None):
+        sh = NamedSharding(mesh, spec)
+        shape = (n_global,) if width is None else (n_global, width)
+
+        def cb(index):
+            r0, r1, _ = index[0].indices(n_global)
+            d = r0 // S
+            local = shard_source(d)
+            rows = r1 - r0
+            if width is None:
+                out = np.full(rows, fill, dtype)
+                r = min(local.shape[0], rows)
+                out[:r] = local[:r]
+            else:
+                c0, c1s, _ = index[1].indices(width)
+                out = np.full((rows, c1s - c0), fill, dtype)
+                r = min(local.shape[0], rows)
+                c1 = min(c1s, local.shape[1])
+                if c1 > c0:
+                    out[:r, :c1 - c0] = local[:r, c0:c1]
+            if _piece_spy is not None:
+                _piece_spy(out.shape)
+            return out
+
+        return jax.make_array_from_callback(shape, sh, cb)
+
+    lab_dtype = np.int32 if num_class > 1 else np.float32
+    bins_d = make(P(DATA_AXIS, FEATURE_AXIS), bin_dtype, 0,
+                  lambda d: bins_shards[d], width=f_padded)
+    lab_d = make(P(DATA_AXIS), lab_dtype, 0,
+                 lambda d: np.asarray(label_shards[d], lab_dtype))
+    w_d = make(P(DATA_AXIS), np.float32, 0.0,
+               lambda d: np.asarray(weight_shards[d], np.float32))
+    real_d = make(P(DATA_AXIS), np.float32, 0.0,
+                  lambda d: np.ones(sizes[d], np.float32))
+    if num_class > 1:
+        scores = jax.device_put(
+            jnp.full((n_global, num_class), init, jnp.float32),
+            NamedSharding(mesh, P(DATA_AXIS, None)))
+    else:
+        scores = jax.device_put(jnp.full(n_global, init, jnp.float32),
+                                NamedSharding(mesh, P(DATA_AXIS)))
+    rp = n_global - sum(sizes)
+    return bins_d, lab_d, w_d, real_d, scores, rp, f_padded - f
+
+
 def prepare_arrays(bins: np.ndarray, labels: np.ndarray, weights: np.ndarray,
                    mesh: Mesh, num_class: int, init: float,
                    init_scores: Optional[np.ndarray] = None

@@ -350,7 +350,19 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     ``mesh``: a ``(data, feature)`` Mesh for distributed training; rows and
     features are padded to the mesh shape and the boost step runs under
     ``shard_map`` with psum histogram allreduce (SURVEY.md §5.8 swap).
+
+    ``bins`` may also be a LIST of per-shard binned matrices (with
+    ``labels``/``weights`` lists to match) for multi-host ingestion: each
+    data shard's rows go straight to its mesh slice with no global
+    materialization (SURVEY.md §7 hard part 4; requires ``mesh``, plain
+    gbdt, no validation/bagging/callbacks).
     """
+    if isinstance(bins, (list, tuple)):
+        return _train_distributed_sharded(
+            bins, labels, weights, mapper, objective, params, mesh,
+            feature_names, val_bins=val_bins, callbacks=callbacks,
+            grad_fn_override=grad_fn_override, init_scores=init_scores,
+            ranking_info=ranking_info)
     n, f = bins.shape
     K = objective.num_model_per_iteration
     rng = np.random.default_rng(params.seed)
@@ -725,6 +737,103 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             t.leaf_value = t.leaf_value * avg
             t.internal_value = t.internal_value * avg
             t.shrinkage = avg
+    return _finalize_booster(trees, K, init, params, objective, mapper,
+                             feature_names, f, stop_iter)
+
+
+def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
+                               mapper, objective, params, mesh,
+                               feature_names, val_bins=None, callbacks=None,
+                               grad_fn_override=None, init_scores=None,
+                               ranking_info=None) -> Booster:
+    """Multi-host mesh training from per-shard inputs: each data shard's
+    rows feed its own mesh slice via ``make_array_from_callback`` — the
+    full binned matrix never exists on one host (SURVEY.md §7 hard part
+    4; the reference's per-executor Dataset construction)."""
+    from .distributed import make_boost_scan, make_multiclass_scan, \
+        prepare_arrays_from_shards
+
+    if mesh is None:
+        raise ValueError("sharded input requires a mesh (setMesh or "
+                         "multi-device default)")
+    if (val_bins is not None or callbacks or grad_fn_override is not None
+            or init_scores is not None or ranking_info is not None):
+        raise NotImplementedError(
+            "sharded ingestion supports plain distributed gbdt only "
+            "(no validation, callbacks, ranking, or init scores yet)")
+    if params.boosting != "gbdt":
+        raise NotImplementedError(
+            "sharded ingestion requires boostingType='gbdt'")
+    if params.bagging_freq > 0 and params.bagging_fraction < 1.0:
+        raise NotImplementedError(
+            "bagging with sharded ingestion is not yet supported (no "
+            "global row order to draw against)")
+    if params.parallelism == "voting" and mapper.has_categorical:
+        raise NotImplementedError(
+            "parallelism='voting' does not support categorical features "
+            "yet; use parallelism='data'")
+
+    K = objective.num_model_per_iteration
+    T = params.num_iterations
+    rng = np.random.default_rng(params.seed)
+    f = bins_shards[0].shape[1]
+    if weight_shards is None:
+        weight_shards = [np.ones(b.shape[0], np.float64)
+                         for b in bins_shards]
+    # objective statistics need the global label/weight vectors — 1-D and
+    # tiny relative to bins, which is what must never be concatenated
+    y_global = np.concatenate([np.asarray(y) for y in label_shards])
+    w_global = np.concatenate([np.asarray(w) for w in weight_shards])
+    objective.prepare(y_global, w_global)
+    init = objective.init_score(y_global, w_global) \
+        if params.boost_from_average else 0.0
+
+    cfg = GrowerConfig(
+        num_leaves=params.num_leaves, max_depth=params.max_depth,
+        num_bins=mapper.num_total_bins, lambda_l1=params.lambda_l1,
+        lambda_l2=params.lambda_l2, min_data_in_leaf=params.min_data_in_leaf,
+        min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
+        min_gain_to_split=params.min_gain_to_split,
+        hist_method=params.histogram_method,
+        voting_k=params.top_k if params.parallelism == "voting" else 0,
+        use_categorical=mapper.has_categorical,
+        cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
+        max_cat_threshold=params.max_cat_threshold,
+        max_cat_to_onehot=params.max_cat_to_onehot)
+
+    bins_d, labels_d, w_d, real, scores, rp, fp = \
+        prepare_arrays_from_shards(
+            bins_shards, label_shards, weight_shards, mesh, K, init,
+            mapper.bin_dtype)
+    f_padded = f + fp
+    fi_base = np.zeros((f_padded, 3), np.float32)
+    fi_base[:f] = _feat_info_from_mapper(mapper, f)
+    if params.feature_fraction < 1.0:
+        fi_stack = jnp.asarray(np.stack([
+            _draw_feature_fraction(rng, fi_base, f,
+                                   params.feature_fraction)
+            for _ in range(T)]))
+    else:
+        fi_stack = jnp.asarray(np.broadcast_to(fi_base,
+                                               (T,) + fi_base.shape))
+    bags = jnp.ones((T, 1), jnp.float32)
+    dummy_vb = jnp.zeros((int(mesh.shape["data"]), f), mapper.bin_dtype)
+    dummy_vs = jnp.zeros(
+        (int(mesh.shape["data"]), K) if K > 1
+        else (int(mesh.shape["data"]),), jnp.float32)
+
+    if K > 1:
+        step = make_multiclass_scan(mesh, objective, cfg,
+                                    params.learning_rate, K, False)
+    else:
+        step = make_boost_scan(mesh, objective, cfg,
+                               params.learning_rate, False)
+    trees_st, scores, _, _ = step(bins_d, scores, labels_d, w_d, real,
+                                  bags, fi_stack, dummy_vb, dummy_vs)
+
+    trees, nls = _fetch_host_trees([trees_st], params.num_leaves, mapper)
+    trees, stop_iter = _truncate_no_growth(trees, nls, K, T,
+                                           params.verbosity)
     return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)
 

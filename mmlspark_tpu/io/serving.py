@@ -31,12 +31,75 @@ from ..core.schema import DataTable
 
 
 class _Pending:
-    __slots__ = ("event", "response", "status")
+    __slots__ = ("event", "response", "status", "dead")
 
     def __init__(self):
         self.event = threading.Event()
         self.response: Any = None
         self.status = 200
+        self.dead = False   # handler gave up (timeout); replies must fail
+
+
+class _Exchange:
+    """Shared request queue + parked-reply table.
+
+    One exchange can back many worker servers: requests from every worker
+    land in ONE micro-batch queue, and a reply routes to the parked socket
+    by request-id regardless of which worker accepted it — the
+    cross-worker reply routing of the reference's DistributedHTTPSource /
+    HTTPSink pair (expected path io/http/DistributedHTTPSource.scala,
+    UNVERIFIED; SURVEY.md §3.4).
+    """
+
+    def __init__(self, reply_timeout: float = 30.0):
+        self.queue: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
+        self.pending: Dict[str, _Pending] = {}
+        self.lock = threading.Lock()
+        self.reply_timeout = reply_timeout
+
+    def park(self, payload: Any) -> Tuple[str, _Pending]:
+        rid = uuid.uuid4().hex
+        pending = _Pending()
+        with self.lock:
+            self.pending[rid] = pending
+        self.queue.put((rid, payload))
+        return rid, pending
+
+    def unpark(self, rid: str) -> bool:
+        """Remove a parked request after its wait ended.  Returns whether a
+        reply landed — re-checked under the lock, so a reply racing the
+        timeout either delivers (True) or cleanly fails on the reply side
+        (the ``dead`` flag), never both."""
+        with self.lock:
+            pending = self.pending.pop(rid, None)
+            if pending is None:
+                return False
+            if pending.event.is_set():
+                return True
+            pending.dead = True
+            return False
+
+    def get_batch(self, max_rows: int = 64, timeout: float = 0.05
+                  ) -> List[Tuple[str, Any]]:
+        batch: List[Tuple[str, Any]] = []
+        try:
+            batch.append(self.queue.get(timeout=timeout))
+            while len(batch) < max_rows:
+                batch.append(self.queue.get_nowait())
+        except queue.Empty:
+            pass
+        return batch
+
+    def reply(self, request_id: str, response: Any,
+              status: int = 200) -> bool:
+        with self.lock:
+            pending = self.pending.get(request_id)
+            if pending is None or pending.dead:
+                return False  # socket gone (timeout/disconnect)
+            pending.response = response
+            pending.status = status
+            pending.event.set()
+            return True
 
 
 class HTTPServer:
@@ -48,11 +111,9 @@ class HTTPServer:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 api_path: str = "/", reply_timeout: float = 30.0):
-        self._queue: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
-        self._pending: Dict[str, _Pending] = {}
-        self._lock = threading.Lock()
-        self._reply_timeout = reply_timeout
+                 api_path: str = "/", reply_timeout: float = 30.0,
+                 exchange: Optional[_Exchange] = None):
+        self._exchange = exchange or _Exchange(reply_timeout)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -70,15 +131,11 @@ class HTTPServer:
                 except (ValueError, UnicodeDecodeError):
                     self.send_error(400, "invalid JSON")
                     return
-                rid = uuid.uuid4().hex
-                pending = _Pending()
-                with outer._lock:
-                    outer._pending[rid] = pending
-                outer._queue.put((rid, payload))
-                ok = pending.event.wait(outer._reply_timeout)
-                with outer._lock:
-                    outer._pending.pop(rid, None)
-                if not ok:
+                rid, pending = outer._exchange.park(payload)
+                ok = pending.event.wait(outer._exchange.reply_timeout)
+                # unpark re-checks under the lock: a reply racing the
+                # timeout is either fully delivered or fully refused
+                if not outer._exchange.unpark(rid) and not ok:
                     self.send_error(504, "pipeline timeout")
                     return
                 body = json.dumps(pending.response).encode("utf-8")
@@ -108,26 +165,52 @@ class HTTPServer:
     def get_batch(self, max_rows: int = 64, timeout: float = 0.05
                   ) -> List[Tuple[str, Any]]:
         """Pull up to ``max_rows`` parked requests (micro-batch trigger)."""
-        batch: List[Tuple[str, Any]] = []
-        try:
-            batch.append(self._queue.get(timeout=timeout))
-            while len(batch) < max_rows:
-                batch.append(self._queue.get_nowait())
-        except queue.Empty:
-            pass
-        return batch
+        return self._exchange.get_batch(max_rows, timeout)
 
     def reply(self, request_id: str, response: Any,
               status: int = 200) -> bool:
         """HTTPSink: route a reply to the parked socket by request-id."""
-        with self._lock:
-            pending = self._pending.get(request_id)
-        if pending is None:
-            return False  # socket gone (timeout/disconnect)
-        pending.response = response
-        pending.status = status
-        pending.event.set()
-        return True
+        return self._exchange.reply(request_id, response, status)
+
+
+class DistributedHTTPServer:
+    """N worker HTTP servers over ONE shared exchange.
+
+    The reference's DistributedHTTPSource runs one server per executor
+    and routes each reply back to whichever executor parked the socket
+    (SURVEY.md §3.4).  Here: every worker pushes into the shared micro-
+    batch queue, the driver loop pulls interleaved batches, and
+    ``reply``/``reply_from_table`` deliver by request-id across workers.
+    """
+
+    def __init__(self, num_workers: int = 2, host: str = "127.0.0.1",
+                 api_path: str = "/", reply_timeout: float = 30.0):
+        self._exchange = _Exchange(reply_timeout)
+        self.workers = [
+            HTTPServer(host, 0, api_path, reply_timeout,
+                       exchange=self._exchange)
+            for _ in range(num_workers)]
+
+    @property
+    def addresses(self) -> List[str]:
+        return [w.address for w in self.workers]
+
+    def start(self) -> "DistributedHTTPServer":
+        for w in self.workers:
+            w.start()
+        return self
+
+    def stop(self) -> None:
+        for w in self.workers:
+            w.stop()
+
+    def get_batch(self, max_rows: int = 64, timeout: float = 0.05
+                  ) -> List[Tuple[str, Any]]:
+        return self._exchange.get_batch(max_rows, timeout)
+
+    def reply(self, request_id: str, response: Any,
+              status: int = 200) -> bool:
+        return self._exchange.reply(request_id, response, status)
 
 
 def request_table(batch: List[Tuple[str, Any]]) -> DataTable:

@@ -1,0 +1,86 @@
+"""Multi-host ingestion: per-shard arrays feed the mesh with no global
+binned-matrix materialization (VERDICT r2 next #9; SURVEY.md §7 hard
+part 4)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.mesh import build_mesh
+from mmlspark_tpu.gbdt import fit_bin_mapper
+from mmlspark_tpu.gbdt.engine import TrainParams, train
+from mmlspark_tpu.gbdt.objectives import get_objective
+
+
+@pytest.fixture(scope="module")
+def data():
+    from sklearn.datasets import make_classification
+    X, y = make_classification(n_samples=1100, n_features=9,
+                               n_informative=6, random_state=13)
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def _shards(X, y, mapper, D=8, rng=np.random.default_rng(0)):
+    """Unequal per-host shards, as per-host readers would produce."""
+    cuts = np.sort(rng.choice(np.arange(50, len(y) - 50), D - 1,
+                              replace=False))
+    idx = np.split(np.arange(len(y)), cuts)
+    bins_shards = [mapper.transform_packed(X[i]) for i in idx]
+    label_shards = [y[i] for i in idx]
+    weight_shards = [np.ones(len(i), np.float64) for i in idx]
+    return bins_shards, label_shards, weight_shards, idx
+
+
+class TestShardedIngestion:
+    def test_sharded_matches_monolithic_mesh_training(self, data):
+        X, y = data
+        mapper = fit_bin_mapper(X, max_bin=63)
+        mesh = build_mesh(data=8, feature=1)
+        params = TrainParams(num_iterations=6, num_leaves=7,
+                             min_data_in_leaf=5, max_bin=63, verbosity=0)
+        bs, ls, ws, idx = _shards(X, y, mapper)
+        # shard-order concatenation = the row order the sharded path sees
+        perm = np.concatenate(idx)
+        obj1 = get_objective("binary")
+        sharded = train(bs, ls, ws, mapper, obj1, params, mesh=mesh)
+        obj2 = get_objective("binary")
+        mono = train(mapper.transform_packed(X[perm]), y[perm],
+                     np.ones(len(y)), mapper, obj2, params, mesh=mesh)
+        st, mt = sharded.trees, mono.trees
+        assert len(st) == len(mt) == 6
+        for a, b in zip(st, mt):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=2e-3, atol=1e-5)
+
+    def test_no_device_piece_exceeds_one_shard(self, data):
+        """Every host-side materialization the ingest path performs is at
+        most ONE shard slice — the full matrix never exists."""
+        X, y = data
+        mapper = fit_bin_mapper(X, max_bin=63)
+        mesh = build_mesh(data=8, feature=1)
+        from mmlspark_tpu.gbdt.distributed import prepare_arrays_from_shards
+        bs, ls, ws, idx = _shards(X, y, mapper)
+        S = max(len(i) for i in idx)
+        pieces = []
+        out = prepare_arrays_from_shards(
+            bs, ls, ws, mesh, 1, 0.0, mapper.bin_dtype,
+            _piece_spy=lambda shape: pieces.append(shape))
+        assert pieces, "callback path not exercised"
+        n_total = sum(len(i) for i in idx)
+        for shape in pieces:
+            assert shape[0] <= S < n_total, shape
+        bins_d = out[0]
+        assert bins_d.shape == (8 * S, X.shape[1])
+
+    def test_sharded_requires_mesh_and_plain_gbdt(self, data):
+        X, y = data
+        mapper = fit_bin_mapper(X, max_bin=63)
+        bs, ls, ws, _ = _shards(X, y, mapper)
+        obj = get_objective("binary")
+        with pytest.raises(ValueError, match="requires a mesh"):
+            train(bs, ls, ws, mapper, obj,
+                  TrainParams(num_iterations=2), mesh=None)
+        with pytest.raises(NotImplementedError, match="gbdt"):
+            train(bs, ls, ws, mapper, obj,
+                  TrainParams(num_iterations=2, boosting="goss"),
+                  mesh=build_mesh(data=8, feature=1))
