@@ -337,13 +337,15 @@ def prepare_arrays_from_shards(bins_shards, label_shards, weight_shards,
                lambda d: np.asarray(weight_shards[d], np.float32))
     real_d = make(P(DATA_AXIS), np.float32, 0.0,
                   lambda d: np.ones(sizes[d], np.float32))
+    # scores ride the callback path too — no transient global array on any
+    # single device (the arrays this function exists to avoid)
     if num_class > 1:
-        scores = jax.device_put(
-            jnp.full((n_global, num_class), init, jnp.float32),
-            NamedSharding(mesh, P(DATA_AXIS, None)))
+        scores = make(P(DATA_AXIS, None), np.float32, init,
+                      lambda d: np.full((S, num_class), init, np.float32),
+                      width=num_class)
     else:
-        scores = jax.device_put(jnp.full(n_global, init, jnp.float32),
-                                NamedSharding(mesh, P(DATA_AXIS)))
+        scores = make(P(DATA_AXIS), np.float32, init,
+                      lambda d: np.full(S, init, np.float32))
     rp = n_global - sum(sizes)
     return bins_d, lab_d, w_d, real_d, scores, rp, f_padded - f
 

@@ -773,6 +773,12 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
             "parallelism='voting' does not support categorical features "
             "yet; use parallelism='data'")
 
+    if any(b is None for b in bins_shards):
+        raise NotImplementedError(
+            "engine.train's sharded entrypoint is single-controller: all "
+            "shard slots must be present (a multi-controller deployment "
+            "calls prepare_arrays_from_shards with None slots + "
+            "shard_rows and drives the scan steps directly)")
     K = objective.num_model_per_iteration
     T = params.num_iterations
     rng = np.random.default_rng(params.seed)

@@ -1,102 +1,128 @@
-"""Binary file datasource.
+"""Binary file datasource — batch and streaming.
 
 Reference: io/binary/BinaryFileFormat.scala, BinaryFileReader.scala
 (expected paths, UNVERIFIED — SURVEY.md §2.1): (path, bytes) rows from a
-directory tree, streaming-capable.  A C++ fast path
-(``mmlspark_tpu.native``) mmaps and bulk-reads when built; the Python
-fallback keeps behavior identical.
+directory tree, with subsampling, usable in batch and streaming queries.
+The native engine (``mmlspark_tpu.native``, C++) provides the directory
+scan and a thread-pool bulk read with the GIL released; pure-Python
+fallbacks keep behavior identical when the extension isn't built.
 """
 
 from __future__ import annotations
 
-import fnmatch
-import os
-from typing import Iterator, List, Optional, Tuple
+import time
+from typing import Iterator, List, Optional
 
 import numpy as np
 
+from .. import native
 from ..core.schema import DataTable
 
 
-def _iter_files(path: str, pattern: Optional[str],
-                recursive: bool) -> Iterator[str]:
+def _scan(path: str, pattern: Optional[str],
+          recursive: bool) -> List[tuple]:
+    import os
     if os.path.isfile(path):
-        yield path
-        return
-    if recursive:
-        for root, _, files in os.walk(path):
-            for f in sorted(files):
-                if pattern is None or fnmatch.fnmatch(f, pattern):
-                    yield os.path.join(root, f)
-    else:
-        for f in sorted(os.listdir(path)):
-            full = os.path.join(path, f)
-            if os.path.isfile(full) and (pattern is None
-                                         or fnmatch.fnmatch(f, pattern)):
-                yield full
+        st = os.stat(path)
+        return [(path, int(st.st_size), float(st.st_mtime))]
+    return native.scan_dir(path, pattern, recursive)
 
 
-def _read_bytes(path: str) -> bytes:
-    try:
-        from mmlspark_tpu import native
-        if native.available():
-            return native.read_file(path)
-    except ImportError:
-        pass
-    with open(path, "rb") as f:
-        return f.read()
+def _subsample(entries: List[tuple], sample_ratio: float,
+               seed: int) -> List[tuple]:
+    """Per-file Bernoulli subsample (BinaryFileFormat's subsample option).
+
+    The keep/drop decision is a pure function of (path, seed) — NOT a
+    positional draw — so a file's sampling fate is stable as new files
+    appear in a streaming listing."""
+    if sample_ratio >= 1.0:
+        return entries
+    from ..featurize.hashing import murmur3_32
+    thresh = sample_ratio * 2147483648.0
+    return [e for e in entries
+            if (murmur3_32(e[0].encode("utf-8"), seed) & 0x7FFFFFFF)
+            < thresh]
+
+
+def _table(entries: List[tuple], with_stats: bool = True) -> DataTable:
+    paths = [e[0] for e in entries]
+    blobs_list = native.read_files(paths)
+    blobs = np.empty(len(paths), dtype=object)
+    lengths = np.zeros(len(paths), dtype=np.int64)
+    for i, b in enumerate(blobs_list):
+        blobs[i] = b
+        lengths[i] = len(b)
+    cols = {
+        "path": np.asarray(paths, dtype=object),
+        "length": lengths,
+        "bytes": blobs,
+    }
+    if with_stats:
+        cols["modificationTime"] = np.asarray(
+            [e[2] for e in entries], np.float64)
+    return DataTable(cols)
 
 
 def read_binary_files(path: str, pattern: Optional[str] = None,
-                      recursive: bool = True,
-                      with_stats: bool = True) -> DataTable:
-    """Directory tree → (path, length, modificationTime, bytes) table."""
-    paths: List[str] = list(_iter_files(path, pattern, recursive))
-    blobs = np.empty(len(paths), dtype=object)
-    lengths = np.zeros(len(paths), dtype=np.int64)
-    mtimes = np.zeros(len(paths), dtype=np.float64)
-    for i, p in enumerate(paths):
-        blobs[i] = _read_bytes(p)
-        lengths[i] = len(blobs[i])
-        if with_stats:
-            mtimes[i] = os.path.getmtime(p)
-    return DataTable({
-        "path": np.asarray(paths, dtype=object),
-        "length": lengths,
-        "modificationTime": mtimes,
-        "bytes": blobs,
-    })
+                      recursive: bool = True, with_stats: bool = True,
+                      *, sample_ratio: float = 1.0,
+                      seed: int = 0) -> DataTable:
+    """Directory tree → (path, length[, modificationTime], bytes) table.
+
+    New options are keyword-only so pre-existing positional callers of
+    ``(path, pattern, recursive, with_stats)`` keep their meaning."""
+    entries = _subsample(_scan(path, pattern, recursive), sample_ratio, seed)
+    return _table(entries, with_stats)
 
 
 class BinaryFileReader:
-    """Streaming-capable reader: iterate micro-batches of binary rows
-    (analog of the datasource's streaming mode)."""
+    """Streaming binary datasource: iterate micro-batches of binary rows.
+
+    Batch mode (``follow=False``) yields the directory's current contents
+    in ``batch_size`` chunks.  Streaming mode (``follow=True``) keeps
+    polling for NEW files (by path + mtime) every ``poll_interval``
+    seconds and yields them as they appear — the reference's streaming
+    ``readStream.format("binaryFile")`` behavior — until ``stop()`` is
+    called or ``max_batches`` is reached.
+    """
 
     def __init__(self, path: str, pattern: Optional[str] = None,
-                 recursive: bool = True, batch_size: int = 64):
+                 recursive: bool = True, batch_size: int = 64,
+                 sample_ratio: float = 1.0, seed: int = 0,
+                 follow: bool = False, poll_interval: float = 0.25,
+                 max_batches: Optional[int] = None):
         self.path = path
         self.pattern = pattern
         self.recursive = recursive
         self.batch_size = batch_size
+        self.sample_ratio = sample_ratio
+        self.seed = seed
+        self.follow = follow
+        self.poll_interval = poll_interval
+        self.max_batches = max_batches
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
 
     def __iter__(self) -> Iterator[DataTable]:
-        batch_paths: List[str] = []
-        for p in _iter_files(self.path, self.pattern, self.recursive):
-            batch_paths.append(p)
-            if len(batch_paths) >= self.batch_size:
-                yield self._make(batch_paths)
-                batch_paths = []
-        if batch_paths:
-            yield self._make(batch_paths)
-
-    def _make(self, paths: List[str]) -> DataTable:
-        blobs = np.empty(len(paths), dtype=object)
-        lengths = np.zeros(len(paths), dtype=np.int64)
-        for i, p in enumerate(paths):
-            blobs[i] = _read_bytes(p)
-            lengths[i] = len(blobs[i])
-        return DataTable({
-            "path": np.asarray(paths, dtype=object),
-            "length": lengths,
-            "bytes": blobs,
-        })
+        seen: dict = {}
+        emitted = 0
+        while not self._stopped:
+            entries = _subsample(
+                _scan(self.path, self.pattern, self.recursive),
+                self.sample_ratio, self.seed)
+            fresh = [e for e in entries
+                     if seen.get(e[0]) != e[2]]
+            for e in fresh:
+                seen[e[0]] = e[2]
+            for i in range(0, len(fresh), self.batch_size):
+                yield _table(fresh[i:i + self.batch_size])
+                emitted += 1
+                if self.max_batches and emitted >= self.max_batches:
+                    return
+                if self._stopped:
+                    return
+            if not self.follow:
+                return
+            time.sleep(self.poll_interval)

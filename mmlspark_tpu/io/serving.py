@@ -31,13 +31,12 @@ from ..core.schema import DataTable
 
 
 class _Pending:
-    __slots__ = ("event", "response", "status", "dead")
+    __slots__ = ("event", "response", "status")
 
     def __init__(self):
         self.event = threading.Event()
         self.response: Any = None
         self.status = 200
-        self.dead = False   # handler gave up (timeout); replies must fail
 
 
 class _Exchange:
@@ -67,17 +66,13 @@ class _Exchange:
 
     def unpark(self, rid: str) -> bool:
         """Remove a parked request after its wait ended.  Returns whether a
-        reply landed — re-checked under the lock, so a reply racing the
-        timeout either delivers (True) or cleanly fails on the reply side
-        (the ``dead`` flag), never both."""
+        reply landed — re-checked under the lock: once the entry is popped
+        here, any later reply() sees no entry and reports undelivered, so
+        a reply racing the timeout either fully delivers or fully fails,
+        never both."""
         with self.lock:
             pending = self.pending.pop(rid, None)
-            if pending is None:
-                return False
-            if pending.event.is_set():
-                return True
-            pending.dead = True
-            return False
+            return pending is not None and pending.event.is_set()
 
     def get_batch(self, max_rows: int = 64, timeout: float = 0.05
                   ) -> List[Tuple[str, Any]]:
@@ -94,7 +89,7 @@ class _Exchange:
               status: int = 200) -> bool:
         with self.lock:
             pending = self.pending.get(request_id)
-            if pending is None or pending.dead:
+            if pending is None:
                 return False  # socket gone (timeout/disconnect)
             pending.response = response
             pending.status = status

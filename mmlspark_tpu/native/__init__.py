@@ -1,0 +1,130 @@
+"""Native runtime extensions (C++), with build-on-demand and fallback.
+
+The reference backs its IO layer with JVM/Hadoop native streams; here the
+equivalent is a small C++ extension (``fastio.cc``) compiled on first use
+with the in-image toolchain.  Public surface:
+
+* ``available() -> bool`` — whether the extension loaded (or could be
+  built); all callers must keep a pure-Python fallback.
+* ``read_file(path) -> bytes``
+* ``read_files(paths, n_threads=8) -> list[bytes]`` — thread-pool bulk
+  read with the GIL released.
+* ``scan_dir(root, pattern, recursive) -> [(path, size, mtime)]``
+
+Set ``MMLSPARK_TPU_NO_NATIVE=1`` to force the Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_mod = None
+_tried = False
+
+
+def _so_path() -> str:
+    tag = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_HERE, f"_fastio{tag}")
+
+
+def _build() -> bool:
+    """Compile fastio.cc with g++ (or cc) into the package directory."""
+    src = os.path.join(_HERE, "fastio.cc")
+    out = _so_path()
+    include = sysconfig.get_paths()["include"]
+    for cxx in ("g++", "c++", "clang++"):
+        try:
+            proc = subprocess.run(
+                [cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+                 f"-I{include}", src, "-o", out, "-pthread"],
+                capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode == 0:
+            return True
+    return False
+
+
+def _load():
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    _tried = True
+    if os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_so_path()) and not _build():
+        return None
+    try:
+        sys.path.insert(0, _HERE)
+        import _fastio  # noqa: PLC0415
+        _mod = _fastio
+    except ImportError:
+        _mod = None
+    finally:
+        if _HERE in sys.path:
+            sys.path.remove(_HERE)
+    return _mod
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_file(path: str) -> bytes:
+    mod = _load()
+    if mod is not None:
+        return mod.read_file(path)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def read_files(paths: List[str], n_threads: int = 8) -> List[bytes]:
+    mod = _load()
+    if mod is not None:
+        return mod.read_files(list(paths), n_threads)
+    return [read_file(p) for p in paths]
+
+
+def murmur3_batch(terms: List[str], seed: int = 42) -> List[int]:
+    """Spark-compatible Murmur3_x86_32 of each term's UTF-8 bytes, as
+    signed int32 (C++ path only; callers gate on :func:`available` and
+    fall back to featurize.hashing's pure-python murmur3_32)."""
+    mod = _load()
+    if mod is None:
+        raise RuntimeError(
+            "mmlspark_tpu.native extension unavailable; use the "
+            "pure-python hasher (featurize.hashing.murmur3_32)")
+    return mod.murmur3_batch(list(terms), seed)
+
+
+def scan_dir(root: str, pattern: Optional[str] = None,
+             recursive: bool = True) -> List[Tuple[str, int, float]]:
+    mod = _load()
+    if mod is not None:
+        return mod.scan_dir(root, pattern, recursive)
+    import fnmatch
+    out: List[Tuple[str, int, float]] = []
+
+    def walk(d: str):
+        names = sorted(os.listdir(d))
+        subdirs = []
+        for name in names:
+            full = os.path.join(d, name)
+            if os.path.isdir(full):
+                if not os.path.islink(full):   # no symlink-dir recursion
+                    subdirs.append(full)
+            elif os.path.isfile(full) and (
+                    pattern is None or fnmatch.fnmatch(name, pattern)):
+                st = os.stat(full)
+                out.append((full, int(st.st_size), float(st.st_mtime)))
+        if recursive:
+            for sd in subdirs:
+                walk(sd)
+
+    walk(root)
+    return out

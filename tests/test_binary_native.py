@@ -1,0 +1,99 @@
+"""Binary datasource: native C++ IO engine + streaming follow mode
+(VERDICT r2 missing #9; reference BinaryFileFormat/BinaryFileReader,
+SURVEY.md §2.1)."""
+
+import importlib
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import native
+from mmlspark_tpu.io.binary import BinaryFileReader, read_binary_files
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    d = tmp_path / "blobs"
+    (d / "sub").mkdir(parents=True)
+    for i in range(10):
+        (d / f"f{i:02d}.bin").write_bytes(bytes([i]) * (100 + i))
+    (d / "sub" / "deep.bin").write_bytes(b"deep")
+    (d / "skip.txt").write_text("no")
+    return str(d)
+
+
+class TestNativeIO:
+    def test_native_builds_and_loads(self):
+        """The C++ engine must actually build in this image (g++ is part
+        of the toolchain contract); the fallback exists for wheels."""
+        assert native.available()
+
+    def test_scan_matches_python_fallback(self, tree, monkeypatch):
+        ents = native.scan_dir(tree, "*.bin", True)
+        assert len(ents) == 11
+        monkeypatch.setenv("MMLSPARK_TPU_NO_NATIVE", "1")
+        fallback = importlib.reload(native)
+        try:
+            ents2 = fallback.scan_dir(tree, "*.bin", True)
+        finally:
+            monkeypatch.delenv("MMLSPARK_TPU_NO_NATIVE")
+            importlib.reload(native)
+        assert [e[0] for e in ents] == [e[0] for e in ents2]
+        assert [e[1] for e in ents] == [e[1] for e in ents2]
+
+    def test_parallel_read_contents(self, tree):
+        ents = native.scan_dir(tree, "*.bin", True)
+        blobs = native.read_files([e[0] for e in ents], n_threads=4)
+        for (p, size, _), b in zip(ents, blobs):
+            assert len(b) == size
+            assert b == open(p, "rb").read()
+
+    def test_non_recursive_and_pattern(self, tree):
+        flat = native.scan_dir(tree, "*.bin", False)
+        assert len(flat) == 10             # sub/deep.bin excluded
+        txt = native.scan_dir(tree, "*.txt", True)
+        assert len(txt) == 1
+
+
+class TestBinaryDatasource:
+    def test_batch_read_with_subsample(self, tree):
+        t = read_binary_files(tree, pattern="*.bin")
+        assert len(t["path"]) == 11
+        assert t["bytes"][0] == bytes([0]) * 100
+        assert (np.asarray(t["length"][:10]) ==
+                np.arange(100, 110)).all()
+        t2 = read_binary_files(tree, pattern="*.bin", sample_ratio=0.5,
+                               seed=3)
+        assert 0 < len(t2["path"]) < 11
+        # deterministic under the same seed
+        t3 = read_binary_files(tree, pattern="*.bin", sample_ratio=0.5,
+                               seed=3)
+        assert list(t2["path"]) == list(t3["path"])
+
+    def test_streaming_follow_picks_up_new_files(self, tree):
+        r = BinaryFileReader(tree, pattern="*.bin", batch_size=4,
+                             follow=True, poll_interval=0.05)
+        got = []
+
+        def consume():
+            for b in r:
+                got.extend(list(b["path"]))
+                if any("late" in p for p in list(b["path"])):
+                    r.stop()
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        with open(os.path.join(tree, "late.bin"), "wb") as f:
+            f.write(b"late!")
+        th.join(10)
+        assert any(p.endswith("late.bin") for p in got)
+        assert len(got) == 12              # 11 initial + 1 late, no dups
+
+    def test_batch_mode_terminates(self, tree):
+        batches = list(BinaryFileReader(tree, pattern="*.bin",
+                                        batch_size=4))
+        assert [len(b["path"]) for b in batches] == [4, 4, 3]
