@@ -47,3 +47,59 @@ def regression_table(rng):
         n_samples=2000, n_features=15, n_informative=10, noise=10.0,
         random_state=11)
     return {"features": X, "label": y.astype(np.float64)}
+
+
+def start_echo_server(post_hook=None, include_headers=False,
+                      strip_query=False):
+    """Shared loopback JSON echo service for HTTP-stage tests.
+
+    POST → ``{"echo": payload}`` (plus the request headers when
+    ``include_headers``), unless ``post_hook(path, payload, headers)``
+    returns a ``(status, obj)`` override; GET → ``{"path": ...}``
+    (query-stripped when ``strip_query``, for deterministic re-runs).
+    Returns ``(base_url, shutdown)``.
+    """
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, obj):
+            body = _json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = _json.loads(self.rfile.read(n)) if n else None
+            except (ValueError, UnicodeDecodeError):
+                payload = "<binary>"
+            if post_hook is not None:
+                hooked = post_hook(self.path, payload, self.headers)
+                if hooked is not None:
+                    self._send(*hooked)
+                    return
+            obj = {"echo": payload}
+            if include_headers:
+                obj["headers"] = dict(self.headers)
+            self._send(200, obj)
+
+        def do_GET(self):
+            path = self.path.split("?")[0] if strip_query else self.path
+            self._send(200, {"path": path})
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def shutdown():
+        server.shutdown()
+        server.server_close()
+
+    return f"http://127.0.0.1:{server.server_address[1]}", shutdown

@@ -734,29 +734,71 @@ def _onnx_model():
         transform_data=t)]
 
 
-# -- io / cognitive (serialization-only: live REST endpoints) -----------------
+# -- io / cognitive (executed against a live LOCAL echo service) --------------
+#
+# The reference gates its cognitive suites behind service secrets
+# (SURVEY.md §4); here a loopback echo server stands in for the REST
+# endpoint, so the fuzzer exercises the full request-build → HTTP →
+# response-parse → column-write path hermetically instead of skipping it.
+
+_ECHO: dict = {}
+
+
+def _echo_url() -> str:
+    """Lazily-started session-lifetime echo service (shared conftest
+    factory): deterministic JSON bodies so save/load re-runs compare
+    equal."""
+    if "url" not in _ECHO:
+        from conftest import start_echo_server
+        _ECHO["url"], _ = start_echo_server(strip_query=True)
+    return _ECHO["url"]
+
+
+def _obj_col(payload):
+    arr = np.empty(2, dtype=object)
+    arr[0] = payload
+    arr[1] = payload
+    return arr
+
 
 @fuzzing_objects("HTTPTransformer")
 def _http_transformer():
     from mmlspark_tpu.io import HTTPTransformer
+    url = _echo_url()
+    reqs = _obj_col({"url": f"{url}/a", "method": "POST",
+                     "headers": {"Content-Type": "application/json"},
+                     "body": '{"x": 1}'})
     return [TestObject(HTTPTransformer(inputCol="request",
                                        outputCol="response"),
-                       serialization_only=True)]
+                       transform_data=DataTable({"request": reqs}),
+                       compare_cols=[])]
 
 
 @fuzzing_objects("SimpleHTTPTransformer")
 def _simple_http():
     from mmlspark_tpu.io import SimpleHTTPTransformer
     return [TestObject(
-        SimpleHTTPTransformer(url="http://127.0.0.1:9/svc",
+        SimpleHTTPTransformer(url=f"{_echo_url()}/svc",
                               inputCol="in", outputCol="out"),
-        serialization_only=True)]
+        transform_data=DataTable({"in": _obj_col({"x": 1})}))]
+
+
+#: per-module row payloads matching each service family's _wrap contract
+_COG_PAYLOADS = {
+    "text": "good text for fuzzing",
+    "vision": "http://images.example/x.png",
+    "face": "http://images.example/face.png",
+    "anomaly": [{"timestamp": "2024-01-01T00:00:00Z", "value": 1.0},
+                {"timestamp": "2024-01-02T00:00:00Z", "value": 1.1}],
+    "search": {"id": "1", "text": "hello"},
+    "speech": None,          # posts raw audio bytes; not JSON-roundtrippable
+}
 
 
 def _register_cognitive():
-    """All cognitive transformers share CognitiveServiceBase params; fuzz
-    persistence generically (live execution is secret-gated in the
-    reference too — SURVEY.md §4)."""
+    """Every cognitive transformer executes end-to-end against the local
+    echo service; families whose payloads cannot be JSON (speech audio)
+    stay persistence-only."""
     import importlib
     import pkgutil
 
@@ -770,11 +812,20 @@ def _register_cognitive():
         if cls.__module__.startswith("mmlspark_tpu.cognitive.")]
 
     def make_provider(cls):
+        module = cls.__module__.rsplit(".", 1)[-1]
+        payload = _COG_PAYLOADS[module]   # KeyError = new module needs a payload
+
         def provider():
+            key = "00000000000000000000000000000000"
+            if payload is None:
+                return [TestObject(
+                    cls(subscriptionKey=key, url="http://127.0.0.1:9/cog"),
+                    serialization_only=True)]
+            stage = cls(subscriptionKey=key, url=f"{_echo_url()}/cog",
+                        inputCol="in", outputCol="out")
             return [TestObject(
-                cls(subscriptionKey="00000000000000000000000000000000",
-                    url="http://127.0.0.1:9/cog"),
-                serialization_only=True)]
+                stage, transform_data=DataTable({"in": _obj_col(payload)}))]
+
         return provider
 
     for name, cls in cog_classes:

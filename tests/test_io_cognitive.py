@@ -18,46 +18,26 @@ from mmlspark_tpu.core.schema import DataTable
 
 @pytest.fixture(scope="module")
 def echo_server():
-    """POST /echo returns {"echo": <payload>}; /fail returns 500;
-    GET /q echoes the query string."""
+    """POST /echo returns {"echo": <payload>, "headers": ...}; /fail
+    returns 500; /sentiment fakes the text-analytics shape; GET echoes
+    the path+query.  Built on the shared conftest echo factory."""
+    from conftest import start_echo_server
 
-    class Handler(BaseHTTPRequestHandler):
-        def log_message(self, *a):
-            pass
+    def hook(path, payload, headers):
+        if path.startswith("/fail"):
+            return 500, {"error": "boom"}
+        if path.startswith("/sentiment"):
+            docs = payload["documents"]
+            return 200, {"documents": [
+                {"id": d["id"], "sentiment": "positive"
+                 if "good" in d["text"] else "negative"}
+                for d in docs],
+                "key": headers.get("Ocp-Apim-Subscription-Key")}
+        return None
 
-        def _send(self, code, obj):
-            body = json.dumps(obj).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def do_POST(self):
-            n = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(n)) if n else None
-            if self.path.startswith("/fail"):
-                self._send(500, {"error": "boom"})
-            elif self.path.startswith("/sentiment"):
-                docs = payload["documents"]
-                self._send(200, {"documents": [
-                    {"id": d["id"], "sentiment": "positive"
-                     if "good" in d["text"] else "negative"}
-                    for d in docs], "key": self.headers.get(
-                        "Ocp-Apim-Subscription-Key")})
-            else:
-                self._send(200, {"echo": payload,
-                                 "headers": dict(self.headers)})
-
-        def do_GET(self):
-            self._send(200, {"path": self.path})
-
-    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-    t = threading.Thread(target=server.serve_forever, daemon=True)
-    t.start()
-    yield f"http://127.0.0.1:{server.server_address[1]}"
-    server.shutdown()
-    server.server_close()
+    url, shutdown = start_echo_server(post_hook=hook, include_headers=True)
+    yield url
+    shutdown()
 
 
 def test_http_transformer(echo_server):
