@@ -114,6 +114,10 @@ class DataTable:
     def head(self, n: int = 5) -> "DataTable":
         return self.take(np.arange(min(n, self._n)))
 
+    def slice(self, start: int, stop: int) -> "DataTable":
+        """Contiguous row range [start, stop) as a new table (views)."""
+        return DataTable({k: v[start:stop] for k, v in self._cols.items()})
+
     def concat(self, other: "DataTable") -> "DataTable":
         if set(self.columns) != set(other.columns):
             raise ValueError("Cannot concat tables with differing columns")

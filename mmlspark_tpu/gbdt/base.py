@@ -145,6 +145,12 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
     maxCatToOnehot = Param(
         "maxCatToOnehot", "Cardinality at or below which one-vs-rest "
         "splits are used", default=4, typeConverter=TypeConverters.toInt)
+    faultTolerantRetries = Param(
+        "faultTolerantRetries",
+        "Chunk-level training failure recovery: snapshot boosting state "
+        "at chunk boundaries and replay a failed chunk up to this many "
+        "times (0 disables; SURVEY.md section 5.3 analog of executor "
+        "gang-restart)", default=0, typeConverter=TypeConverters.toInt)
     topK = Param("topK",
                  "voting parallelism (PV-Tree): features each worker "
                  "votes per split (reference LightGBMParams.topK)",
@@ -189,6 +195,7 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
             verbosity=self.getVerbosity(),
             parallelism=self.getParallelism(),
             top_k=self.getTopK(),
+            fault_tolerant_retries=self.getFaultTolerantRetries(),
             cat_smooth=self.getCatSmooth(),
             cat_l2=self.getCatL2(),
             max_cat_threshold=self.getMaxCatThreshold(),

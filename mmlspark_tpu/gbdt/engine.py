@@ -71,6 +71,12 @@ class TrainParams:
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
+    #: chunk-level failure recovery (SURVEY.md §5.3): > 0 snapshots the
+    #: boosting state to host RAM at every chunk boundary and, when a
+    #: chunk's device execution fails (preempted/lost chip, tunnel drop),
+    #: re-uploads the inputs and replays THAT chunk up to this many times
+    #: — the TPU-shaped analog of the reference's executor gang-restart.
+    fault_tolerant_retries: int = 0
     #: raw passthrough params recorded into the model file (parity with the
     #: reference's passThroughArgs; engine-known keys override these)
     pass_through: Dict[str, str] = field(default_factory=dict)
@@ -535,6 +541,17 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         # bag_masks are (chunk, n): bound the chunk so per-fit device
         # memory stays O(n), not O(T*n)
         chunk = min(chunk, 64)
+    if params.fault_tolerant_retries > 0:
+        # bounded chunks = bounded replay work after a device failure;
+        # host copies of the training inputs make full re-upload possible
+        # when a failure kills every device buffer
+        chunk = min(chunk, 32)
+        ft_host = {
+            "bins": np.asarray(bins),
+            "labels": np.asarray(labels),
+            "w": np.asarray(w),
+            "val_bins": np.asarray(val_bins_d),
+        }
 
     trees_chunks: List[TreeArrays] = []
     stop_iter = T
@@ -668,22 +685,66 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             else:
                 fi_stack = jnp.asarray(np.broadcast_to(
                     fi_base, (C,) + fi_base.shape))
-            if use_goss:
-                trees_st, scores, val_scores, val_hist = _boost_scan_goss(
-                    bins_d, scores, labels_d, weights_d,
-                    goss_keys[it:it + C], fi_stack, val_bins_d, val_scores,
-                    objective, cfg, params.learning_rate, k1, k2, goss_amp,
-                    has_val)
-            elif K > 1:
-                trees_st, scores, val_scores, val_hist = _boost_scan_multi(
-                    bins_d, scores, labels_d, weights_d, bag_masks,
-                    fi_stack, val_bins_d, val_scores, objective, cfg,
-                    params.learning_rate, K, has_val)
-            else:
-                trees_st, scores, val_scores, val_hist = _boost_scan(
+            def run_chunk(scores, val_scores):
+                if use_goss:
+                    return _boost_scan_goss(
+                        bins_d, scores, labels_d, weights_d,
+                        goss_keys[it:it + C], fi_stack, val_bins_d,
+                        val_scores, objective, cfg, params.learning_rate,
+                        k1, k2, goss_amp, has_val)
+                if K > 1:
+                    return _boost_scan_multi(
+                        bins_d, scores, labels_d, weights_d, bag_masks,
+                        fi_stack, val_bins_d, val_scores, objective, cfg,
+                        params.learning_rate, K, has_val)
+                return _boost_scan(
                     bins_d, scores, labels_d, weights_d, bag_masks,
                     fi_stack, val_bins_d, val_scores, objective, cfg,
                     params.learning_rate, has_val, use_rf)
+
+            ftr = params.fault_tolerant_retries
+            if ftr > 0:
+                # chunk-boundary snapshots + replay (SURVEY.md §5.3): a
+                # device/tunnel failure may take EVERY device buffer with
+                # it, so a replay re-uploads all chunk inputs from host
+                # copies (ft_host snapshot taken before the loop, plus
+                # this chunk's already-drawn masks) — the replayed chunk
+                # is bit-identical to the one that failed.
+                snap = (np.asarray(scores), np.asarray(val_scores))
+                bagm_host = np.asarray(bag_masks)
+                fi_host = np.asarray(fi_stack)
+                for attempt in range(ftr + 1):
+                    try:
+                        trees_st, scores, val_scores, val_hist = run_chunk(
+                            jnp.asarray(snap[0]), jnp.asarray(snap[1]))
+                        # materialize: a failure discovered later must not
+                        # invalidate this chunk's results
+                        jax.block_until_ready(trees_st)
+                        break
+                    except Exception:  # noqa: BLE001 - device loss etc.
+                        if attempt >= ftr:
+                            raise
+                        log.warning(
+                            "chunk at iteration %d failed (attempt %d/%d);"
+                            " re-uploading state and replaying",
+                            it, attempt + 1, ftr)
+                        bins_d = jnp.asarray(ft_host["bins"],
+                                             mapper.bin_dtype)
+                        labels_d = jnp.asarray(
+                            ft_host["labels"],
+                            jnp.int32 if K > 1 else jnp.float32)
+                        weights_d = jnp.asarray(ft_host["w"], jnp.float32)
+                        val_bins_d = jnp.asarray(ft_host["val_bins"],
+                                                 mapper.bin_dtype)
+                        bag_masks = jnp.asarray(bagm_host)
+                        fi_stack = jnp.asarray(fi_host)
+                        if use_goss:
+                            goss_keys = jax.random.split(
+                                jax.random.PRNGKey(params.bagging_seed),
+                                params.num_iterations)
+            else:
+                trees_st, scores, val_scores, val_hist = run_chunk(
+                    scores, val_scores)
             trees_chunks.append(trees_st)
             stop = False
             if has_val:

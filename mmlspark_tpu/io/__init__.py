@@ -7,6 +7,7 @@ file datasource, PowerBI writer.
 
 from .http import (
     HTTPTransformer,
+    PartitionConsolidator,
     JSONInputParser,
     JSONOutputParser,
     SimpleHTTPTransformer,
@@ -16,7 +17,8 @@ from .binary import BinaryFileReader, read_binary_files
 from .powerbi import PowerBIWriter
 
 __all__ = [
-    "HTTPTransformer", "SimpleHTTPTransformer",
+    "HTTPTransformer", "PartitionConsolidator",
+    "SimpleHTTPTransformer",
     "JSONInputParser", "JSONOutputParser",
     "HTTPServer", "request_table", "reply_from_table",
     "BinaryFileReader", "read_binary_files",

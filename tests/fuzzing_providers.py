@@ -833,3 +833,11 @@ def _register_cognitive():
 
 
 _register_cognitive()
+
+
+@fuzzing_objects("PartitionConsolidator")
+def _partition_consolidator():
+    from mmlspark_tpu.io import PartitionConsolidator
+    t = DataTable({"x": np.arange(5.0)})
+    return [TestObject(PartitionConsolidator(targetBatchSize=8),
+                       transform_data=t)]

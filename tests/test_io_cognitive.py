@@ -8,7 +8,7 @@ import json
 import threading
 import time
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
 
 import numpy as np
 import pytest
@@ -233,3 +233,20 @@ def test_all_cognitive_stages_constructible():
         assert stage.hasParam("subscriptionKey"), name
         count += 1
     assert count >= 20
+
+
+def test_partition_consolidator_rechunks():
+    from mmlspark_tpu.io import PartitionConsolidator
+    pc = PartitionConsolidator(targetBatchSize=4)
+    # transform on one table is the identity (one table == one partition)
+    t = DataTable({"x": np.arange(3.0)})
+    assert pc.transform(t) is t
+    # streaming surface: ragged micro-batches -> dense fixed-size batches
+    parts = [DataTable({"x": np.arange(k, dtype=np.float64)})
+             for k in (1, 2, 3, 1, 5, 2)]
+    out = list(pc.consolidate(parts))
+    assert [len(b) for b in out] == [4, 4, 4, 2]
+    merged = np.concatenate([np.asarray(b["x"]) for b in out])
+    want = np.concatenate([np.arange(k, dtype=np.float64)
+                           for k in (1, 2, 3, 1, 5, 2)])
+    np.testing.assert_array_equal(merged, want)
