@@ -338,6 +338,10 @@ class LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
 
     __abstractstage__ = True
 
+    featuresShapCol = Param("featuresShapCol",
+                            "Output column for SHAP values (empty disables)",
+                            default="", typeConverter=TypeConverters.toString)
+
     def __init__(self, booster: Optional[Booster] = None, **kwargs):
         super().__init__(**kwargs)
         self._booster = booster
@@ -377,6 +381,18 @@ class LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
                                   ) -> "LightGBMModelBase":
         """Reference-parity alias: parse a LightGBM model text blob."""
         return cls(booster=Booster.load_native_model_string(model_str))
+
+    def _with_shap(self, table, X):
+        """Append the featuresShapCol column (TreeSHAP contributions) when
+        the param is set — reference featuresShapCol semantics."""
+        col = self.getFeaturesShapCol()
+        if not col:
+            return table
+        contribs = self._booster.predict_contrib(X)
+        arr = np.empty(len(contribs), dtype=object)
+        for i, row in enumerate(contribs):
+            arr[i] = row
+        return table.withColumn(col, arr)
 
     def getFeatureImportances(self, importance_type: str = "split"):
         return list(self._booster.feature_importances(importance_type))

@@ -256,6 +256,13 @@ class Booster:
             return jnp.exp(m)
         return m
 
+    def predict_contrib(self, X) -> np.ndarray:
+        """Per-row TreeSHAP contributions, LightGBM pred_contrib layout:
+        (n, num_class * (num_features + 1)) with the expected value in
+        each class's trailing slot (see mmlspark_tpu/gbdt/shap.py)."""
+        from .shap import predict_contrib
+        return predict_contrib(self, X)
+
     def predict_leaf_index(self, X):
         X = jnp.asarray(X, jnp.float32)
         s = self._stack()

@@ -111,7 +111,7 @@ class LightGBMClassificationModel(LightGBMModelBase, _ClassifierParams):
             pred = np.argmax(prob / np.asarray(thresholds)[None, :], axis=1)
         else:
             pred = np.argmax(prob, axis=1)
-        out = table
+        out = self._with_shap(table, X)
         raw_col = self.getRawPredictionCol()
         prob_col = self.getProbabilityCol()
         if raw_col:

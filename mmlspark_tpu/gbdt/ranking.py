@@ -301,8 +301,9 @@ class LightGBMRankerModel(LightGBMModelBase):
     def _transform(self, table: DataTable) -> DataTable:
         X = features_matrix(table, self.getFeaturesCol())
         pred = np.asarray(self._booster.predict_margin(X))
-        return table.withColumn(self.getPredictionCol(),
-                                pred.astype(np.float64))
+        out = self._with_shap(table, X)
+        return out.withColumn(self.getPredictionCol(),
+                              pred.astype(np.float64))
 
 
 def ndcg_at_k(scores: np.ndarray, labels: np.ndarray, query_ids: np.ndarray,

@@ -51,5 +51,6 @@ class LightGBMRegressionModel(LightGBMModelBase):
     def _transform(self, table: DataTable) -> DataTable:
         X = features_matrix(table, self.getFeaturesCol())
         pred = np.asarray(self._booster.predict(X))
-        return table.withColumn(self.getPredictionCol(),
-                                pred.astype(np.float64))
+        out = self._with_shap(table, X)
+        return out.withColumn(self.getPredictionCol(),
+                              pred.astype(np.float64))

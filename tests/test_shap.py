@@ -1,0 +1,88 @@
+"""TreeSHAP contributions (featuresShapCol; reference pred_contrib)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.gbdt import (LightGBMClassifier, LightGBMRegressor)
+
+
+@pytest.fixture(scope="module")
+def table(rng):
+    X = rng.normal(size=(1500, 6)).astype(np.float32)
+    y = ((X[:, 0] + 0.8 * X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+    return {"features": X, "label": y}
+
+
+class TestTreeSHAP:
+    def test_local_accuracy_binary(self, table):
+        """sum(contribs) + expected == margin, row for row — the SHAP
+        local-accuracy axiom, the strongest self-check of the algorithm."""
+        m = LightGBMClassifier(numIterations=10, numLeaves=15,
+                               parallelism="serial", verbosity=0).fit(table)
+        X = np.asarray(table["features"])[:64]
+        contribs = m.getModel().predict_contrib(X)
+        assert contribs.shape == (64, 7)
+        margins = np.asarray(m.getModel().predict_margin(X)).ravel()
+        np.testing.assert_allclose(contribs.sum(axis=1), margins,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_unused_feature_gets_zero(self, rng):
+        """A constant feature can never be split on; its SHAP value must
+        be exactly zero (the dummy axiom)."""
+        X = rng.normal(size=(1200, 4)).astype(np.float32)
+        X[:, 3] = 1.0
+        y = X[:, 0] * 2 + 0.1 * rng.normal(size=1200)
+        m = LightGBMRegressor(numIterations=8, numLeaves=7,
+                              parallelism="serial", verbosity=0).fit(
+            {"features": X, "label": y})
+        contribs = m.getModel().predict_contrib(X[:32])
+        assert np.abs(contribs[:, 3]).max() == 0.0
+        # the informative feature dominates
+        assert np.abs(contribs[:, 0]).mean() > np.abs(contribs[:, 1]).mean()
+
+    def test_features_shap_col(self, table):
+        m = LightGBMClassifier(numIterations=5, numLeaves=7,
+                               featuresShapCol="shap",
+                               parallelism="serial", verbosity=0).fit(table)
+        out = m.transform(table)
+        assert "shap" in out
+        row = out["shap"][0]
+        assert row.shape == (7,)        # f + expected-value slot
+        margins = np.asarray(m.getModel().predict_margin(
+            np.asarray(table["features"])[:1])).ravel()
+        np.testing.assert_allclose(row.sum(), margins[0], rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_multiclass_layout(self, rng):
+        from sklearn.datasets import make_classification
+        X, y = make_classification(n_samples=900, n_features=5,
+                                   n_informative=4, n_redundant=0,
+                                   n_classes=3, random_state=4)
+        t = {"features": X, "label": y.astype(float)}
+        m = LightGBMClassifier(numIterations=4, numLeaves=7,
+                               parallelism="serial", verbosity=0).fit(t)
+        contribs = m.getModel().predict_contrib(np.asarray(X)[:16])
+        assert contribs.shape == (16, 3 * 6)
+        margins = np.asarray(m.getModel().predict_margin(
+            np.asarray(X)[:16]))
+        per_class = contribs.reshape(16, 3, 6).sum(axis=2)
+        np.testing.assert_allclose(per_class, margins, rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestShapPredictorParity:
+    def test_nan_rows_keep_local_accuracy(self, rng):
+        """NaN inputs must walk the SAME path as the predictor (numeric
+        NaN routes right), so local accuracy holds on dirty data too."""
+        X = rng.normal(size=(1500, 4)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+        m = LightGBMClassifier(numIterations=8, numLeaves=15,
+                               parallelism="serial", verbosity=0).fit(
+            {"features": X, "label": y})
+        Xq = X[:32].copy()
+        Xq[::3, 0] = np.nan
+        Xq[1::4, 2] = np.nan
+        contribs = m.getModel().predict_contrib(Xq)
+        margins = np.asarray(m.getModel().predict_margin(Xq)).ravel()
+        np.testing.assert_allclose(contribs.sum(axis=1), margins,
+                                   rtol=1e-5, atol=1e-5)
