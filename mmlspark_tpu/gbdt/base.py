@@ -302,8 +302,10 @@ class LightGBMBase(Estimator, LightGBMParams):
         # Distributed by default when a mesh is available, like the
         # reference trains across all executors (SURVEY.md §3.1); the
         # parallelism param picks the axis layout.
+        # goss stays serial unless a mesh is pinned explicitly (per-shard
+        # sampling is a semantic choice); dart is host-loop only
         if mesh is None and grad_override is None and ranking_info is None \
-                and self.getBoostingType() not in ("goss", "dart", "rf"):
+                and self.getBoostingType() not in ("goss", "dart"):
             import jax
             if jax.device_count() > 1:
                 from .distributed import resolve_mesh

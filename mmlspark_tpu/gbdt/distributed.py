@@ -73,12 +73,80 @@ def _sharded_cfg(mesh: Mesh, cfg: GrowerConfig) -> GrowerConfig:
     })
 
 
+def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
+                   k1: int, k2: int, amp: float, has_val: bool = False):
+    """Mesh GOSS: every data shard samples its own top-|g·h| rows plus an
+    amplified random remainder (per-machine sampling, exactly like
+    distributed LightGBM's boosting=goss), then the sampled sub-shards
+    train one tree data-parallel with psum histograms.  ``k1``/``k2`` are
+    PER-SHARD row counts; the per-iteration PRNG key is folded with the
+    shard index so shards draw independent remainders."""
+    cfg = _sharded_cfg(mesh, cfg)
+
+    def steps(bins, scores, labels, weights, real, keys, fis,
+              val_bins, val_scores):
+        def body(carry, xs):
+            scores, val_scores = carry
+            key, fi = xs
+            if cfg.axis_name is not None:
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(cfg.axis_name))
+            g, h = obj.grad_hess(scores, labels, weights)
+            g = g * real
+            h = h * real
+            n_local = g.shape[0]
+            rank = jnp.argsort(-jnp.abs(g * h))      # pads (0) sort last
+            top_idx = rank[:k1]
+            rest = rank[k1:]
+            rk = jax.random.uniform(key, (n_local - k1,))
+            other_idx = jnp.take(rest, jnp.argsort(rk)[:k2])
+            idx = jnp.concatenate([top_idx, other_idx])
+            amp_vec = jnp.concatenate([
+                jnp.ones(k1, jnp.float32), jnp.full(k2, amp, jnp.float32)])
+            valid = jnp.take(real, idx)
+            bins_g = jnp.take(bins, idx, axis=0)
+            gh = jnp.stack([jnp.take(g, idx) * amp_vec,
+                            jnp.take(h, idx) * amp_vec,
+                            valid], axis=1)
+            tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
+            scores = scores + lr * predict_tree_binned(tree, bins,
+                                                       cfg.num_leaves)
+            tree = apply_shrinkage(tree, lr)
+            if has_val:
+                val_scores = val_scores + predict_tree_binned(
+                    tree, val_bins, cfg.num_leaves)
+                out_v = val_scores
+            else:
+                out_v = jnp.zeros((0,), jnp.float32)
+            return (scores, val_scores), (tree, out_v)
+
+        (scores, val_scores), (trees, val_hist) = jax.lax.scan(
+            body, (scores, val_scores), (keys, fis))
+        return trees, scores, val_scores, val_hist
+
+    val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
+    mapped = jax.shard_map(
+        steps, mesh=mesh,
+        in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(DATA_AXIS), P(None, None),
+                  P(None, FEATURE_AXIS, None),
+                  P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), val_hist_spec),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(1, 8))
+
+
 def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
-                    bag_sharded: bool, has_val: bool = False):
+                    bag_sharded: bool, has_val: bool = False,
+                    rf: bool = False):
     """Chunked distributed boosting: a ``lax.scan`` over iterations INSIDE
     the shard_map, so a whole chunk of trees trains in one launch with all
     histogram psums compiler-scheduled onto ICI (the reference's per-
     iteration socket allreduce, amortized to one program).
+
+    ``rf``: random-forest mode — every tree fits the gradient at the
+    CONSTANT init scores, unshrunk (averaging happens at export), with
+    the per-iteration bagging masks providing the forest's resampling.
 
     ``real``: (n,) row-validity mask sharded over ``data`` (zeros on pad
     rows), folded into every iteration's mask.  ``bags``: (C, n) bagging
@@ -107,8 +175,9 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
             g, h = obj.grad_hess(scores, labels, weights)
             gh = jnp.stack([g * bag, h * bag, bag], axis=1)
             tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
-            scores = scores + lr * tree.leaf_value[row_leaf]
-            tree = apply_shrinkage(tree, lr)
+            if not rf:
+                scores = scores + lr * tree.leaf_value[row_leaf]
+                tree = apply_shrinkage(tree, lr)
             if has_val:
                 val_scores = val_scores + predict_tree_binned(
                     tree, val_bins, cfg.num_leaves)

@@ -473,11 +473,11 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             raise NotImplementedError(
                 "custom gradient overrides are not supported with a "
                 "mesh (only lambdarank, which provides ranking_info)")
-        if use_goss or use_dart or use_rf:
+        if use_dart:
             raise NotImplementedError(
-                f"boostingType={params.boosting!r} with an explicit mesh "
-                "is not yet supported; drop setMesh(...) or use "
-                "boostingType='gbdt'")
+                "boostingType='dart' with an explicit mesh is not yet "
+                "supported (per-tree dropout bookkeeping is host-side); "
+                "drop setMesh(...) or use boostingType='gbdt'")
         if callbacks:
             raise NotImplementedError(
                 "callbacks are not yet supported with an explicit mesh; "
@@ -750,11 +750,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             if has_val:
                 vh = np.asarray(val_hist)        # (C, n_val[, K])
                 for j in range(C):
-                    # rf: trees are unshrunk raw fits; the ensemble margin
-                    # at iteration j is init + running AVERAGE of the tree
-                    # outputs (val_scores start at init, which must not be
-                    # divided down)
-                    margins = (init + (vh[j] - init) / (it + j + 1)
+                    margins = (_rf_margins(init, vh[j], it + j)
                                if use_rf else vh[j])
                     metric = float(val_metric(margins, val_labels_np,
                                               val_weights))
@@ -791,13 +787,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             t.leaf_value = t.leaf_value * s
             t.internal_value = t.internal_value * s
             t.shrinkage = s
-    elif use_rf and trees:
-        # random forest: the model output is the AVERAGE of the raw trees
-        avg = 1.0 / (len(trees) // K)
-        for t in trees:
-            t.leaf_value = t.leaf_value * avg
-            t.internal_value = t.internal_value * avg
-            t.shrinkage = avg
+    elif use_rf:
+        _rf_average_trees(trees, K)
     return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)
 
@@ -1028,6 +1019,25 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
                              feature_names, f, stop_iter)
 
 
+def _rf_margins(init, vh_row, tree_idx: int):
+    """rf ensemble margins at iteration ``tree_idx``: trees are unshrunk
+    raw fits, so the margin is init + running AVERAGE of the tree outputs
+    (val_scores start at init, which must not be divided down)."""
+    return init + (vh_row - init) / (tree_idx + 1)
+
+
+def _rf_average_trees(trees, K: int) -> None:
+    """Bake the 1/T random-forest averaging weight into the exported
+    trees (the model output is the average of the raw trees)."""
+    if not trees:
+        return
+    avg = 1.0 / (len(trees) // K)
+    for t in trees:
+        t.leaf_value = t.leaf_value * avg
+        t.internal_value = t.internal_value * avg
+        t.shrinkage = avg
+
+
 def _feat_info_from_mapper(mapper: BinMapper, f: int) -> np.ndarray:
     """(f, 3) [mask, is_cat, n_value_bins] from the fitted BinMapper."""
     fi = np.zeros((f, 3), np.float32)
@@ -1073,11 +1083,11 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     ``lax.scan`` launch (no per-iteration host round-trips); with a
     validation set the loop chunks and the host replays per-iteration
     metrics for early stopping, exactly like the serial path."""
-    from .distributed import (make_boost_scan, make_multiclass_scan,
-                              prepare_arrays)
+    from .distributed import (make_boost_scan, make_goss_scan,
+                              make_multiclass_scan, prepare_arrays)
 
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from ..core.mesh import DATA_AXIS, pad_to_multiple
+    from ..core.mesh import DATA_AXIS, FEATURE_AXIS, pad_to_multiple
 
     n, f = bins.shape
     K = objective.num_model_per_iteration
@@ -1085,14 +1095,45 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     esr = params.early_stopping_round
     use_bag = params.bagging_freq > 0 and params.bagging_fraction < 1.0
     use_ff = params.feature_fraction < 1.0
+    use_goss_m = params.boosting == "goss"
+    use_rf_m = params.boosting == "rf"
     has_val = val_bins is not None and val_metric is not None
-    if K > 1:
+    if use_goss_m:
+        if int(mesh.shape[FEATURE_AXIS]) > 1:
+            raise NotImplementedError(
+                "boostingType='goss' requires a data-only mesh (the "
+                "sampled-tree score update reads whole feature rows); "
+                "use parallelism='data' / feature=1")
+        dn_pre = int(mesh.shape[DATA_AXIS])
+        s_local = pad_to_multiple(n, dn_pre) // dn_pre  # rows per shard
+        k1 = max(1, int(np.ceil(s_local * params.top_rate)))
+        k2 = max(1, int(np.ceil(s_local * params.other_rate)))
+        if k1 + k2 >= s_local:
+            use_goss_m = False   # tiny shards: nothing to shrink
+            if params.verbosity > 0:
+                log.info("GOSS sample covers every local row; mesh "
+                         "training falls back to plain gbdt")
+        else:
+            goss_amp_m = (1.0 - params.top_rate) / params.other_rate
+            goss_keys_m = jax.random.split(
+                jax.random.PRNGKey(params.bagging_seed),
+                params.num_iterations)
+    if use_goss_m and K == 1:
+        step = make_goss_scan(
+            mesh, objective, cfg, params.learning_rate, k1, k2,
+            goss_amp_m, has_val)
+    elif K > 1:
+        if use_goss_m or use_rf_m:
+            raise NotImplementedError(
+                f"boostingType={params.boosting!r} with a mesh currently "
+                "supports single-model objectives")
         step = make_multiclass_scan(
             mesh, objective, cfg, params.learning_rate, K, use_bag,
             has_val)
     else:
         step = make_boost_scan(
-            mesh, objective, cfg, params.learning_rate, use_bag, has_val)
+            mesh, objective, cfg, params.learning_rate, use_bag, has_val,
+            rf=use_rf_m)
     bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
         np.asarray(bins, mapper.bin_dtype), np.asarray(labels),
         np.asarray(w, np.float32), mesh, K, init, init_scores)
@@ -1168,15 +1209,22 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         else:
             fi_stack = jnp.asarray(np.broadcast_to(fi_base,
                                                    (C,) + fi_base.shape))
-        trees_st, scores, val_scores, val_hist = step(
-            bins_d, scores, labels_d, w_d, real, bags, fi_stack,
-            val_bins_d, val_scores)
+        if use_goss_m and K == 1:
+            trees_st, scores, val_scores, val_hist = step(
+                bins_d, scores, labels_d, w_d, real,
+                goss_keys_m[it:it + C], fi_stack, val_bins_d, val_scores)
+        else:
+            trees_st, scores, val_scores, val_hist = step(
+                bins_d, scores, labels_d, w_d, real, bags, fi_stack,
+                val_bins_d, val_scores)
         chunks.append(trees_st)
         stop = False
         if has_val:
             vh = np.asarray(val_hist)[:, :nv]    # drop val pad rows
             for j in range(C):
-                metric = float(val_metric(vh[j], val_labels_np,
+                margins = (_rf_margins(init, vh[j], it + j)
+                           if use_rf_m else vh[j])
+                metric = float(val_metric(margins, val_labels_np,
                                           val_weights))
                 gi = it + j
                 if metric < best_metric - 1e-12:
@@ -1197,5 +1245,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     trees, nls = trees[:stop_iter * K], nls[:stop_iter * K]
     trees, stop_iter = _truncate_no_growth(trees, nls, K, stop_iter,
                                            params.verbosity)
+    if use_rf_m:
+        _rf_average_trees(trees, K)
     return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)

@@ -335,3 +335,51 @@ class TestVotingParallel:
         shapes = {(int(a), int(b)) for a, b in reduced}
         assert (2 * k, B) in shapes, shapes
         assert (f, B) not in shapes, "full-histogram all-reduce present"
+
+
+class TestDistributedBoostingModes:
+    """GOSS and rf under a mesh (round-2 gap: engine raised for both)."""
+
+    @pytest.fixture(scope="class")
+    def mode_table(self):
+        from sklearn.datasets import make_classification
+        X, y = make_classification(n_samples=1600, n_features=10,
+                                   n_informative=6, random_state=21)
+        return {"features": X, "label": y.astype(float)}
+
+    def test_mesh_goss_learns(self, mode_table):
+        from sklearn.metrics import roc_auc_score
+        m = LightGBMClassifier(boostingType="goss", numIterations=20,
+                               numLeaves=15, minDataInLeaf=5,
+                               verbosity=0).setMesh(
+            build_mesh(data=8, feature=1)).fit(mode_table)
+        out = m.transform(mode_table)
+        auc = roc_auc_score(mode_table["label"],
+                            np.asarray(out["probability"])[:, 1])
+        assert auc > 0.9
+
+    def test_mesh_goss_deterministic(self, mode_table):
+        kw = dict(boostingType="goss", numIterations=6, numLeaves=7,
+                  minDataInLeaf=5, verbosity=0)
+        a = LightGBMClassifier(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(mode_table)
+        b = LightGBMClassifier(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(mode_table)
+        assert (a.getModel().save_native_model_string()
+                == b.getModel().save_native_model_string())
+
+    def test_mesh_rf_matches_serial_rf(self, mode_table):
+        kw = dict(boostingType="rf", numIterations=6, numLeaves=15,
+                  minDataInLeaf=5, baggingFraction=0.6, baggingFreq=1,
+                  verbosity=0)
+        serial = LightGBMClassifier(**kw).setMesh(_serial_mesh()).fit(
+            mode_table)
+        dist = LightGBMClassifier(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(mode_table)
+        st, dt = serial.getModel().trees, dist.getModel().trees
+        assert len(st) == len(dt)
+        assert all(abs(t.shrinkage - 1 / 6) < 1e-12 for t in dt)
+        for a, b in zip(st, dt):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=2e-3, atol=1e-5)
