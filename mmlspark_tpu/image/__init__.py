@@ -1,5 +1,7 @@
-from .transformer import ImageTransformer, UnrollImage, ImageSetAugmenter
+from .transformer import (ImageTransformer, UnrollBinaryImage, UnrollImage,
+                          ImageSetAugmenter)
 from .featurizer import ImageFeaturizer
 
-__all__ = ["ImageTransformer", "UnrollImage", "ImageSetAugmenter",
+__all__ = ["ImageTransformer", "UnrollBinaryImage", "UnrollImage",
+           "ImageSetAugmenter",
            "ImageFeaturizer"]

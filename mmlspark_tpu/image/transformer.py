@@ -191,6 +191,47 @@ class UnrollImage(Transformer, HasInputCol, HasOutputCol):
         return table.withColumn(self.getOutputCol(), flat)
 
 
+class UnrollBinaryImage(Transformer, HasInputCol, HasOutputCol):
+    """Encoded image BYTES column (PNG/JPEG/BMP) → flat numeric vector
+    column (reference image/UnrollImage.scala UnrollBinaryImage, expected
+    path, UNVERIFIED): decode + optional resize + unroll in one stage, for
+    tables straight out of the binary datasource."""
+
+    width = Param("width", "Resize width before unrolling (0 keeps size)",
+                  default=0, typeConverter=TypeConverters.toInt)
+    height = Param("height", "Resize height before unrolling (0 keeps size)",
+                   default=0, typeConverter=TypeConverters.toInt)
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("inputCol", "bytes")
+        kwargs.setdefault("outputCol", "unrolled")
+        super().__init__(**kwargs)
+
+    def _transform(self, table: DataTable) -> DataTable:
+        import io as _io
+
+        from PIL import Image
+
+        w, h = self.getWidth(), self.getHeight()
+        if (w > 0) != (h > 0):
+            raise ValueError(
+                "UnrollBinaryImage: set BOTH width and height to resize "
+                f"(got width={w}, height={h})")
+        rows = []
+        for blob in table[self.getInputCol()]:
+            img = Image.open(_io.BytesIO(bytes(blob))).convert("RGB")
+            if w > 0 and h > 0:
+                img = img.resize((w, h))
+            rows.append(np.asarray(img, np.float64).reshape(-1))
+        widths = {len(r) for r in rows}
+        if len(widths) > 1:
+            raise ValueError(
+                "UnrollBinaryImage requires uniformly-sized images; set "
+                "width/height to resize while decoding")
+        flat = np.stack(rows) if rows else np.zeros((0, 0))
+        return table.withColumn(self.getOutputCol(), flat)
+
+
 class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
     """Dataset augmentation by flips: emits 2x (or 4x) rows per input
     (reference image/ImageSetAugmenter.scala, expected path, UNVERIFIED)."""

@@ -11,6 +11,7 @@ from .stages import (
     EnsembleByKey,
     Explode,
     FixedMiniBatchTransformer,
+    MiniBatchTransformer,
     FlattenBatch,
     Lambda,
     MultiColumnAdapter,
@@ -28,7 +29,8 @@ from .stages import (
 
 __all__ = [
     "Cacher", "DropColumns", "EnsembleByKey", "Explode",
-    "FixedMiniBatchTransformer", "FlattenBatch", "Lambda",
+    "FixedMiniBatchTransformer", "MiniBatchTransformer",
+    "FlattenBatch", "Lambda",
     "MultiColumnAdapter", "MultiColumnAdapterModel", "RenameColumn",
     "Repartition", "SelectColumns",
     "StratifiedRepartition", "SummarizeData", "TextPreprocessor", "Timer",

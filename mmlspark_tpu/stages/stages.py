@@ -507,6 +507,11 @@ class FixedMiniBatchTransformer(Transformer):
         return DataTable(cols)
 
 
+class MiniBatchTransformer(FixedMiniBatchTransformer):
+    """Reference-name alias: stages/MiniBatchTransformer.scala's default
+    batcher is the fixed-size one."""
+
+
 class FlattenBatch(Transformer):
     """Inverse of the mini-batchers (stages/FlattenBatch.scala)."""
 

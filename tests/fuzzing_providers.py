@@ -791,14 +791,17 @@ _COG_PAYLOADS = {
     "anomaly": [{"timestamp": "2024-01-01T00:00:00Z", "value": 1.0},
                 {"timestamp": "2024-01-02T00:00:00Z", "value": 1.1}],
     "search": {"id": "1", "text": "hello"},
-    "speech": None,          # posts raw audio bytes; not JSON-roundtrippable
+    # SpeechToText overrides _prepare to post raw audio bytes; the echo
+    # service answers binary bodies deterministically ("<binary>")
+    "speech": b"RIFF\x00\x00\x00\x00WAVEfmt fuzz-audio",
 }
 
 
 def _register_cognitive():
     """Every cognitive transformer executes end-to-end against the local
-    echo service; families whose payloads cannot be JSON (speech audio)
-    stay persistence-only."""
+    echo service (speech posts raw bytes, which the echo answers
+    deterministically); a module missing from _COG_PAYLOADS fails loudly
+    at provider time."""
     import importlib
     import pkgutil
 
@@ -817,10 +820,6 @@ def _register_cognitive():
 
         def provider():
             key = "00000000000000000000000000000000"
-            if payload is None:
-                return [TestObject(
-                    cls(subscriptionKey=key, url="http://127.0.0.1:9/cog"),
-                    serialization_only=True)]
             stage = cls(subscriptionKey=key, url=f"{_echo_url()}/cog",
                         inputCol="in", outputCol="out")
             return [TestObject(
@@ -840,4 +839,31 @@ def _partition_consolidator():
     from mmlspark_tpu.io import PartitionConsolidator
     t = DataTable({"x": np.arange(5.0)})
     return [TestObject(PartitionConsolidator(targetBatchSize=8),
+                       transform_data=t)]
+
+
+@fuzzing_objects("MiniBatchTransformer")
+def _minibatch_alias():
+    from mmlspark_tpu.stages import MiniBatchTransformer
+    t = DataTable({"x": np.arange(10.0)})
+    return [TestObject(MiniBatchTransformer(batchSize=4),
+                       transform_data=t)]
+
+
+@fuzzing_objects("UnrollBinaryImage")
+def _unroll_binary_image():
+    import io as _io
+
+    from PIL import Image
+
+    from mmlspark_tpu.image import UnrollBinaryImage
+    rng = np.random.default_rng(SEED)
+    blobs = np.empty(2, dtype=object)
+    for i in range(2):
+        buf = _io.BytesIO()
+        Image.fromarray(rng.integers(0, 255, size=(9 + i, 7 + i, 3),
+                                     dtype=np.uint8)).save(buf, "PNG")
+        blobs[i] = buf.getvalue()
+    t = DataTable({"bytes": blobs})
+    return [TestObject(UnrollBinaryImage(width=8, height=8),
                        transform_data=t)]
