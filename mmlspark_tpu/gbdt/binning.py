@@ -68,18 +68,104 @@ class BinMapper:
         return np.dtype(np.uint8 if self.num_total_bins <= 256
                         else np.int32)
 
+    def _fast_state(self, is64: bool):
+        """Precomputed arrays for the native ``bin_columns`` kernel.
+
+        For float32 inputs the float64 bounds are adjusted DOWN to the
+        largest float32 ``c <= b``; then for every float32 value ``v``,
+        ``c < v  ⇔  b < v`` (if ``c < v`` then ``v`` is a float32 above
+        the largest float32 ≤ b, hence ``v > b``; conversely ``b < v``
+        implies ``c ≤ b < v``), so uint8 bins from float32 comparisons
+        match the float64 reference bit-exactly.  A uniform ``C``-cell
+        grid per feature provides a starting hint; the kernel probes
+        locally in both directions, so the hint only affects speed, never
+        the result.  Features whose bounds pack > 32 deep into one cell
+        (degenerate hint) use plain binary search instead.
+        """
+        key = "_fs64" if is64 else "_fs32"
+        cached = getattr(self, key, None)
+        if cached is not None:
+            return cached
+        f = self.num_features
+        C = 2048
+        nb = np.asarray([len(ub) for ub in self.upper_bounds], np.int32)
+        m = max(int(nb.max()), 1) if f else 1
+        dt = np.float64 if is64 else np.float32
+        bext = np.full((f, m), np.inf, dt)
+        lo = np.zeros(f, np.float32)
+        scale = np.zeros(f, np.float32)
+        base = np.zeros((f, C), np.int32)
+        use_table = np.zeros(f, np.uint8)
+        for j, ub in enumerate(self.upper_bounds):
+            if len(ub) == 0 or self.is_categorical(j):
+                continue
+            if is64:
+                c = ub
+            else:
+                c = ub.astype(np.float32)
+                over = c.astype(np.float64) > ub
+                c[over] = np.nextafter(c[over], np.float32(-np.inf))
+            bext[j, :len(c)] = c
+            span = float(c[-1]) - float(c[0])
+            if len(c) >= 8 and span > 0 and np.isfinite(span):
+                lo[j] = np.float32(c[0])
+                with np.errstate(over="ignore"):
+                    scale_j = np.float32(C / (span * (1 + 1e-6)))
+                if not np.isfinite(scale_j):   # span below ~f32 tiny
+                    continue
+                scale[j] = scale_j
+                edges = (float(lo[j])
+                         + np.arange(C, dtype=np.float64) / float(scale[j]))
+                b0 = np.searchsorted(c, edges.astype(c.dtype), side="left")
+                top = np.searchsorted(
+                    c, np.nextafter((edges + 1.0 / float(scale[j])
+                                     ).astype(c.dtype), np.inf), side="left")
+                if int((top - b0).max()) <= 32:
+                    base[j] = b0
+                    use_table[j] = 1
+        state = (bext, nb, base, lo, scale, use_table)
+        object.__setattr__(self, key, state)
+        return state
+
     def transform_packed(self, X: np.ndarray) -> np.ndarray:
-        """:meth:`transform` into the narrowest dtype, using torch's batched
-        ``searchsorted`` when available (~25% faster than the per-feature
-        numpy loop on one core).  The uint8 output is what ships over the
-        host↔device link: 4x fewer bytes than int32, which dominates fit
-        startup on a tunneled TPU (~25-100 MB/s link; see BENCH_SWEEP.md).
+        """:meth:`transform` into the narrowest dtype via the native
+        ``fastbin`` kernel (~0.2 s for the 400k×50 bench matrix vs ~3 s
+        for numpy/torch searchsorted on this box's single core — the
+        binning pass, not the TPU, was the round-2 fit bottleneck).  The
+        uint8 output is what ships over the host↔device link: 4x fewer
+        bytes than int32, which dominates fit startup on a tunneled TPU
+        (~25-100 MB/s link; see BENCH_SWEEP.md).
 
         Shipping X and binning on-device loses: the raw f32 matrix is 4x
         the bytes of the binned u8 one, and the link is the bottleneck —
         measured 4-11s for 80 MB vs ~0.5s for the 20 MB binned form.
+
+        Exactness: identical output to :meth:`transform` (float64
+        semantics) for float32 and float64 inputs; pinned by
+        tests/test_gbdt.py's packed-parity test.
         """
         dt = self.bin_dtype
+        if dt != np.uint8 or X.dtype not in (np.float32, np.float64):
+            # > 256 total bins (or exotic dtypes): torch's batched
+            # searchsorted still beats the per-column numpy loop
+            return self._transform_torch(X, dt)
+        from .. import native
+        if not native.bin_columns_available():
+            return self._transform_torch(X, dt)
+        is64 = X.dtype == np.float64
+        bext, nb, base, lo, scale, use_table = self._fast_state(is64)
+        Xc = np.ascontiguousarray(X)
+        out = np.empty(X.shape, np.uint8)
+        native.bin_columns(Xc, bext, nb, base, lo, scale, use_table,
+                           self.missing_bin, out)
+        if self.has_categorical:
+            for j in np.nonzero(self.categorical)[0]:
+                out[:, j] = self._transform_cat(X[:, j], int(j))
+        return out
+
+    def _transform_torch(self, X: np.ndarray, dt: np.dtype) -> np.ndarray:
+        """Batched float64 searchsorted via torch — the fallback when the
+        native kernel can't apply (non-uint8 bins, missing toolchain)."""
         if self.has_categorical:
             return self.transform(X).astype(dt)
         try:
@@ -175,6 +261,8 @@ def fit_bin_mapper(X: np.ndarray, max_bin: int = 255,
     if n > sample_cnt:
         rng = np.random.default_rng(seed)
         idx = rng.choice(n, size=sample_cnt, replace=False)
+        # sorted row gather: same sample set, sequential-ish memory access
+        idx.sort()
         sample = X[idx]
     else:
         sample = X
@@ -222,14 +310,25 @@ def _find_categories(col: np.ndarray, max_bin: int, j: int) -> np.ndarray:
 
 def _find_bounds(col: np.ndarray, max_bin: int,
                  min_data_in_bin: int) -> np.ndarray:
+    """One ``np.sort`` per column feeds BOTH the distinct-value census and
+    the quantile cuts (``np.quantile``'s internal partition re-sorted every
+    feature; on this box's single core that was ~40% of fit_bin_mapper).
+    The quantile lerp reproduces ``np.quantile(..., method="linear")``
+    bit-exactly, including its ``t >= 0.5`` rearrangement."""
     if col.size == 0:
         return np.empty(0, dtype=np.float64)
-    distinct, counts = np.unique(col, return_counts=True)
-    if len(distinct) <= 1:
+    s = np.sort(col)
+    change = np.empty(s.size, bool)
+    change[0] = True
+    np.not_equal(s[1:], s[:-1], out=change[1:])
+    starts = np.nonzero(change)[0]
+    if starts.size <= 1:
         return np.empty(0, dtype=np.float64)
-    if len(distinct) <= max_bin:
+    if starts.size <= max_bin:
         # Exact: midpoints between consecutive distinct values, but respect
         # min_data_in_bin by merging tiny bins (LightGBM does the same).
+        distinct = s[starts]
+        counts = np.diff(np.append(starts, s.size))
         mids = (distinct[:-1] + distinct[1:]) / 2.0
         if min_data_in_bin > 1 and col.size >= 2 * min_data_in_bin:
             keep, acc = [], 0
@@ -242,6 +341,15 @@ def _find_bounds(col: np.ndarray, max_bin: int,
         return np.asarray(mids, dtype=np.float64)
     # Quantile spacing over the empirical distribution.
     qs = np.linspace(0, 1, max_bin + 1)[1:-1]
-    cuts = np.quantile(col, qs, method="linear")
+    pos = qs * (s.size - 1)
+    lo = pos.astype(np.int64)
+    frac = pos - lo
+    a = s[lo]
+    b = s[np.minimum(lo + 1, s.size - 1)]
+    # np.quantile's _lerp: the diff stays in the COLUMN dtype, the lerp
+    # itself promotes to float64 — fuzz-verified bit-exact for f32 and f64
+    # columns (a pure-f64 lerp differs in the low bits on f32 columns)
+    d = b - a
+    cuts = np.where(frac >= 0.5, b - d * (1.0 - frac), a + d * frac)
     cuts = np.unique(cuts)
     return cuts.astype(np.float64)

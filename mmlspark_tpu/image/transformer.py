@@ -201,6 +201,12 @@ class UnrollBinaryImage(Transformer, HasInputCol, HasOutputCol):
                   default=0, typeConverter=TypeConverters.toInt)
     height = Param("height", "Resize height before unrolling (0 keeps size)",
                    default=0, typeConverter=TypeConverters.toInt)
+    channelsBGR = Param(
+        "channelsBGR",
+        "Unroll in BGR channel order (the reference decodes via OpenCV/"
+        "ImageSchema, which is BGR — keep True for vectors interchangeable "
+        "with reference-produced ones; False gives PIL-native RGB)",
+        default=True, typeConverter=TypeConverters.toBool)
 
     def __init__(self, **kwargs):
         kwargs.setdefault("inputCol", "bytes")
@@ -217,12 +223,16 @@ class UnrollBinaryImage(Transformer, HasInputCol, HasOutputCol):
             raise ValueError(
                 "UnrollBinaryImage: set BOTH width and height to resize "
                 f"(got width={w}, height={h})")
+        bgr = self.getChannelsBGR()
         rows = []
         for blob in table[self.getInputCol()]:
             img = Image.open(_io.BytesIO(bytes(blob))).convert("RGB")
             if w > 0 and h > 0:
                 img = img.resize((w, h))
-            rows.append(np.asarray(img, np.float64).reshape(-1))
+            arr = np.asarray(img, np.float64)
+            if bgr:
+                arr = arr[:, :, ::-1]
+            rows.append(arr.reshape(-1))
         widths = {len(r) for r in rows}
         if len(widths) > 1:
             raise ValueError(

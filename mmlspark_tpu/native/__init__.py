@@ -1,15 +1,23 @@
 """Native runtime extensions (C++), with build-on-demand and fallback.
 
-The reference backs its IO layer with JVM/Hadoop native streams; here the
-equivalent is a small C++ extension (``fastio.cc``) compiled on first use
-with the in-image toolchain.  Public surface:
+The reference backs its IO layer and compute hot loops with JVM/Hadoop
+native streams and LightGBM C++; here the equivalents are small C++
+extensions compiled on first use with the in-image toolchain:
 
-* ``available() -> bool`` — whether the extension loaded (or could be
+* ``fastio.cc``  — directory scan / bulk parallel file read / murmur3.
+* ``fastbin.cc`` — the BinMapper quantization inner loop
+  (``bin_columns``), the single-core-hostile part of dataset prep.
+
+Public surface:
+
+* ``available() -> bool`` — whether the IO extension loaded (or could be
   built); all callers must keep a pure-Python fallback.
 * ``read_file(path) -> bytes``
 * ``read_files(paths, n_threads=8) -> list[bytes]`` — thread-pool bulk
   read with the GIL released.
 * ``scan_dir(root, pattern, recursive) -> [(path, size, mtime)]``
+* ``bin_columns_available() -> bool`` / ``bin_columns(...)`` — native
+  binning kernel (callers fall back to numpy searchsorted).
 
 Set ``MMLSPARK_TPU_NO_NATIVE=1`` to force the Python fallbacks.
 """
@@ -23,19 +31,18 @@ import sysconfig
 from typing import List, Optional, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_mod = None
-_tried = False
+_mods = {}
 
 
-def _so_path() -> str:
+def _so_path(stem: str) -> str:
     tag = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return os.path.join(_HERE, f"_fastio{tag}")
+    return os.path.join(_HERE, f"{stem}{tag}")
 
 
-def _build() -> bool:
-    """Compile fastio.cc with g++ (or cc) into the package directory."""
-    src = os.path.join(_HERE, "fastio.cc")
-    out = _so_path()
+def _build(src_name: str, stem: str) -> bool:
+    """Compile one .cc with g++ (or cc) into the package directory."""
+    src = os.path.join(_HERE, src_name)
+    out = _so_path(stem)
     include = sysconfig.get_paths()["include"]
     for cxx in ("g++", "c++", "clang++"):
         try:
@@ -50,29 +57,44 @@ def _build() -> bool:
     return False
 
 
-def _load():
-    global _mod, _tried
-    if _mod is not None or _tried:
-        return _mod
-    _tried = True
+def _load(stem: str = "_fastio", src_name: str = "fastio.cc"):
+    if stem in _mods:
+        return _mods[stem]
+    _mods[stem] = None
     if os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_so_path()) and not _build():
+    if not os.path.exists(_so_path(stem)) and not _build(src_name, stem):
         return None
     try:
         sys.path.insert(0, _HERE)
-        import _fastio  # noqa: PLC0415
-        _mod = _fastio
+        _mods[stem] = __import__(stem)
     except ImportError:
-        _mod = None
+        _mods[stem] = None
     finally:
         if _HERE in sys.path:
             sys.path.remove(_HERE)
-    return _mod
+    return _mods[stem]
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def bin_columns_available() -> bool:
+    return _load("_fastbin", "fastbin.cc") is not None
+
+
+def bin_columns(X, bext, nb, base, lo, scale, use_table, missing_bin,
+                out) -> None:
+    """Native BinMapper transform; see fastbin.cc for the argument
+    contract.  Raises RuntimeError when the extension is unavailable
+    (callers gate on :func:`bin_columns_available`)."""
+    mod = _load("_fastbin", "fastbin.cc")
+    if mod is None:
+        raise RuntimeError("mmlspark_tpu.native._fastbin unavailable; use "
+                           "the numpy searchsorted path")
+    mod.bin_columns(X, bext, nb, base, lo, scale, use_table, missing_bin,
+                    out)
 
 
 def read_file(path: str) -> bytes:
