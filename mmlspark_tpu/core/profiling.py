@@ -1,0 +1,81 @@
+"""Device-level tracing — the framework's profiling subsystem.
+
+The reference's observability story is the Spark UI plus the ``Timer``
+pipeline stage (SURVEY.md §5.1); the TPU-native equivalent is a
+``jax.profiler`` trace (Perfetto/TensorBoard-readable, captures every XLA
+op with device timestamps).  This module makes that a first-class,
+in-package capability rather than a side tool:
+
+* :func:`trace` — context manager; wrap any region to capture a device
+  trace into a directory.
+* :func:`summarize_trace` — parse the written trace (no TensorBoard
+  needed) into per-op device-time totals, the same aggregation
+  ``tools/profile_boost_step.py`` prints.
+* ``LightGBMBase.setProfileTraceDir(dir)`` — traces the whole ``fit``
+  (engine hooks through :func:`maybe_trace`).
+
+The committed evidence chain in PERF.md (129 → 87 ms/tree) was produced
+with exactly these aggregations.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+
+@contextmanager
+def trace(out_dir: str):
+    """Capture a ``jax.profiler`` trace of the wrapped region."""
+    import jax
+    os.makedirs(out_dir, exist_ok=True)
+    with jax.profiler.trace(out_dir):
+        yield
+
+
+@contextmanager
+def maybe_trace(out_dir: Optional[str]):
+    """:func:`trace` when ``out_dir`` is set; no-op otherwise (the shape
+    engine code wants: one `with` either way)."""
+    if not out_dir:
+        yield
+        return
+    with trace(out_dir):
+        yield
+
+
+def summarize_trace(out_dir: str, top: int = 25
+                    ) -> List[Tuple[float, str]]:
+    """Aggregate device-op durations from the newest perfetto JSON export
+    under ``out_dir``.  Returns ``[(total_ms, op_name), ...]`` sorted
+    descending; empty when no trace file exists."""
+    paths = glob.glob(os.path.join(out_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        return []
+    with gzip.open(sorted(paths)[-1], "rt") as fh:
+        data = json.load(fh)
+    events = data.get("traceEvents", [])
+    agg: Dict[Tuple[int, str], float] = defaultdict(float)
+    for e in events:
+        if e.get("ph") == "X" and "dur" in e:
+            agg[(e.get("pid", 0), e.get("name", "?"))] += e["dur"]
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e.get("pid")] = e.get("args", {}).get("name", "")
+    dev_pids = [p for p, nm in pid_names.items()
+                if "TPU" in nm or "Device" in nm or "/device" in nm]
+    if not dev_pids:
+        by_pid: Dict[int, float] = defaultdict(float)
+        for (pid, _), d in agg.items():
+            by_pid[pid] += d
+        dev_pids = [max(by_pid, key=by_pid.get)] if by_pid else []
+    rows = sorted(((d / 1e3, name) for (pid, name), d in agg.items()
+                   if pid in dev_pids), reverse=True)
+    return rows[:top]

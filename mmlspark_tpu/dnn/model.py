@@ -34,6 +34,14 @@ class DNNModel(Transformer, HasInputCol, HasOutputCol):
 
     miniBatchSize = Param("miniBatchSize", "Rows per device minibatch",
                           default=64, typeConverter=TypeConverters.toInt)
+    computeDtype = Param(
+        "computeDtype",
+        "Device compute dtype: 'float32' or 'bfloat16'.  bfloat16 halves "
+        "HBM traffic and doubles MXU throughput (weights and activations "
+        "cast on device; outputs always return as float32) — the idiomatic "
+        "TPU inference mode for featurization, where last-bit parity "
+        "doesn't matter", default="float32",
+        typeConverter=TypeConverters.toString)
 
     def __init__(self, apply_fn: Optional[Callable] = None,
                  variables: Any = None, **kwargs):
@@ -41,21 +49,54 @@ class DNNModel(Transformer, HasInputCol, HasOutputCol):
         self._apply_fn = apply_fn
         self._variables = variables
         self._jitted = None
+        self._jitted_dtype = None
+        self._cast_variables = None
 
     def setModel(self, apply_fn: Callable, variables: Any) -> "DNNModel":
         self._apply_fn = apply_fn
         self._variables = variables
         self._jitted = None
+        self._cast_variables = None
         return self
 
     def _get_jitted(self):
-        if self._jitted is None:
+        dt = self.getComputeDtype()
+        if self._jitted is None or self._jitted_dtype != dt:
             if self._apply_fn is None:
                 raise ValueError(
                     f"{type(self).__name__} has no model; call setModel() or "
                     "construct with apply_fn/variables")
-            self._jitted = jax.jit(self._apply_fn)
+            if dt == "bfloat16":
+                base = self._apply_fn
+
+                def bf16_fn(variables, batch):
+                    out = base(variables, batch.astype(jnp.bfloat16))
+                    return jax.tree_util.tree_map(
+                        lambda a: a.astype(jnp.float32), out)
+
+                self._jitted = jax.jit(bf16_fn)
+            elif dt == "float32":
+                self._jitted = jax.jit(self._apply_fn)
+            else:
+                raise ValueError(
+                    f"computeDtype must be 'float32' or 'bfloat16', got "
+                    f"{dt!r}")
+            self._jitted_dtype = dt
+            self._cast_variables = None
         return self._jitted
+
+    def _exec_variables(self):
+        """Weights in the compute dtype, cast ONCE and cached — a per-batch
+        in-jit cast would re-read the full f32 tree from HBM every launch,
+        forfeiting the bf16 traffic saving."""
+        if self.getComputeDtype() != "bfloat16":
+            return self._variables
+        if self._cast_variables is None:
+            self._cast_variables = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                self._variables)
+        return self._cast_variables
 
     def _batch_input(self, col: np.ndarray) -> np.ndarray:
         if col.dtype == object:
@@ -67,15 +108,32 @@ class DNNModel(Transformer, HasInputCol, HasOutputCol):
         n = col.shape[0]
         bs = self.getMiniBatchSize()
         fn = self._get_jitted()
-        outs = []
+        # dispatch minibatches asynchronously with a bounded in-flight
+        # window: upload of batch k+1 overlaps compute of batch k (a
+        # per-batch np.asarray would serialize each launch behind a device
+        # round-trip — ~ms of dead time per minibatch on a tunneled TPU),
+        # while draining past the window keeps pinned input buffers at
+        # O(window · batch) HBM instead of O(dataset)
+        window = 4
+        variables = self._exec_variables()
+        outs, pending = [], []
+
+        def drain_one():
+            dev, p = pending.pop(0)
+            o = np.asarray(dev)
+            outs.append(o[:bs - p] if p else o)
+
         for start in range(0, n, bs):
             batch = col[start:start + bs]
             pad = bs - batch.shape[0]
             if pad:  # pad the tail so every minibatch hits the same program
                 batch = np.concatenate(
                     [batch, np.zeros((pad,) + batch.shape[1:], batch.dtype)])
-            out = np.asarray(fn(self._variables, jnp.asarray(batch)))
-            outs.append(out[:bs - pad] if pad else out)
+            pending.append((fn(variables, jnp.asarray(batch)), pad))
+            if len(pending) > window:
+                drain_one()
+        while pending:
+            drain_one()
         result = np.concatenate(outs, axis=0) if outs else \
             np.zeros((0, 0), np.float32)
         return table.withColumn(self.getOutputCol(),

@@ -159,6 +159,14 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                             "Raw 'key=value key=value' LightGBM param string "
                             "recorded into the model file",
                             default="", typeConverter=TypeConverters.toString)
+    profileTraceDir = Param(
+        "profileTraceDir",
+        "Directory for a jax.profiler device trace of the whole fit "
+        "(empty disables).  Perfetto/TensorBoard-readable; "
+        "core.profiling.summarize_trace parses it offline — the "
+        "TPU-native replacement for the reference's Spark-UI stage "
+        "timings (SURVEY.md section 5.1)",
+        default="", typeConverter=TypeConverters.toString)
 
     def _train_params(self) -> TrainParams:
         pass_through = {}
@@ -325,14 +333,16 @@ class LightGBMBase(Estimator, LightGBMParams):
                 val_weights=w[val_mask] if w is not None else None,
                 val_metric=self._val_metric_fn(table, val_mask),
             )
-        booster = train(
-            bins, y_train, w_train, mapper, objective, params,
-            feature_names=feature_names,
-            grad_fn_override=grad_override,
-            mesh=mesh,
-            init_scores=init_scores,
-            ranking_info=ranking_info,
-            **val_kwargs)
+        from ..core.profiling import maybe_trace
+        with maybe_trace(self.getProfileTraceDir()):
+            booster = train(
+                bins, y_train, w_train, mapper, objective, params,
+                feature_names=feature_names,
+                grad_fn_override=grad_override,
+                mesh=mesh,
+                init_scores=init_scores,
+                ranking_info=ranking_info,
+                **val_kwargs)
         model = self._make_model(booster)
         model.setParams(**{k: v for k, v in self._iterSetParams()
                            if model.hasParam(k)})

@@ -16,8 +16,10 @@ Parallelism mapping (reference ``parallelism`` param → mesh axes):
   learner).  This is the GBDT analog of sequence parallelism: the wide axis
   is sharded (SURVEY.md §5.7).
 * ``data+feature`` — 2-D mesh composing both.
-* ``voting``  — approximated by ``data`` for now (top-k voting is a comm
-  optimization, not a semantic change; planned for a later round).
+* ``voting``  — data-sharded layout with PV-Tree split finding (Meng et
+  al. 2016; LightGBM tree_learner=voting): histograms stay shard-local,
+  each shard votes its top-k features, and only the ~2k winning features'
+  histogram slices are psum-reduced (grower.find_best_split_voting).
 
 The whole boost step (grad/hess → grow tree → score update) runs inside one
 ``shard_map`` under ``jit``, so a single compiled program per iteration does
@@ -58,7 +60,7 @@ def resolve_mesh(parallelism: str, mesh: Optional[Mesh] = None) -> Mesh:
         arr = np.asarray(devs[:1]).reshape(1, 1)
     elif parallelism == "data+feature" and n > 1 and n % 2 == 0:
         arr = np.asarray(devs).reshape(n // 2, 2)
-    else:  # data / voting (voting-parallel comm optimization: later round)
+    else:  # data / voting (same mesh layout; voting differs in the grower)
         arr = np.asarray(devs).reshape(n, 1)
     return Mesh(arr, (DATA_AXIS, FEATURE_AXIS))
 

@@ -67,7 +67,11 @@ class TestBinning:
         searchsorted semantics BIT-EXACTLY for f32 and f64 inputs
         (binning.py documents the round-down bound-adjustment proof this
         test pins)."""
+        import os
         from mmlspark_tpu import native
+        if os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+            pytest.skip("MMLSPARK_TPU_NO_NATIVE=1 forces the fallback; "
+                        "parity vs itself proves nothing")
         assert native.bin_columns_available(), \
             "native fastbin kernel failed to build — the parity test " \
             "would silently compare the fallback against itself"
@@ -368,3 +372,20 @@ class TestValScoreScale:
         margins = np.asarray(m.getModel().predict_margin(
             np.asarray(binary_table["features"])[vmask]))
         assert np.allclose(captured["val"], margins, atol=1e-4)
+
+
+class TestProfiling:
+    def test_profile_trace_dir_writes_trace(self, binary_table, tmp_path):
+        """profileTraceDir captures a jax.profiler trace of fit and
+        core.profiling.summarize_trace can aggregate it offline (SURVEY
+        §5.1 subsystem; VERDICT r2 A1 flagged zero in-package profiler
+        usage)."""
+        from mmlspark_tpu.core import profiling
+        out = str(tmp_path / "trace")
+        m = LightGBMClassifier(numIterations=2, numLeaves=7, verbosity=0,
+                               profileTraceDir=out).fit(binary_table)
+        assert m is not None
+        files = [p for _, _, fs in __import__("os").walk(out) for p in fs]
+        assert files, "no trace files written"
+        rows = profiling.summarize_trace(out)
+        assert isinstance(rows, list)

@@ -99,6 +99,24 @@ class TestResNet:
                         features_only=True)
         assert feats.shape == (2, 512)
 
+    def test_bfloat16_compute_dtype_close_to_f32(self):
+        """computeDtype='bfloat16' (the TPU inference mode) must track the
+        float32 features closely and still return float outputs."""
+        from mmlspark_tpu.dnn import build_resnet, init_params
+        from mmlspark_tpu.dnn.model import ResNetFeaturizerModel
+        v = init_params(build_resnet("resnet18"), 64)
+        imgs = np.random.default_rng(1).normal(size=(5, 64, 64, 3)).astype(
+            np.float32)
+        kw = dict(variables=v, inputCol="image", outputCol="f",
+                  modelName="resnet18", miniBatchSize=4)
+        f32 = np.asarray(ResNetFeaturizerModel(**kw).transform(
+            {"image": imgs})["f"])
+        bf16 = np.asarray(ResNetFeaturizerModel(
+            computeDtype="bfloat16", **kw).transform({"image": imgs})["f"])
+        assert bf16.dtype == np.float64   # table contract: float out
+        denom = np.maximum(np.abs(f32), 1e-3)
+        assert np.median(np.abs(bf16 - f32) / denom) < 0.05
+
     def test_torch_state_dict_roundtrip(self):
         """flax forward with torch-layout random weights == torch forward."""
         torch = pytest.importorskip("torch")

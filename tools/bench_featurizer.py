@@ -1,0 +1,60 @@
+"""ImageFeaturizer throughput: ResNet-50 images/sec/chip (BASELINE.md
+secondary target).
+
+Measures the steady-state jitted headless-ResNet forward on the live
+backend at several batch sizes, float32 and bfloat16, end-to-end through
+``ResNetFeaturizerModel`` (including host→device upload and the
+back-to-back async minibatch dispatch the transformer uses).  Random
+weights — throughput does not depend on weight values.
+
+Usage: python tools/bench_featurizer.py [--images 512] [--batch 64 128]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=512)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--batch", type=int, nargs="*", default=[64, 128, 256])
+    ap.add_argument("--model", default="resnet50")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+    from mmlspark_tpu.dnn.model import ResNetFeaturizerModel
+    from mmlspark_tpu.dnn.resnet import build_resnet, init_params
+
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+    n, hw = args.images, args.size
+    rng = np.random.default_rng(0)
+    imgs = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    variables = init_params(build_resnet(args.model), hw)
+
+    best = {}
+    for dtype in ("float32", "bfloat16"):
+        for bs in args.batch:
+            m = ResNetFeaturizerModel(
+                variables=variables, inputCol="image", outputCol="f",
+                modelName=args.model, miniBatchSize=bs, computeDtype=dtype)
+            m._transform({"image": imgs[: 2 * bs]})        # compile
+            t0 = time.perf_counter()
+            out = m._transform({"image": imgs})
+            dt = time.perf_counter() - t0
+            ips = n / dt
+            best[dtype] = max(best.get(dtype, 0.0), ips)
+            print(f"{args.model} {dtype:9s} bs={bs:4d}: "
+                  f"{ips:8.1f} imgs/s  ({dt:.2f}s, "
+                  f"out {np.asarray(out['f']).shape})")
+    print(f"BEST: f32 {best.get('float32', 0):.1f} imgs/s, "
+          f"bf16 {best.get('bfloat16', 0):.1f} imgs/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,17 +43,56 @@ def main():
     ap.add_argument("--reps", type=int, default=17,
                     help="in-program repetitions for the slope measurement")
     ap.add_argument("--out", default="BENCH_SWEEP.md")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (the image's sitecustomize "
+                         "pins JAX_PLATFORMS=axon, so the env var alone "
+                         "does not work)")
+    ap.add_argument("--methods", nargs="*", default=None,
+                    help="subset of methods for this invocation")
+    ap.add_argument("--sizes", type=int, nargs="*", default=None,
+                    help="subset of bucket sizes for this invocation "
+                         "(results merge into the existing table, so a "
+                         "long sweep can be split across runs)")
     args = ap.parse_args()
 
     import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: ~90 jitted programs per full sweep, each
+    # 20-40 s through the remote compile service — reruns must not repay
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import jax.numpy as jnp
     import numpy as np
     from mmlspark_tpu.ops.histogram import compute_histogram
 
     backend = jax.default_backend()
     f, B, R = args.features, args.bins, args.reps
-    sizes = [2048, 4096, 8192, 16384, 32768, 65536, 131072, 262144, 524288]
+    sizes = args.sizes or [2048, 4096, 8192, 16384, 32768, 65536, 131072,
+                           262144, 524288]
     rng = np.random.default_rng(0)
+
+    sweep_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "mmlspark_tpu", "ops", f"_sweep_{backend}.json")
+    state = {"backend": backend, "features": f, "num_bins": B,
+             "winner_by_rows": {}, "times_us_by_rows": {}}
+    try:
+        with open(sweep_path) as fh:
+            prev = json.load(fh)
+        if prev.get("features") == f and prev.get("num_bins") == B:
+            state.update(prev)
+    except (OSError, ValueError):
+        pass
+
+    def flush_state():
+        """Persist winners + raw times after every size: a timeout loses
+        at most the in-flight point (the first run of this tool lost 50
+        minutes of measurements to a buffered pipe + SIGTERM)."""
+        state["device_kind"] = jax.devices()[0].device_kind
+        with open(sweep_path, "w") as fh:
+            json.dump(state, fh, indent=1)
+        write_markdown(args.out, state, backend, f, B, R)
 
     def timed_per_call(method, bins, gh_stack):
         """Per-call seconds via the two-point in-program slope."""
@@ -85,14 +124,12 @@ def main():
             best = min(best, (t_r - t_1) / (R - 1))
         return max(best, 0.0)
 
-    rows = []
-    winners = {}
     for n in sizes:
         bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.uint8)
         gh_stack = jnp.asarray(rng.normal(size=(R, n, 3)), jnp.float32)
         ref = None
-        times = {}
-        for m in ALL_METHODS:
+        times = dict(state["times_us_by_rows"].get(str(n), {}))
+        for m in (args.methods or ALL_METHODS):
             try:
                 out = jax.jit(
                     lambda b, g, m=m: compute_histogram(b, g, B, method=m)
@@ -112,29 +149,39 @@ def main():
         ok = {k: v for k, v in times.items()
               if v is not None and k in EXACT_METHODS}
         best = min(ok, key=ok.get) if ok else "dot16"
-        winners[str(n)] = best
-        rows.append((n, times, best))
+        state["winner_by_rows"][str(n)] = best
+        state["times_us_by_rows"][str(n)] = times
+        flush_state()
         print(f"n={n:7d} " + " ".join(
-            f"{m}={times[m]:.0f}us" if times[m] is not None else f"{m}=FAIL"
-            for m in ALL_METHODS) + f"  -> {best}")
+            f"{m}={times[m]:.0f}us" if times.get(m) is not None
+            else f"{m}=—" for m in ALL_METHODS) + f"  -> {best}",
+            flush=True)
 
+    print(f"wrote {args.out} and {sweep_path}", flush=True)
+
+
+def write_markdown(out_path, state, backend, f, B, R):
+    import jax
+    by_rows = state["times_us_by_rows"]
     lines = [
         "# Histogram-method sweep",
         "",
         f"Backend: **{backend}** ({jax.devices()[0].device_kind}); "
         f"shapes: (n, {f}) uint8 bins, {B} bins, 3 gradient channels.  "
         f"Per-call microseconds via the in-program slope "
-        f"(R={args.reps} scan reps vs 1; best of 3) — per-launch timing "
+        f"(R={R} scan reps vs 1; best of 3) — per-launch timing "
         "is meaningless on a tunneled TPU where every dispatch pays a "
         "~2-3 ms RPC floor.",
         "",
         "| rows | " + " | ".join(ALL_METHODS) + " | winner (f32-exact) |",
         "|---:|" + "---:|" * (len(ALL_METHODS) + 1),
     ]
-    for n, times, best in rows:
-        cells = [f"{times[m]:.0f}" if times[m] is not None else "—"
+    for n in sorted(by_rows, key=int):
+        times = by_rows[n]
+        cells = [f"{times[m]:.0f}" if times.get(m) is not None else "—"
                  for m in ALL_METHODS]
-        lines.append(f"| {n} | " + " | ".join(cells) + f" | **{best}** |")
+        lines.append(f"| {n} | " + " | ".join(cells)
+                     + f" | **{state['winner_by_rows'][n]}** |")
     lines += [
         "",
         "`compute_histogram(method='auto')` consults the per-backend winner "
@@ -146,17 +193,8 @@ def main():
         "from 'auto' (numerics) and stays opt-in.",
         "",
     ]
-    with open(args.out, "w") as fh:
+    with open(out_path, "w") as fh:
         fh.write("\n".join(lines))
-    sweep_path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "mmlspark_tpu", "ops", f"_sweep_{backend}.json")
-    with open(sweep_path, "w") as fh:
-        json.dump({"backend": backend,
-                   "device_kind": jax.devices()[0].device_kind,
-                   "features": f, "num_bins": B,
-                   "winner_by_rows": winners}, fh, indent=1)
-    print(f"wrote {args.out} and {sweep_path}")
 
 
 if __name__ == "__main__":
