@@ -63,7 +63,10 @@ def main():
     ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--features", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
-    ap.add_argument("--probe-timeout", type=float, default=300.0)
+    ap.add_argument("--probe-timeout", type=float, default=540.0,
+                    help="TPU init probe budget; a chip recovering from a "
+                         "wedged lease can take several minutes to claim, "
+                         "and falling back to CPU forfeits the benchmark")
     ap.add_argument("--force-cpu", action="store_true")
     args = ap.parse_args()
 

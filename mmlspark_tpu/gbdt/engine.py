@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import debug as _debug
 from .binning import BinMapper, fit_bin_mapper
 from .booster import Booster, HostTree, host_tree_from_arrays
 from .grower import (GrowerConfig, TreeArrays, apply_shrinkage,
@@ -87,7 +88,9 @@ class TrainParams:
 def _boost_step(bins, scores, labels, weights, bag_mask, feat_info,
                 obj: Objective, cfg: GrowerConfig, lr: float):
     """One boosting iteration for a single tree (single-class)."""
+    _debug.check_bins_in_range(bins, cfg.num_bins)
     g, h = obj.grad_hess(scores, labels, weights)
+    _debug.check_finite("gradients/hessians", g, h)
     gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
     tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
     scores = scores + lr * tree.leaf_value[row_leaf]
@@ -131,11 +134,14 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
     is the TPU-shaped analog of the reference keeping the whole iteration
     loop behind one JNI call (SURVEY.md §3.1).
     """
+    _debug.check_bins_in_range(bins, cfg.num_bins)
+
     def body(carry, xs):
         scores, val_scores = carry
         bag, fi = xs
         bag = jnp.broadcast_to(bag, scores.shape)
         g, h = obj.grad_hess(scores, labels, weights)
+        _debug.check_finite("gradients/hessians", g, h)
         gh = jnp.stack([g * bag, h * bag, bag], axis=1)
         tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
         if not rf:
@@ -156,13 +162,25 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
     return trees, scores, val_scores, val_hist
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _grow_checked(bins, gh, feat_info, cfg: GrowerConfig):
+    """grow_tree with the debug-mode invariants in-program (the ranking /
+    custom-gradient path computes gh outside jit, so the checks live in
+    this thin wrapper)."""
+    _debug.check_bins_in_range(bins, cfg.num_bins)
+    _debug.check_finite("gradients/hessians", gh)
+    return _grow_tree_impl(bins, gh, feat_info, cfg)
+
+
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"))
 def _dart_step(bins, s_minus, labels, weights, bag, fi, obj: Objective,
                cfg: GrowerConfig, lr: float):
     """One dart iteration body: fit a tree to the gradient at the dropped-
     out score vector; returns the lr-shrunk tree and its base contribution
     (the host applies the 1/(k+1) dart normalization)."""
+    _debug.check_bins_in_range(bins, cfg.num_bins)
     g, h = obj.grad_hess(s_minus, labels, weights)
+    _debug.check_finite("gradients/hessians", g, h)
     gh = jnp.stack([g * bag, h * bag, bag], axis=1)
     tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
     tree = apply_shrinkage(tree, lr)
@@ -182,10 +200,13 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
     boosting=goss).  Histogram work shrinks to ``(topRate + otherRate)·n``
     rows via a gather; scores still update for every row via a full binned
     traversal of the new tree."""
+    _debug.check_bins_in_range(bins, cfg.num_bins)
+
     def body(carry, xs):
         scores, val_scores = carry
         key, fi = xs
         g, h = obj.grad_hess(scores, labels, weights)
+        _debug.check_finite("gradients/hessians", g, h)
         n = g.shape[0]
         rank = jnp.argsort(-jnp.abs(g * h))          # descending influence
         top_idx = rank[:k1]
@@ -226,11 +247,14 @@ def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
     trees (LightGBM softmax semantics), then K grow steps consume the fixed
     gradients.  Emits trees flattened to (C*K, ...), iteration-major,
     class-minor — the order the model file expects."""
+    _debug.check_bins_in_range(bins, cfg.num_bins)
+
     def body(carry, xs):
         scores, val_scores = carry
         bag, fi = xs
         bag = jnp.broadcast_to(bag, (scores.shape[0],))
         g, h = obj.grad_hess(scores, labels, weights)
+        _debug.check_finite("gradients/hessians", g, h)
         trees_k = []
         for k in range(K):
             gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
@@ -560,6 +584,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         # Per-iteration host loop: the ranking gradient closes over query
         # structure on the host (not a hashable static), so it can't ride
         # the scan.  Trees still cross to the host as one packed chunk.
+        run_grow = _debug.checked(functools.partial(_grow_checked, cfg=cfg))
         trees_list: List[TreeArrays] = []
         for it in range(T):
             if use_bag and it % params.bagging_freq == 0:
@@ -569,7 +594,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             fi = jnp.asarray(iter_fi(it))
             g, h = grad_fn_override(scores)
             gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
-            tree, row_leaf = grow_tree(bins_d, gh, fi, cfg)
+            tree, row_leaf = run_grow(bins_d, gh, fi)
             scores = scores + params.learning_rate * \
                 tree.leaf_value[row_leaf]
             tree = apply_shrinkage(tree, params.learning_rate)
@@ -604,6 +629,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         # shrink by k/(k+1), preserving the ensemble total.  Per-tree
         # weights are tracked on host and baked into the exported trees.
         dart_rng = np.random.default_rng(params.drop_seed)
+        run_dart = _debug.checked(functools.partial(
+            _dart_step, obj=objective, cfg=cfg, lr=params.learning_rate))
         trees_list = []
         scales: List[float] = []
         L_steps = params.num_leaves
@@ -632,9 +659,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 s_minus = scores - P
             else:
                 s_minus = scores
-            tree, b_new = _dart_step(bins_d, s_minus, labels_d, weights_d,
-                                     bag_mask, fi, objective, cfg,
-                                     params.learning_rate)
+            tree, b_new = run_dart(bins_d, s_minus, labels_d, weights_d,
+                                   bag_mask, fi)
             norm = 1.0 / (k + 1)
             scores = s_minus + norm * b_new
             if k:
@@ -664,6 +690,22 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             trees_chunks = [jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *trees_list)]
     else:
+        # debug/sanitizer mode (SURVEY.md §5.2): checkified variants raise
+        # on OOB indexing or non-finite gradients instead of training
+        # silently on garbage; identity wrappers when debug mode is off.
+        # Static args bind via partial so checkify only sees array args.
+        run_scan = _debug.checked(functools.partial(
+            _boost_scan, obj=objective, cfg=cfg, lr=params.learning_rate,
+            has_val=has_val, rf=use_rf))
+        if use_goss:
+            run_goss = _debug.checked(functools.partial(
+                _boost_scan_goss, obj=objective, cfg=cfg,
+                lr=params.learning_rate, k1=k1, k2=k2, amp=goss_amp,
+                has_val=has_val))
+        if K > 1:
+            run_multi = _debug.checked(functools.partial(
+                _boost_scan_multi, obj=objective, cfg=cfg,
+                lr=params.learning_rate, K=K, has_val=has_val))
         cb_list: List[TreeArrays] = []
         it = 0
         while it < T:
@@ -687,20 +729,17 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                     fi_base, (C,) + fi_base.shape))
             def run_chunk(scores, val_scores):
                 if use_goss:
-                    return _boost_scan_goss(
+                    return run_goss(
                         bins_d, scores, labels_d, weights_d,
                         goss_keys[it:it + C], fi_stack, val_bins_d,
-                        val_scores, objective, cfg, params.learning_rate,
-                        k1, k2, goss_amp, has_val)
+                        val_scores)
                 if K > 1:
-                    return _boost_scan_multi(
+                    return run_multi(
                         bins_d, scores, labels_d, weights_d, bag_masks,
-                        fi_stack, val_bins_d, val_scores, objective, cfg,
-                        params.learning_rate, K, has_val)
-                return _boost_scan(
+                        fi_stack, val_bins_d, val_scores)
+                return run_scan(
                     bins_d, scores, labels_d, weights_d, bag_masks,
-                    fi_stack, val_bins_d, val_scores, objective, cfg,
-                    params.learning_rate, has_val, use_rf)
+                    fi_stack, val_bins_d, val_scores)
 
             ftr = params.fault_tolerant_retries
             if ftr > 0:
@@ -721,7 +760,11 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                         # invalidate this chunk's results
                         jax.block_until_ready(trees_st)
                         break
-                    except Exception:  # noqa: BLE001 - device loss etc.
+                    except Exception as e:  # noqa: BLE001 - device loss
+                        from jax.experimental import checkify as _ck
+                        if isinstance(e, _ck.JaxRuntimeError):
+                            raise  # deterministic sanitizer error: a
+                            # replay would fail identically
                         if attempt >= ftr:
                             raise
                         log.warning(
