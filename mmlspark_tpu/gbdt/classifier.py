@@ -50,6 +50,13 @@ class LightGBMClassifier(LightGBMBase, _ClassifierParams):
         self._num_class = 1
         if self.getObjective() in ("multiclass", "softmax"):
             self._resolved_objective = self.getObjective()
+            if y.dtype.kind == "f" and np.isnan(y).any():
+                # must fail HERE: the int cast below would turn NaN into
+                # an arbitrary class id and train silently on garbage
+                # (LightGBM likewise rejects NaN labels)
+                raise ValueError(
+                    "multiclass labels contain NaN; labels must be "
+                    "integer class ids in [0, num_class)")
             return y.astype(np.int64)
         uniq = np.unique(y[~np.isnan(y.astype(np.float64))]) \
             if y.dtype.kind == "f" else np.unique(y)

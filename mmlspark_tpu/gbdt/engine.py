@@ -88,9 +88,7 @@ class TrainParams:
 def _boost_step(bins, scores, labels, weights, bag_mask, feat_info,
                 obj: Objective, cfg: GrowerConfig, lr: float):
     """One boosting iteration for a single tree (single-class)."""
-    _debug.check_bins_in_range(bins, cfg.num_bins)
     g, h = obj.grad_hess(scores, labels, weights)
-    _debug.check_finite("gradients/hessians", g, h)
     gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
     tree, row_leaf = _grow_tree_impl(bins, gh, feat_info, cfg)
     scores = scores + lr * tree.leaf_value[row_leaf]
@@ -134,14 +132,11 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
     is the TPU-shaped analog of the reference keeping the whole iteration
     loop behind one JNI call (SURVEY.md §3.1).
     """
-    _debug.check_bins_in_range(bins, cfg.num_bins)
-
     def body(carry, xs):
         scores, val_scores = carry
         bag, fi = xs
         bag = jnp.broadcast_to(bag, scores.shape)
         g, h = obj.grad_hess(scores, labels, weights)
-        _debug.check_finite("gradients/hessians", g, h)
         gh = jnp.stack([g * bag, h * bag, bag], axis=1)
         tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
         if not rf:
@@ -162,25 +157,13 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
     return trees, scores, val_scores, val_hist
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _grow_checked(bins, gh, feat_info, cfg: GrowerConfig):
-    """grow_tree with the debug-mode invariants in-program (the ranking /
-    custom-gradient path computes gh outside jit, so the checks live in
-    this thin wrapper)."""
-    _debug.check_bins_in_range(bins, cfg.num_bins)
-    _debug.check_finite("gradients/hessians", gh)
-    return _grow_tree_impl(bins, gh, feat_info, cfg)
-
-
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"))
 def _dart_step(bins, s_minus, labels, weights, bag, fi, obj: Objective,
                cfg: GrowerConfig, lr: float):
     """One dart iteration body: fit a tree to the gradient at the dropped-
     out score vector; returns the lr-shrunk tree and its base contribution
     (the host applies the 1/(k+1) dart normalization)."""
-    _debug.check_bins_in_range(bins, cfg.num_bins)
     g, h = obj.grad_hess(s_minus, labels, weights)
-    _debug.check_finite("gradients/hessians", g, h)
     gh = jnp.stack([g * bag, h * bag, bag], axis=1)
     tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
     tree = apply_shrinkage(tree, lr)
@@ -200,12 +183,13 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
     boosting=goss).  Histogram work shrinks to ``(topRate + otherRate)·n``
     rows via a gather; scores still update for every row via a full binned
     traversal of the new tree."""
-    _debug.check_bins_in_range(bins, cfg.num_bins)
-
     def body(carry, xs):
         scores, val_scores = carry
         key, fi = xs
         g, h = obj.grad_hess(scores, labels, weights)
+        # pre-gather check: GOSS's influence argsort pushes NaN rows to
+        # the tail, so corrupt gradients could dodge the sampled subset
+        # that _grow_tree_impl's central check sees
         _debug.check_finite("gradients/hessians", g, h)
         n = g.shape[0]
         rank = jnp.argsort(-jnp.abs(g * h))          # descending influence
@@ -247,14 +231,11 @@ def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
     trees (LightGBM softmax semantics), then K grow steps consume the fixed
     gradients.  Emits trees flattened to (C*K, ...), iteration-major,
     class-minor — the order the model file expects."""
-    _debug.check_bins_in_range(bins, cfg.num_bins)
-
     def body(carry, xs):
         scores, val_scores = carry
         bag, fi = xs
         bag = jnp.broadcast_to(bag, (scores.shape[0],))
         g, h = obj.grad_hess(scores, labels, weights)
-        _debug.check_finite("gradients/hessians", g, h)
         trees_k = []
         for k in range(K):
             gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
@@ -584,7 +565,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         # Per-iteration host loop: the ranking gradient closes over query
         # structure on the host (not a hashable static), so it can't ride
         # the scan.  Trees still cross to the host as one packed chunk.
-        run_grow = _debug.checked(functools.partial(_grow_checked, cfg=cfg))
+        run_grow = _debug.checked(functools.partial(grow_tree, cfg=cfg))
         trees_list: List[TreeArrays] = []
         for it in range(T):
             if use_bag and it % params.bagging_freq == 0:

@@ -508,6 +508,12 @@ def make_feat_info(f: int, feature_mask=None, is_cat=None, nbins=None):
 
 
 def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
+    # debug-mode invariants (no-ops unless the calling program is
+    # checkified): every training path funnels through here, so corrupt
+    # bins / non-finite gradients are caught regardless of entry point
+    from ..core import debug as _debug
+    _debug.check_bins_in_range(bins, cfg.num_bins)
+    _debug.check_finite("gradients/hessians", gh)
     n, f = bins.shape
     L = cfg.num_leaves
     W = cfg.cat_words

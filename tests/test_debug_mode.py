@@ -58,6 +58,40 @@ class TestDebugMode:
                                parallelism="serial").fit(bad)
         assert m is not None
 
+    @pytest.mark.parametrize("boosting", ["goss"])
+    def test_goss_path_checked(self, table, boosting):
+        """checkify must discharge through the GOSS scan (argsort/gather
+        body) and catch NaNs BEFORE the influence sample drops them."""
+        debug.debug_mode(True)
+        bad = dict(table)
+        bad["label"] = table["label"].copy()
+        bad["label"][::50] = np.nan
+        with pytest.raises(Exception, match="non-finite|nan"):
+            LightGBMClassifier(numIterations=2, numLeaves=7, verbosity=0,
+                               boostingType=boosting,
+                               parallelism="serial").fit(bad)
+
+    def test_multiclass_path_checked(self, rng):
+        debug.debug_mode(True)
+        X = rng.normal(size=(600, 5)).astype(np.float32)
+        y = rng.integers(0, 3, 600).astype(np.float64)
+        y[::40] = np.nan
+        with pytest.raises(Exception, match="non-finite|nan|NaN|label"):
+            LightGBMClassifier(numIterations=2, numLeaves=7, verbosity=0,
+                               objective="multiclass",
+                               parallelism="serial").fit(
+                {"features": X, "label": y})
+
+    def test_multiclass_clean_passes(self, rng):
+        debug.debug_mode(True)
+        X = rng.normal(size=(600, 5)).astype(np.float32)
+        y = rng.integers(0, 3, 600).astype(np.float64)
+        m = LightGBMClassifier(numIterations=2, numLeaves=7, verbosity=0,
+                               objective="multiclass",
+                               parallelism="serial").fit(
+            {"features": X, "label": y})
+        assert m is not None
+
     def test_dart_path_checked(self, table):
         """boosting=dart runs its own step function; the sanitizer must
         cover it too (reviewer-found gap)."""
