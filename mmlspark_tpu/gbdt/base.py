@@ -155,6 +155,18 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                  "voting parallelism (PV-Tree): features each worker "
                  "votes per split (reference LightGBMParams.topK)",
                  default=20, typeConverter=TypeConverters.toInt)
+    enableBundle = Param(
+        "enableBundle",
+        "Exclusive Feature Bundling (LightGBM enable_bundle): merge "
+        "mutually-exclusive sparse features (one-hot blocks) into single "
+        "bundle columns so histogram work scales with bundles, not "
+        "features.  Off by default; serial gbdt/rf/multiclass only",
+        default=False, typeConverter=TypeConverters.toBool)
+    maxConflictRate = Param(
+        "maxConflictRate",
+        "EFB conflict budget (LightGBM max_conflict_rate): fraction of "
+        "rows allowed to violate exclusivity inside one bundle",
+        default=0.0, typeConverter=TypeConverters.toFloat)
     passThroughArgs = Param("passThroughArgs",
                             "Raw 'key=value key=value' LightGBM param string "
                             "recorded into the model file",
@@ -204,6 +216,8 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
             parallelism=self.getParallelism(),
             top_k=self.getTopK(),
             fault_tolerant_retries=self.getFaultTolerantRetries(),
+            enable_bundle=self.getEnableBundle(),
+            max_conflict_rate=self.getMaxConflictRate(),
             cat_smooth=self.getCatSmooth(),
             cat_l2=self.getCatL2(),
             max_cat_threshold=self.getMaxCatThreshold(),

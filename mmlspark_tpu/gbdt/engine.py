@@ -23,7 +23,7 @@ import numpy as np
 from ..core import debug as _debug
 from .binning import BinMapper, fit_bin_mapper
 from .booster import Booster, HostTree, host_tree_from_arrays
-from .grower import (GrowerConfig, TreeArrays, apply_shrinkage,
+from .grower import (EFBArrays, GrowerConfig, TreeArrays, apply_shrinkage,
                      grow_tree, predict_tree_binned, _grow_tree_impl)
 from .objectives import Objective, MulticlassObjective
 
@@ -78,6 +78,13 @@ class TrainParams:
     #: re-uploads the inputs and replays THAT chunk up to this many times
     #: — the TPU-shaped analog of the reference's executor gang-restart.
     fault_tolerant_retries: int = 0
+    #: Exclusive Feature Bundling (Ke et al. 2017; LightGBM
+    #: enable_bundle): merge mutually-exclusive sparse features into
+    #: bundle columns so histogram work scales with bundles, not
+    #: features.  Serial gbdt/rf/multiclass paths only; trees and the
+    #: exported model always reference original features.
+    enable_bundle: bool = False
+    max_conflict_rate: float = 0.0
     #: raw passthrough params recorded into the model file (parity with the
     #: reference's passThroughArgs; engine-known keys override these)
     pass_through: Dict[str, str] = field(default_factory=dict)
@@ -118,7 +125,7 @@ def _dummy_val(K: int):
                    donate_argnums=(1, 7))
 def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
                 val_bins, val_scores, obj: Objective, cfg: GrowerConfig,
-                lr: float, has_val: bool, rf: bool = False):
+                lr: float, has_val: bool, rf: bool = False, efb=None):
     """A chunk of boosting iterations inside ONE compiled program.
 
     ``bag_masks``: (C, n) bagging masks, or (C, 1) broadcast when bagging
@@ -138,7 +145,7 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
         bag = jnp.broadcast_to(bag, scores.shape)
         g, h = obj.grad_hess(scores, labels, weights)
         gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
         if not rf:
             # rf (random forest): every tree fits the gradient at the
             # CONSTANT init scores, unshrunk; averaging happens at export
@@ -183,13 +190,16 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
     boosting=goss).  Histogram work shrinks to ``(topRate + otherRate)·n``
     rows via a gather; scores still update for every row via a full binned
     traversal of the new tree."""
+    # pre-gather checks: GOSS hands _grow_tree_impl only the influence
+    # SAMPLE, but predict_tree_binned walks the FULL matrix every
+    # iteration, and the argsort pushes NaN rows to the sample's tail —
+    # so both invariants must look at the unsampled inputs here
+    _debug.check_bins_in_range(bins, cfg.num_bins)
+
     def body(carry, xs):
         scores, val_scores = carry
         key, fi = xs
         g, h = obj.grad_hess(scores, labels, weights)
-        # pre-gather check: GOSS's influence argsort pushes NaN rows to
-        # the tail, so corrupt gradients could dodge the sampled subset
-        # that _grow_tree_impl's central check sees
         _debug.check_finite("gradients/hessians", g, h)
         n = g.shape[0]
         rank = jnp.argsort(-jnp.abs(g * h))          # descending influence
@@ -226,7 +236,8 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
                    donate_argnums=(1, 7))
 def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
                       val_bins, val_scores, obj: Objective,
-                      cfg: GrowerConfig, lr: float, K: int, has_val: bool):
+                      cfg: GrowerConfig, lr: float, K: int, has_val: bool,
+                      efb=None):
     """Multiclass chunk: grad/hess computed ONCE per iteration for all K
     trees (LightGBM softmax semantics), then K grow steps consume the fixed
     gradients.  Emits trees flattened to (C*K, ...), iteration-major,
@@ -239,7 +250,7 @@ def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
         trees_k = []
         for k in range(K):
             gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
-            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
             scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
             tree = apply_shrinkage(tree, lr)
             if has_val:
@@ -493,7 +504,36 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             val_bins=val_bins, val_labels=val_labels,
             val_weights=val_weights, val_metric=val_metric)
 
-    bins_d = jnp.asarray(bins, mapper.bin_dtype)
+    # Exclusive Feature Bundling (serial paths; uint8 bins only — a
+    # bundle's encoded width is capped at num_total_bins).  GOSS/dart
+    # score through predict_tree_binned on the TRAINING matrix, whose
+    # node_feat ids are original features, so they stay unbundled.
+    efb_dev = None
+    bins_host_final = bins
+    if params.enable_bundle and not mapper.has_categorical \
+            and mapper.num_total_bins <= 256 \
+            and not use_goss and not use_dart and grad_fn_override is None:
+        from .efb import bundle_matrix, expansion_arrays, find_bundles
+        nb_list = [mapper.feature_num_bins(j) for j in range(f)]
+        spec = find_bundles(np.asarray(bins), nb_list, mapper.missing_bin,
+                            params.max_conflict_rate,
+                            max_bundle_bins=mapper.num_total_bins,
+                            seed=params.seed)
+        if not spec.is_trivial:
+            gi, valid, b_of, o_of, nb_arr, d_of = expansion_arrays(
+                spec, mapper.num_total_bins, mapper.missing_bin)
+            efb_dev = EFBArrays(
+                gather_idx=jnp.asarray(gi, jnp.int32),
+                valid=jnp.asarray(valid),
+                bundle_of=jnp.asarray(b_of), off_of=jnp.asarray(o_of),
+                nb_of=jnp.asarray(nb_arr), default_of=jnp.asarray(d_of))
+            bins_host_final = bundle_matrix(np.asarray(bins), spec,
+                                            mapper.missing_bin)
+            efb_host = (gi, valid, b_of, o_of, nb_arr, d_of)
+            if params.verbosity > 0:
+                log.info("EFB: %d features -> %d bundle columns",
+                         f, spec.num_bundles)
+    bins_d = jnp.asarray(bins_host_final, mapper.bin_dtype)
     labels_d = jnp.asarray(labels,
                            jnp.int32 if K > 1 else jnp.float32)
     weights_d = jnp.asarray(w, jnp.float32)
@@ -552,7 +592,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         # when a failure kills every device buffer
         chunk = min(chunk, 32)
         ft_host = {
-            "bins": np.asarray(bins),
+            "bins": np.asarray(bins_host_final),
             "labels": np.asarray(labels),
             "w": np.asarray(w),
             "val_bins": np.asarray(val_bins_d),
@@ -677,7 +717,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         # Static args bind via partial so checkify only sees array args.
         run_scan = _debug.checked(functools.partial(
             _boost_scan, obj=objective, cfg=cfg, lr=params.learning_rate,
-            has_val=has_val, rf=use_rf))
+            has_val=has_val, rf=use_rf, efb=efb_dev))
         if use_goss:
             run_goss = _debug.checked(functools.partial(
                 _boost_scan_goss, obj=objective, cfg=cfg,
@@ -686,7 +726,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         if K > 1:
             run_multi = _debug.checked(functools.partial(
                 _boost_scan_multi, obj=objective, cfg=cfg,
-                lr=params.learning_rate, K=K, has_val=has_val))
+                lr=params.learning_rate, K=K, has_val=has_val,
+                efb=efb_dev))
         cb_list: List[TreeArrays] = []
         it = 0
         while it < T:
@@ -748,6 +789,29 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                             # replay would fail identically
                         if attempt >= ftr:
                             raise
+                        if efb_dev is not None:
+                            # the EFB maps are device buffers too — dead
+                            # after a device loss; re-upload and rebind
+                            # the chunk runners that captured them
+                            efb_dev = EFBArrays(
+                                gather_idx=jnp.asarray(efb_host[0],
+                                                       jnp.int32),
+                                valid=jnp.asarray(efb_host[1]),
+                                bundle_of=jnp.asarray(efb_host[2]),
+                                off_of=jnp.asarray(efb_host[3]),
+                                nb_of=jnp.asarray(efb_host[4]),
+                                default_of=jnp.asarray(efb_host[5]))
+                            run_scan = _debug.checked(functools.partial(
+                                _boost_scan, obj=objective, cfg=cfg,
+                                lr=params.learning_rate, has_val=has_val,
+                                rf=use_rf, efb=efb_dev))
+                            if K > 1:
+                                run_multi = _debug.checked(
+                                    functools.partial(
+                                        _boost_scan_multi, obj=objective,
+                                        cfg=cfg, lr=params.learning_rate,
+                                        K=K, has_val=has_val,
+                                        efb=efb_dev))
                         log.warning(
                             "chunk at iteration %d failed (attempt %d/%d);"
                             " re-uploading state and replaying",

@@ -39,6 +39,50 @@ from ..ops.histogram import compute_histogram
 EPS_GAIN = 1e-10
 
 
+class EFBArrays(NamedTuple):
+    """Device-side EFB expansion maps (see gbdt/efb.py): bins holds G
+    bundle columns; histograms and split columns reconstruct per ORIGINAL
+    feature through these static-shaped arrays."""
+    gather_idx: jnp.ndarray   # (f, B) i32 flat (bundle*B + bundle_bin)
+    valid: jnp.ndarray        # (f, B) bool bins feature j actually uses
+    bundle_of: jnp.ndarray    # (f,) i32
+    off_of: jnp.ndarray       # (f,) i32
+    nb_of: jnp.ndarray        # (f,) i32
+    default_of: jnp.ndarray   # (f,) i32
+
+
+def _efb_expand(hist_b, efb):
+    """(G, B, 3) bundle histogram -> exact (f, B, 3) per-feature histogram.
+
+    Member slices come from a static flat gather; each feature's default
+    bin - whose rows the bundle encodes implicitly as "not this member" -
+    is reconstituted as leaf_total minus the explicit bins.  Bundle 0's
+    bins partition every row, so its sum IS the leaf total.
+    """
+    f = efb.gather_idx.shape[0]
+    flat = hist_b.reshape(-1, hist_b.shape[-1])          # (G*B, 3)
+    hist = jnp.take(flat, efb.gather_idx.reshape(-1), axis=0)
+    hist = hist.reshape(f, hist_b.shape[1], hist_b.shape[2])
+    hist = hist * efb.valid[:, :, None]
+    tot = jnp.sum(hist_b[0], axis=0)                      # (3,) leaf total
+    deficit = tot[None, :] - jnp.sum(hist, axis=1)        # (f, 3)
+    return hist.at[jnp.arange(f), efb.default_of].add(deficit)
+
+
+def efb_feature_column(binsT, feat, efb, num_bins):
+    """Reconstruct original feature ``feat``'s bin column from its bundle
+    column: in-range values shift back by the member offset (the last
+    member slot is the NaN bin), everything else is the default bin."""
+    g = efb.bundle_of[feat]
+    bcol = jnp.take(binsT, g, axis=0).astype(jnp.int32)
+    off = efb.off_of[feat]
+    nb = efb.nb_of[feat]
+    raw = bcol - off
+    inr = (raw >= 0) & (raw <= nb)
+    return jnp.where(inr, jnp.where(raw == nb, num_bins - 1, raw),
+                     efb.default_of[feat])
+
+
 @dataclass(frozen=True)
 class GrowerConfig:
     """Static hyper-parameters (hashable → usable as a jit static arg)."""
@@ -300,8 +344,12 @@ def _is_voting(cfg: GrowerConfig) -> bool:
     return cfg.axis_name is not None and cfg.voting_k > 0
 
 
-def _hist(bins, gh, cfg: GrowerConfig):
+def _hist(bins, gh, cfg: GrowerConfig, efb: Optional[EFBArrays] = None):
     h = compute_histogram(bins, gh, cfg.num_bins, method=cfg.hist_method)
+    if efb is not None:
+        # bins holds G bundle columns; expand to per-feature histograms
+        # (engine guards EFB to the serial path, so no psum interplay)
+        h = _efb_expand(h, efb)
     if cfg.axis_name is not None and not _is_voting(cfg):
         # voting mode keeps histograms shard-local; only the voted
         # candidate slices are ever reduced (find_best_split_voting)
@@ -488,11 +536,14 @@ def _find_split(hist, pg, ph, pc, fi, depth_ok, cfg: GrowerConfig):
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def grow_tree(bins: jnp.ndarray, gh: jnp.ndarray,
               feat_info: jnp.ndarray,
-              cfg: GrowerConfig) -> Tuple[TreeArrays, jnp.ndarray]:
+              cfg: GrowerConfig,
+              efb: Optional[EFBArrays] = None
+              ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree.  ``gh``: (n, 3) masked (grad, hess, count);
     ``feat_info``: (f, 3) [mask, is_cat, n_value_bins] (see
-    :func:`make_feat_info`)."""
-    return _grow_tree_impl(bins, gh, feat_info, cfg)
+    :func:`make_feat_info`); ``efb``: optional bundle maps — then
+    ``bins`` holds bundle columns (gbdt/efb.py)."""
+    return _grow_tree_impl(bins, gh, feat_info, cfg, efb)
 
 
 def make_feat_info(f: int, feature_mask=None, is_cat=None, nbins=None):
@@ -507,14 +558,17 @@ def make_feat_info(f: int, feature_mask=None, is_cat=None, nbins=None):
     return out
 
 
-def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
+def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None):
     # debug-mode invariants (no-ops unless the calling program is
     # checkified): every training path funnels through here, so corrupt
     # bins / non-finite gradients are caught regardless of entry point
     from ..core import debug as _debug
     _debug.check_bins_in_range(bins, cfg.num_bins)
     _debug.check_finite("gradients/hessians", gh)
-    n, f = bins.shape
+    n = bins.shape[0]
+    # under EFB bins holds G bundle columns; histograms, feat_info and
+    # tree state stay per ORIGINAL feature
+    f = efb.gather_idx.shape[0] if efb is not None else bins.shape[1]
     L = cfg.num_leaves
     W = cfg.cat_words
     sizes = _bucket_sizes(n, cfg)
@@ -525,7 +579,7 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
     # loops.
     binsT = bins.T
 
-    hist0 = _hist(bins, gh, cfg)
+    hist0 = _hist(bins, gh, cfg, efb)
     g0, h0, c0 = _global_totals(*_totals_from_hist(hist0), cfg)
     depth0_ok = (cfg.max_depth <= 0) | (0 < cfg.max_depth)
     bg0, bf0, bb0, bc0, bits0 = _find_split(
@@ -606,6 +660,8 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
                     .astype(jnp.int32),
                     0)
                 col = jax.lax.psum(col_local, cfg.feature_axis_name)
+            elif efb is not None:
+                col = efb_feature_column(binsT, feat, efb, cfg.num_bins)
             else:
                 col = jnp.take(binsT, feat, axis=0)
 
@@ -632,6 +688,8 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
                 child_cnt = jnp.where(use_right, cnt_r_p, cnt_l_p)
                 hist_small = _segment_hist(bins, gh, row_order, child_off,
                                            child_cnt, n, sizes, cfg)
+                if efb is not None:
+                    hist_small = _efb_expand(hist_small, efb)
                 if cfg.axis_name is not None and not _is_voting(cfg):
                     # voting keeps per-leaf histograms local; only voted
                     # candidate slices are reduced inside _find_split
@@ -656,7 +714,7 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig):
                 else:
                     go_right = in_leaf & (col > thr)
                 row_leaf = jnp.where(go_right, new_id, state.row_leaf)
-                hist_r = _hist(bins, gh * go_right[:, None], cfg)
+                hist_r = _hist(bins, gh * go_right[:, None], cfg, efb)
                 hist_l = state.leaf_hist[l] - hist_r
                 row_order = state.row_order
                 leaf_start = state.leaf_start

@@ -1,0 +1,148 @@
+"""Exclusive Feature Bundling (gbdt/efb.py; LightGBM enable_bundle).
+
+The load-bearing property: with perfectly exclusive features the bundled
+fit reproduces the unbundled one to float tolerance (histograms agree to
+~1e-6 relative; the default-bin mass is reconstituted by subtraction, a
+different summation order than direct accumulation).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.gbdt import LightGBMClassifier
+from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+from mmlspark_tpu.gbdt.efb import (BundleSpec, bundle_matrix,
+                                   expansion_arrays, find_bundles)
+
+
+def _sparse_table(rng, n=4000, groups=3, group_size=8, dense=2,
+                  conflict_rate=0.0):
+    """One-hot blocks (mutually exclusive within a group) + dense cols."""
+    cols = []
+    for g in range(groups):
+        onehot = np.zeros((n, group_size), np.float32)
+        owner = rng.integers(0, group_size + 1, n)  # +1 -> all-zero rows
+        mask = owner < group_size
+        onehot[np.arange(n)[mask], owner[mask]] = 1.0
+        if conflict_rate > 0:
+            extra = rng.random(n) < conflict_rate
+            onehot[np.arange(n)[extra],
+                   rng.integers(0, group_size, extra.sum())] = 1.0
+        cols.append(onehot)
+    cols.append(rng.normal(size=(n, dense)).astype(np.float32))
+    X = np.concatenate(cols, axis=1)
+    y = ((X[:, 0] + X[:, group_size] * 2 + X[:, -1]) > 0.5).astype(
+        np.float64)
+    return X, y
+
+
+class TestBundlePlanning:
+    def test_one_hot_groups_bundle(self, rng):
+        X, _ = _sparse_table(rng)
+        m = fit_bin_mapper(X, max_bin=255)
+        bins = m.transform(X)
+        nb = [m.feature_num_bins(j) for j in range(X.shape[1])]
+        spec = find_bundles(bins, nb, m.missing_bin)
+        # 24 one-hot cols (2 value bins each) pack into FEW bundles; the
+        # 2 dense cols stay solo
+        assert spec.num_bundles < X.shape[1]
+        multi = [b for b in spec.bundles if len(b) > 1]
+        assert multi, "no multi-feature bundle found for one-hot blocks"
+        assert not spec.is_trivial
+
+    def test_dense_features_stay_solo_identity(self, rng):
+        X = rng.normal(size=(3000, 4)).astype(np.float32)
+        m = fit_bin_mapper(X, max_bin=255)
+        bins = m.transform(X)
+        nb = [m.feature_num_bins(j) for j in range(4)]
+        spec = find_bundles(bins, nb, m.missing_bin)
+        assert spec.is_trivial
+        bm = bundle_matrix(bins, spec, m.missing_bin)
+        # identity encoding: bundle columns == original columns (maybe
+        # permuted by bundle order)
+        perm = [b[0] for b in spec.bundles]
+        assert (bm == bins[:, perm].astype(np.uint8)).all()
+
+    def test_bundle_decode_roundtrip(self, rng):
+        X, _ = _sparse_table(rng)
+        X[::97, 3] = np.nan                      # missing values too
+        m = fit_bin_mapper(X, max_bin=255)
+        bins = m.transform(X)
+        f = X.shape[1]
+        nb = [m.feature_num_bins(j) for j in range(f)]
+        spec = find_bundles(bins, nb, m.missing_bin)
+        bm = bundle_matrix(bins, spec, m.missing_bin)
+        solo = {g for g, mem in enumerate(spec.bundles) if len(mem) == 1}
+        for j in range(f):
+            g = spec.bundle_of[j]
+            bcol = bm[:, g].astype(np.int64)
+            if g in solo:
+                dec = bcol
+            else:
+                off, nbj, d = (spec.off_of[j], spec.nb_of[j],
+                               spec.default_of[j])
+                raw = bcol - off
+                inr = (raw >= 0) & (raw <= nbj)
+                dec = np.where(inr, np.where(raw == nbj, m.missing_bin,
+                                             raw), d)
+            assert (dec == bins[:, j]).all(), f"feature {j} decode drift"
+
+
+class TestTrainingParity:
+    """Bundled histograms equal direct ones to ~1e-6 relative (the
+    default-bin mass is reconstituted as leaf_total − Σ others, a
+    different summation order), so models agree to float tolerance, not
+    byte-for-byte — the same contract stock LightGBM's enable_bundle
+    carries."""
+
+    def test_prediction_parity_on_exclusive_features(self, rng):
+        X, y = _sparse_table(rng)
+        t = {"features": X, "label": y}
+        kw = dict(numIterations=15, numLeaves=15, verbosity=0,
+                  parallelism="serial", minDataInLeaf=5)
+        m_off = LightGBMClassifier(**kw).fit(t)
+        m_on = LightGBMClassifier(enableBundle=True, **kw).fit(t)
+        p_off = np.asarray(m_off.transform(t)["probability"])[:, 1]
+        p_on = np.asarray(m_on.transform(t)["probability"])[:, 1]
+        assert len(m_off.getModel().trees) == len(m_on.getModel().trees)
+        # median must be tight; a rare gain tie may flip one split and
+        # move a handful of rows, so the tail is bounded separately
+        assert np.median(np.abs(p_on - p_off)) < 1e-5
+        assert np.quantile(np.abs(p_on - p_off), 0.99) < 0.05
+
+    def test_multiclass_prediction_parity(self, rng):
+        X, y = _sparse_table(rng)
+        y3 = (np.abs(X[:, -1]) * 2 + (X[:, 0] > 0)).astype(np.int64) % 3
+        t = {"features": X, "label": y3.astype(np.float64)}
+        kw = dict(numIterations=6, numLeaves=7, verbosity=0,
+                  objective="multiclass", parallelism="serial",
+                  minDataInLeaf=5)
+        p_off = np.asarray(LightGBMClassifier(**kw).fit(t)
+                           .transform(t)["probability"])
+        p_on = np.asarray(LightGBMClassifier(enableBundle=True, **kw)
+                          .fit(t).transform(t)["probability"])
+        assert np.median(np.abs(p_on - p_off)) < 1e-5
+        assert np.quantile(np.abs(p_on - p_off), 0.99) < 0.05
+
+    def test_conflict_budget_trains_close(self, rng):
+        from sklearn.metrics import roc_auc_score
+        X, y = _sparse_table(rng, conflict_rate=0.01)
+        t = {"features": X, "label": y}
+        kw = dict(numIterations=20, numLeaves=15, verbosity=0,
+                  parallelism="serial", minDataInLeaf=5)
+        auc_off = roc_auc_score(y, np.asarray(
+            LightGBMClassifier(**kw).fit(t).transform(t)["probability"]
+        )[:, 1])
+        auc_on = roc_auc_score(y, np.asarray(
+            LightGBMClassifier(enableBundle=True, maxConflictRate=0.05,
+                               **kw).fit(t).transform(t)["probability"]
+        )[:, 1])
+        assert auc_on > auc_off - 0.02, (auc_on, auc_off)
+
+    def test_goss_silently_skips_bundling(self, rng):
+        X, y = _sparse_table(rng)
+        m = LightGBMClassifier(enableBundle=True, boostingType="goss",
+                               numIterations=5, numLeaves=7, verbosity=0,
+                               parallelism="serial").fit(
+            {"features": X, "label": y})
+        assert m is not None
