@@ -1222,9 +1222,11 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         step = make_boost_scan(
             mesh, objective, cfg, params.learning_rate, use_bag, has_val,
             rf=use_rf_m)
+    bins_np = np.asarray(bins, mapper.bin_dtype)
+    labels_np = np.asarray(labels)
+    w_np = np.asarray(w, np.float32)
     bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
-        np.asarray(bins, mapper.bin_dtype), np.asarray(labels),
-        np.asarray(w, np.float32), mesh, K, init, init_scores)
+        bins_np, labels_np, w_np, mesh, K, init, init_scores)
     f_padded = f + fp
 
     fi_base = np.zeros((f_padded, 3), np.float32)
@@ -1271,6 +1273,17 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         chunk = min(chunk, 64)
     if has_val:
         chunk = min(chunk, max(min(esr, 64), 8) if esr > 0 else 64)
+    ftr = params.fault_tolerant_retries
+    if ftr > 0:
+        # the mesh gang-restart analog (SURVEY.md §5.3): bounded chunks
+        # bound the replay; host copies make full re-upload possible when
+        # a failure kills every device buffer in the gang.  The converted
+        # host arrays from dataset prep are reused — no second copy.
+        chunk = min(chunk, 32)
+        ft_bins = bins_np
+        ft_labels = labels_np
+        ft_w = w_np
+        ft_vb = vb if has_val else None   # already padded
     cur = np.ones(n, np.float32)
     chunks: List[TreeArrays] = []
     best_metric, best_iter = np.inf, -1
@@ -1297,14 +1310,73 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         else:
             fi_stack = jnp.asarray(np.broadcast_to(fi_base,
                                                    (C,) + fi_base.shape))
-        if use_goss_m and K == 1:
-            trees_st, scores, val_scores, val_hist = step(
-                bins_d, scores, labels_d, w_d, real,
-                goss_keys_m[it:it + C], fi_stack, val_bins_d, val_scores)
+        def run_step(scores_in, val_scores_in):
+            if use_goss_m and K == 1:
+                return step(
+                    bins_d, scores_in, labels_d, w_d, real,
+                    goss_keys_m[it:it + C], fi_stack, val_bins_d,
+                    val_scores_in)
+            return step(
+                bins_d, scores_in, labels_d, w_d, real, bags, fi_stack,
+                val_bins_d, val_scores_in)
+
+        if ftr > 0:
+            snap = (np.asarray(scores), np.asarray(val_scores))
+            bags_host = np.asarray(bags)
+            fi_host = np.asarray(fi_stack)
+            for attempt in range(ftr + 1):
+                try:
+                    trees_st, scores, val_scores, val_hist = run_step(
+                        jax.device_put(jnp.asarray(snap[0]),
+                                       scores.sharding),
+                        jax.device_put(jnp.asarray(snap[1]),
+                                       val_scores.sharding))
+                    jax.block_until_ready(trees_st)
+                    break
+                except Exception as e:  # noqa: BLE001 - device loss
+                    from jax.experimental import checkify as _ck
+                    if isinstance(e, _ck.JaxRuntimeError):
+                        raise   # deterministic data bug: replay would
+                        # fail identically
+                    if attempt >= ftr:
+                        raise
+                    log.warning(
+                        "mesh chunk at iteration %d failed (attempt "
+                        "%d/%d); re-uploading the gang's inputs and "
+                        "replaying", it, attempt + 1, ftr)
+                    bins_d, labels_d, w_d, real, scores, _, _ = \
+                        prepare_arrays(ft_bins, ft_labels, ft_w, mesh, K,
+                                       init, init_scores)
+                    if use_goss_m and K == 1:
+                        # the PRNG key stack is a device buffer too
+                        goss_keys_m = jax.random.split(
+                            jax.random.PRNGKey(params.bagging_seed),
+                            params.num_iterations)
+                    if has_val:
+                        val_bins_d = jax.device_put(
+                            jnp.asarray(ft_vb),
+                            NamedSharding(mesh, P(DATA_AXIS, None)))
+                        val_scores = jax.device_put(
+                            jnp.asarray(snap[1]),
+                            NamedSharding(mesh, vspec))
+                    else:
+                        val_bins_d = jax.device_put(
+                            jnp.zeros((dn, f_padded), mapper.bin_dtype),
+                            NamedSharding(mesh, P(DATA_AXIS, None)))
+                        val_scores = jax.device_put(
+                            jnp.asarray(snap[1]),
+                            NamedSharding(mesh, P(DATA_AXIS, None)
+                                          if K > 1 else P(DATA_AXIS)))
+                    if use_bag:
+                        bags = jax.device_put(
+                            jnp.asarray(bags_host),
+                            NamedSharding(mesh, P(None, DATA_AXIS)))
+                    else:
+                        bags = jnp.asarray(bags_host)
+                    fi_stack = jnp.asarray(fi_host)
         else:
-            trees_st, scores, val_scores, val_hist = step(
-                bins_d, scores, labels_d, w_d, real, bags, fi_stack,
-                val_bins_d, val_scores)
+            trees_st, scores, val_scores, val_hist = run_step(
+                scores, val_scores)
         chunks.append(trees_st)
         stop = False
         if has_val:

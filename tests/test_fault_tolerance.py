@@ -70,3 +70,115 @@ class TestFaultTolerance:
         recovered = _fit(table, faultTolerantRetries=1, **kw)
         assert (recovered.getModel().save_native_model_string()
                 == clean.getModel().save_native_model_string())
+
+
+class TestMeshFaultTolerance:
+    """The distributed (shard_map) path's gang-restart analog: a failed
+    chunk re-uploads every shard's inputs and replays (VERDICT r2 A3)."""
+
+    def _fit_mesh(self, table, **kw):
+        return LightGBMClassifier(numIterations=24, numLeaves=15,
+                                  parallelism="data", verbosity=0,
+                                  **kw).fit(table)
+
+    def test_mesh_injected_failure_replayed_identically(self, table,
+                                                        monkeypatch):
+        from mmlspark_tpu.gbdt import distributed as dist
+        clean = self._fit_mesh(table)
+
+        orig_make = dist.make_boost_scan
+        state = {"calls": 0}
+
+        def make_flaky(*a, **kw):
+            step = orig_make(*a, **kw)
+
+            def flaky(*sa, **skw):
+                state["calls"] += 1
+                if state["calls"] == 1:
+                    raise RuntimeError("injected gang device loss")
+                return step(*sa, **skw)
+            return flaky
+
+        monkeypatch.setattr(dist, "make_boost_scan", make_flaky)
+        recovered = self._fit_mesh(table, faultTolerantRetries=2)
+        assert state["calls"] >= 2
+        assert (recovered.getModel().save_native_model_string()
+                == clean.getModel().save_native_model_string())
+
+    def test_mesh_exhausted_retries_reraise(self, table, monkeypatch):
+        from mmlspark_tpu.gbdt import distributed as dist
+
+        def make_always_fail(*a, **kw):
+            def step(*sa, **skw):
+                raise RuntimeError("gang gone")
+            return step
+
+        monkeypatch.setattr(dist, "make_boost_scan", make_always_fail)
+        with pytest.raises(RuntimeError, match="gang gone"):
+            self._fit_mesh(table, faultTolerantRetries=1)
+
+    def test_mesh_validation_failure_replayed(self, table, monkeypatch):
+        """Replay with a validation set restores val scores and early-
+        stopping bookkeeping too."""
+        from mmlspark_tpu.gbdt import distributed as dist
+        n = len(table["label"])
+        vmask = np.zeros(n, bool)
+        vmask[: n // 4] = True
+        t = dict(table)
+        t["valid"] = vmask.astype(np.float64)
+        kw = dict(validationIndicatorCol="valid", earlyStoppingRound=50)
+        clean = self._fit_mesh(t, **kw)
+
+        orig_make = dist.make_boost_scan
+        state = {"calls": 0}
+
+        def make_flaky(*a, **kws):
+            step = orig_make(*a, **kws)
+
+            def flaky(*sa, **skw):
+                state["calls"] += 1
+                if state["calls"] == 1:   # esr chunking: T fits one chunk
+                    raise RuntimeError("injected gang device loss")
+                return step(*sa, **skw)
+            return flaky
+
+        monkeypatch.setattr(dist, "make_boost_scan", make_flaky)
+        recovered = self._fit_mesh(t, faultTolerantRetries=2, **kw)
+        assert state["calls"] >= 2
+        assert (recovered.getModel().save_native_model_string()
+                == clean.getModel().save_native_model_string())
+
+    def test_mesh_goss_failure_replayed(self, table, monkeypatch):
+        """GOSS-on-mesh replay must also restore the PRNG key stack (a
+        device buffer) — reviewer-found gap."""
+        from mmlspark_tpu.gbdt import distributed as dist
+        # goss distributes only when a mesh is pinned explicitly (the
+        # per-shard sampling is a semantic choice)
+        mesh = dist.resolve_mesh("data")
+
+        def fit(**kw):
+            est = LightGBMClassifier(numIterations=24, numLeaves=15,
+                                     boostingType="goss", verbosity=0,
+                                     **kw).setMesh(mesh)
+            return est.fit(table)
+
+        clean = fit()
+
+        orig_make = dist.make_goss_scan
+        state = {"calls": 0}
+
+        def make_flaky(*a, **kws):
+            step = orig_make(*a, **kws)
+
+            def flaky(*sa, **skw):
+                state["calls"] += 1
+                if state["calls"] == 1:
+                    raise RuntimeError("injected gang device loss")
+                return step(*sa, **skw)
+            return flaky
+
+        monkeypatch.setattr(dist, "make_goss_scan", make_flaky)
+        recovered = fit(faultTolerantRetries=2)
+        assert state["calls"] >= 2
+        assert (recovered.getModel().save_native_model_string()
+                == clean.getModel().save_native_model_string())
