@@ -140,7 +140,7 @@ def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
 
 def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
                     bag_sharded: bool, has_val: bool = False,
-                    rf: bool = False):
+                    rf: bool = False, efb=None):
     """Chunked distributed boosting: a ``lax.scan`` over iterations INSIDE
     the shard_map, so a whole chunk of trees trains in one launch with all
     histogram psums compiler-scheduled onto ICI (the reference's per-
@@ -176,7 +176,10 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
             bag = jnp.broadcast_to(bag, scores.shape) * real
             g, h = obj.grad_hess(scores, labels, weights)
             gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+            # efb rides the closure: the (f, B)-sized maps replicate as
+            # baked constants; per-feature expansion happens SHARD-LOCAL
+            # before the psum (expansion is linear, so it commutes)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
             if not rf:
                 scores = scores + lr * tree.leaf_value[row_leaf]
                 tree = apply_shrinkage(tree, lr)
@@ -207,7 +210,7 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
 
 def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
                          lr: float, num_class: int, bag_sharded: bool,
-                         has_val: bool = False):
+                         has_val: bool = False, efb=None):
     """Multiclass distributed chunk: grad/hess once per iteration for all K
     trees (LightGBM softmax semantics), K grow steps per scan iteration.
     Trees come back stacked (C*K, ...), iteration-major."""
@@ -224,7 +227,7 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
             trees_k = []
             for k in range(K):
                 gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
-                tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+                tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
                 scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
                 tree = apply_shrinkage(tree, lr)
                 if has_val:

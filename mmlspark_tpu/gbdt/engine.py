@@ -346,6 +346,41 @@ def _truncate_no_growth(host_trees: List[HostTree], nls: np.ndarray, K: int,
     return host_trees[:(first + 1) * K], min(stop_iter, first)
 
 
+
+def _build_efb(bins, mapper, params, f, verbosity_tag=""):
+    """Shared EFB setup: plan bundles, build the device expansion maps and
+    the bundled host matrix.  Returns ``(efb_dev, efb_host, bundled)`` or
+    ``(None, None, None)`` when bundling is trivial — callers decide the
+    path-specific gate conditions."""
+    from .efb import bundle_matrix, expansion_arrays, find_bundles
+    nb_list = [mapper.feature_num_bins(j) for j in range(f)]
+    spec = find_bundles(np.asarray(bins), nb_list, mapper.missing_bin,
+                        params.max_conflict_rate,
+                        max_bundle_bins=mapper.num_total_bins,
+                        seed=params.seed)
+    if spec.is_trivial:
+        return None, None, None
+    efb_host = expansion_arrays(spec, mapper.num_total_bins,
+                                mapper.missing_bin)
+    bundled = bundle_matrix(np.asarray(bins), spec, mapper.missing_bin)
+    if params.verbosity > 0:
+        log.info("EFB%s: %d features -> %d bundle columns",
+                 verbosity_tag, f, spec.num_bundles)
+    return _efb_dev_from_host(efb_host), efb_host, bundled
+
+
+def _efb_dev_from_host(efb_host):
+    """Upload the six EFB map arrays (dtypes pinned so a replay re-upload
+    never retraces)."""
+    return EFBArrays(
+        gather_idx=jnp.asarray(efb_host[0], jnp.int32),
+        valid=jnp.asarray(efb_host[1]),
+        bundle_of=jnp.asarray(efb_host[2]),
+        off_of=jnp.asarray(efb_host[3]),
+        nb_of=jnp.asarray(efb_host[4]),
+        default_of=jnp.asarray(efb_host[5]))
+
+
 def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
           mapper: BinMapper, objective: Objective, params: TrainParams,
           feature_names: Optional[List[str]] = None,
@@ -513,26 +548,9 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     if params.enable_bundle and not mapper.has_categorical \
             and mapper.num_total_bins <= 256 \
             and not use_goss and not use_dart and grad_fn_override is None:
-        from .efb import bundle_matrix, expansion_arrays, find_bundles
-        nb_list = [mapper.feature_num_bins(j) for j in range(f)]
-        spec = find_bundles(np.asarray(bins), nb_list, mapper.missing_bin,
-                            params.max_conflict_rate,
-                            max_bundle_bins=mapper.num_total_bins,
-                            seed=params.seed)
-        if not spec.is_trivial:
-            gi, valid, b_of, o_of, nb_arr, d_of = expansion_arrays(
-                spec, mapper.num_total_bins, mapper.missing_bin)
-            efb_dev = EFBArrays(
-                gather_idx=jnp.asarray(gi, jnp.int32),
-                valid=jnp.asarray(valid),
-                bundle_of=jnp.asarray(b_of), off_of=jnp.asarray(o_of),
-                nb_of=jnp.asarray(nb_arr), default_of=jnp.asarray(d_of))
-            bins_host_final = bundle_matrix(np.asarray(bins), spec,
-                                            mapper.missing_bin)
-            efb_host = (gi, valid, b_of, o_of, nb_arr, d_of)
-            if params.verbosity > 0:
-                log.info("EFB: %d features -> %d bundle columns",
-                         f, spec.num_bundles)
+        efb_dev, efb_host, bundled = _build_efb(bins, mapper, params, f)
+        if efb_dev is not None:
+            bins_host_final = bundled
     bins_d = jnp.asarray(bins_host_final, mapper.bin_dtype)
     labels_d = jnp.asarray(labels,
                            jnp.int32 if K > 1 else jnp.float32)
@@ -793,14 +811,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                             # the EFB maps are device buffers too — dead
                             # after a device loss; re-upload and rebind
                             # the chunk runners that captured them
-                            efb_dev = EFBArrays(
-                                gather_idx=jnp.asarray(efb_host[0],
-                                                       jnp.int32),
-                                valid=jnp.asarray(efb_host[1]),
-                                bundle_of=jnp.asarray(efb_host[2]),
-                                off_of=jnp.asarray(efb_host[3]),
-                                nb_of=jnp.asarray(efb_host[4]),
-                                default_of=jnp.asarray(efb_host[5]))
+                            efb_dev = _efb_dev_from_host(efb_host)
                             run_scan = _debug.checked(functools.partial(
                                 _boost_scan, obj=objective, cfg=cfg,
                                 lr=params.learning_rate, has_val=has_val,
@@ -1206,22 +1217,46 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
             goss_keys_m = jax.random.split(
                 jax.random.PRNGKey(params.bagging_seed),
                 params.num_iterations)
-    if use_goss_m and K == 1:
-        step = make_goss_scan(
-            mesh, objective, cfg, params.learning_rate, k1, k2,
-            goss_amp_m, has_val)
-    elif K > 1:
-        if use_goss_m or use_rf_m:
-            raise NotImplementedError(
-                f"boostingType={params.boosting!r} with a mesh currently "
-                "supports single-model objectives")
-        step = make_multiclass_scan(
-            mesh, objective, cfg, params.learning_rate, K, use_bag,
-            has_val)
-    else:
-        step = make_boost_scan(
+    # EFB under a data mesh: one bundling plan from the full host matrix
+    # (columns are global), per-shard bundled rows, shard-local expansion
+    # before the psum.  GOSS scores through the training matrix by
+    # original feature id and a feature-sharded mesh would split bundles,
+    # so both are excluded; voting's shard-local vote scan likewise.
+    # EFB under a data mesh: one bundling plan from the full host matrix
+    # (columns are global), per-shard bundled rows, shard-local expansion
+    # before the psum.  GOSS scores through the training matrix by
+    # original feature id and a feature-sharded mesh would split bundles,
+    # so both are excluded; voting's shard-local vote scan likewise.
+    efb_dev_m, efb_host_m = None, None
+    if params.enable_bundle and not mapper.has_categorical \
+            and mapper.num_total_bins <= 256 \
+            and int(mesh.shape[FEATURE_AXIS]) == 1 \
+            and cfg.voting_k == 0 and not use_goss_m:
+        efb_dev_m, efb_host_m, bundled = _build_efb(
+            bins, mapper, params, f, verbosity_tag=" (mesh)")
+        if efb_dev_m is not None:
+            bins = bundled
+
+    def build_step(efb_arg):
+        """(Re)build the shard_mapped chunk program — the fault-tolerance
+        replay needs fresh EFB closure constants after a device loss."""
+        if use_goss_m and K == 1:
+            return make_goss_scan(
+                mesh, objective, cfg, params.learning_rate, k1, k2,
+                goss_amp_m, has_val)
+        if K > 1:
+            if use_goss_m or use_rf_m:
+                raise NotImplementedError(
+                    f"boostingType={params.boosting!r} with a mesh "
+                    "currently supports single-model objectives")
+            return make_multiclass_scan(
+                mesh, objective, cfg, params.learning_rate, K, use_bag,
+                has_val, efb=efb_arg)
+        return make_boost_scan(
             mesh, objective, cfg, params.learning_rate, use_bag, has_val,
-            rf=use_rf_m)
+            rf=use_rf_m, efb=efb_arg)
+
+    step = build_step(efb_dev_m)
     bins_np = np.asarray(bins, mapper.bin_dtype)
     labels_np = np.asarray(labels)
     w_np = np.asarray(w, np.float32)
@@ -1229,7 +1264,11 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         bins_np, labels_np, w_np, mesh, K, init, init_scores)
     f_padded = f + fp
 
-    fi_base = np.zeros((f_padded, 3), np.float32)
+    # feat_info stays per ORIGINAL feature under EFB (histograms expand
+    # back to f features before split finding); fp then pads bundle
+    # columns, not features
+    fi_base = np.zeros((f if efb_dev_m is not None else f_padded, 3),
+                       np.float32)
     fi_base[:f] = _feat_info_from_mapper(mapper, f)
 
     dn = int(mesh.shape[DATA_AXIS])
@@ -1300,16 +1339,17 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                     cur = (bag_rng.random(n) < params.bagging_fraction
                            ).astype(np.float32)
                 rows.append(np.concatenate([cur, np.zeros(rp, np.float32)]))
-            bags = jax.device_put(jnp.asarray(np.stack(rows)),
+            bags_host = np.stack(rows)
+            bags = jax.device_put(jnp.asarray(bags_host),
                                   NamedSharding(mesh, P(None, DATA_AXIS)))
         else:
+            bags_host = np.ones((C, 1), np.float32)
             bags = jnp.ones((C, 1), jnp.float32)
         if use_ff:
-            fi_stack = jnp.asarray(
-                np.stack([iter_fi_dist(it + j) for j in range(C)]))
+            fi_host = np.stack([iter_fi_dist(it + j) for j in range(C)])
         else:
-            fi_stack = jnp.asarray(np.broadcast_to(fi_base,
-                                                   (C,) + fi_base.shape))
+            fi_host = np.broadcast_to(fi_base, (C,) + fi_base.shape)
+        fi_stack = jnp.asarray(fi_host)
         def run_step(scores_in, val_scores_in):
             if use_goss_m and K == 1:
                 return step(
@@ -1321,16 +1361,21 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                 val_bins_d, val_scores_in)
 
         if ftr > 0:
+            # one D2H snapshot per chunk buys replay; the happy path
+            # reuses the LIVE device buffers (donation is safe — the
+            # snapshot covers the replay)
             snap = (np.asarray(scores), np.asarray(val_scores))
-            bags_host = np.asarray(bags)
-            fi_host = np.asarray(fi_stack)
             for attempt in range(ftr + 1):
                 try:
+                    if attempt == 0:
+                        s_in, v_in = scores, val_scores
+                    else:
+                        s_in = jax.device_put(jnp.asarray(snap[0]),
+                                              scores.sharding)
+                        v_in = jax.device_put(jnp.asarray(snap[1]),
+                                              val_scores.sharding)
                     trees_st, scores, val_scores, val_hist = run_step(
-                        jax.device_put(jnp.asarray(snap[0]),
-                                       scores.sharding),
-                        jax.device_put(jnp.asarray(snap[1]),
-                                       val_scores.sharding))
+                        s_in, v_in)
                     jax.block_until_ready(trees_st)
                     break
                 except Exception as e:  # noqa: BLE001 - device loss
@@ -1352,6 +1397,12 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                         goss_keys_m = jax.random.split(
                             jax.random.PRNGKey(params.bagging_seed),
                             params.num_iterations)
+                    if efb_dev_m is not None:
+                        # the EFB maps are closure constants of the
+                        # compiled step — dead with the gang; re-upload
+                        # and rebuild the program around them
+                        efb_dev_m = _efb_dev_from_host(efb_host_m)
+                        step = build_step(efb_dev_m)
                     if has_val:
                         val_bins_d = jax.device_put(
                             jnp.asarray(ft_vb),

@@ -146,3 +146,57 @@ class TestTrainingParity:
                                parallelism="serial").fit(
             {"features": X, "label": y})
         assert m is not None
+
+
+class TestMeshEFB:
+    """EFB under a data mesh: shard-local expansion commutes with the
+    histogram psum (both are linear), so bundled mesh training matches
+    bundled serial training to float tolerance."""
+
+    def test_mesh_matches_serial_with_bundling(self, rng):
+        X, y = _sparse_table(rng)
+        t = {"features": X, "label": y}
+        kw = dict(numIterations=12, numLeaves=15, verbosity=0,
+                  minDataInLeaf=5, enableBundle=True)
+        p_serial = np.asarray(
+            LightGBMClassifier(parallelism="serial", **kw).fit(t)
+            .transform(t)["probability"])[:, 1]
+        p_mesh = np.asarray(
+            LightGBMClassifier(parallelism="data", **kw).fit(t)
+            .transform(t)["probability"])[:, 1]
+        assert np.median(np.abs(p_mesh - p_serial)) < 1e-5
+        assert np.quantile(np.abs(p_mesh - p_serial), 0.99) < 0.05
+
+    def test_mesh_bundle_matches_mesh_plain(self, rng):
+        X, y = _sparse_table(rng)
+        t = {"features": X, "label": y}
+        kw = dict(numIterations=12, numLeaves=15, verbosity=0,
+                  minDataInLeaf=5, parallelism="data")
+        p_plain = np.asarray(
+            LightGBMClassifier(**kw).fit(t).transform(t)["probability"]
+        )[:, 1]
+        p_efb = np.asarray(
+            LightGBMClassifier(enableBundle=True, **kw).fit(t)
+            .transform(t)["probability"])[:, 1]
+        assert np.median(np.abs(p_efb - p_plain)) < 1e-5
+        assert np.quantile(np.abs(p_efb - p_plain), 0.99) < 0.05
+
+    def test_mesh_multiclass_bundled(self, rng):
+        X, _ = _sparse_table(rng)
+        y3 = ((X[:, 0] > 0) + (X[:, 8] > 0) * 1).astype(np.float64)
+        t = {"features": X, "label": y3}
+        m = LightGBMClassifier(numIterations=5, numLeaves=7, verbosity=0,
+                               objective="multiclass", enableBundle=True,
+                               parallelism="data").fit(t)
+        p = np.asarray(m.transform(t)["probability"])
+        assert np.isfinite(p).all()
+
+    def test_feature_mesh_skips_bundling(self, rng):
+        """A feature-sharded mesh would split bundles across shards; EFB
+        must silently disengage."""
+        X, y = _sparse_table(rng)
+        m = LightGBMClassifier(numIterations=5, numLeaves=7, verbosity=0,
+                               enableBundle=True,
+                               parallelism="data+feature").fit(
+            {"features": X, "label": y})
+        assert m is not None
