@@ -1222,11 +1222,6 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     # before the psum.  GOSS scores through the training matrix by
     # original feature id and a feature-sharded mesh would split bundles,
     # so both are excluded; voting's shard-local vote scan likewise.
-    # EFB under a data mesh: one bundling plan from the full host matrix
-    # (columns are global), per-shard bundled rows, shard-local expansion
-    # before the psum.  GOSS scores through the training matrix by
-    # original feature id and a feature-sharded mesh would split bundles,
-    # so both are excluded; voting's shard-local vote scan likewise.
     efb_dev_m, efb_host_m = None, None
     if params.enable_bundle and not mapper.has_categorical \
             and mapper.num_total_bins <= 256 \

@@ -348,7 +348,9 @@ def _hist(bins, gh, cfg: GrowerConfig, efb: Optional[EFBArrays] = None):
     h = compute_histogram(bins, gh, cfg.num_bins, method=cfg.hist_method)
     if efb is not None:
         # bins holds G bundle columns; expand to per-feature histograms
-        # (engine guards EFB to the serial path, so no psum interplay)
+        # BEFORE any psum — expansion is linear (static gather + a
+        # leaf-total subtraction), so shard-local expansion followed by
+        # the reduction equals expanding the reduced histogram
         h = _efb_expand(h, efb)
     if cfg.axis_name is not None and not _is_voting(cfg):
         # voting mode keeps histograms shard-local; only the voted

@@ -217,6 +217,121 @@ def _eval_node(node: Dict[str, Any], env: Dict[str, Any]):
                                     (1, size) + (1,) * (x.ndim - 2),
                                     (1,) * x.ndim, pads)
         return x / (bias + alpha / size * den) ** beta
+    if op == "Gather":
+        return jnp.take(env[ins[0]],
+                        jnp.asarray(env[ins[1]]).astype(jnp.int32),
+                        axis=attrs.get("axis", 0))
+    if op == "Shape":
+        # static under jit: shapes are trace-time constants
+        shp = env[ins[0]].shape
+        nd = len(shp)
+        st = attrs.get("start", 0)
+        en = attrs.get("end", nd)
+        st = st + nd if st < 0 else st
+        en = en + nd if en < 0 else en
+        return jnp.asarray(shp[st:en], jnp.int64)
+    if op == "Slice":
+        # opset >= 10 form: starts/ends[/axes/steps] are (initializer)
+        # inputs — like Reshape, shape-like operands must be constants
+        x = env[ins[0]]
+        starts = np.asarray(env[ins[1]]).tolist()
+        ends = np.asarray(env[ins[2]]).tolist()
+        axes = (np.asarray(env[ins[3]]).tolist()
+                if len(ins) > 3 and ins[3] else list(range(len(starts))))
+        steps = (np.asarray(env[ins[4]]).tolist()
+                 if len(ins) > 4 and ins[4] else [1] * len(starts))
+        sl = [slice(None)] * x.ndim
+        int32max = 2 ** 31 - 1
+        for st, en, ax, sp in zip(starts, ends, axes, steps):
+            en = None if en >= int32max else int(en)
+            sl[int(ax)] = slice(int(st), en, int(sp))
+        return x[tuple(sl)]
+    if op == "Split":
+        x = env[ins[0]]
+        ax = attrs.get("axis", 0)
+        if len(ins) > 1 and ins[1]:
+            sizes = np.asarray(env[ins[1]]).tolist()
+        elif attrs.get("split"):
+            sizes = list(attrs["split"])
+        else:
+            # opset-18 default: ceil-sized chunks, remainder in the last
+            k = len(node["outputs"])
+            chunk = -(-x.shape[ax] // k)
+            sizes = [chunk] * (k - 1) + [x.shape[ax] - chunk * (k - 1)]
+        offs = np.cumsum([0] + sizes)
+        pieces = tuple(
+            jax.lax.slice_in_dim(x, int(offs[i]), int(offs[i + 1]),
+                                 axis=ax)
+            for i in range(len(sizes)))
+        return pieces if len(pieces) > 1 else pieces[0]
+    if op in ("ReduceSum", "ReduceMax", "ReduceMin"):
+        fn = {"ReduceSum": jnp.sum, "ReduceMax": jnp.max,
+              "ReduceMin": jnp.min}[op]
+        axes = attrs.get("axes") or (
+            np.asarray(env[ins[1]]).tolist() if len(ins) > 1 and ins[1]
+            else None)
+        return fn(env[ins[0]], axis=tuple(axes) if axes else None,
+                  keepdims=bool(attrs.get("keepdims", 1)))
+    if op in ("ArgMax", "ArgMin"):
+        fn = jnp.argmax if op == "ArgMax" else jnp.argmin
+        out = fn(env[ins[0]], axis=attrs.get("axis", 0))
+        if attrs.get("keepdims", 1):
+            out = jnp.expand_dims(out, attrs.get("axis", 0))
+        return out.astype(jnp.int64)
+    if op == "Where":
+        return jnp.where(env[ins[0]], env[ins[1]], env[ins[2]])
+    if op in ("Equal", "Greater", "Less"):
+        fn = {"Equal": jnp.equal, "Greater": jnp.greater,
+              "Less": jnp.less}[op]
+        return fn(env[ins[0]], env[ins[1]])
+    if op == "Expand":
+        shape = np.asarray(env[ins[1]]).tolist()
+        x = env[ins[0]]
+        # ONNX Expand follows numpy broadcasting with dim-1 stretching
+        shape = list(np.broadcast_shapes(tuple(x.shape), tuple(
+            int(d) for d in shape)))
+        return jnp.broadcast_to(x, shape)
+    if op == "Tile":
+        reps = np.asarray(env[ins[1]]).tolist()
+        return jnp.tile(env[ins[0]], [int(r) for r in reps])
+    if op == "ConstantOfShape":
+        shape = [int(d) for d in np.asarray(env[ins[0]]).tolist()]
+        val = attrs.get("value")
+        if val is None:
+            return jnp.zeros(shape, jnp.float32)
+        v = np.asarray(val).reshape(-1)[0]
+        return jnp.full(shape, v, dtype=np.asarray(val).dtype)
+    if op == "Range":
+        start, limit, delta = (np.asarray(env[i]).reshape(()).item()
+                               for i in ins[:3])
+        return jnp.arange(start, limit, delta)
+    if op == "Pad":
+        x = env[ins[0]]
+        pads = (np.asarray(env[ins[1]]).tolist() if len(ins) > 1
+                else list(attrs.get("pads", [])))
+        cval = (np.asarray(env[ins[2]]).reshape(()).item()
+                if len(ins) > 2 and ins[2] else attrs.get("value", 0.0))
+        mode = attrs.get("mode", b"constant")
+        mode = mode.decode() if isinstance(mode, bytes) else mode
+        nd = x.ndim
+        widths = [(int(pads[i]), int(pads[i + nd])) for i in range(nd)]
+        if mode == "constant":
+            return jnp.pad(x, widths, constant_values=cval)
+        return jnp.pad(x, widths,
+                       mode={"reflect": "reflect", "edge": "edge"}[mode])
+    if op == "LayerNormalization":
+        x = env[ins[0]]
+        ax = attrs.get("axis", -1)
+        ax = ax + x.ndim if ax < 0 else ax
+        # spec: normalize over ALL axes [axis, rank) jointly
+        axes = tuple(range(ax, x.ndim))
+        eps = attrs.get("epsilon", 1e-5)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - mean) / jnp.sqrt(var + eps) * env[ins[1]]
+        if len(ins) > 2 and ins[2]:
+            out = out + env[ins[2]]
+        return out
     raise NotImplementedError(
         f"ONNX op {op!r} is not supported yet "
         f"(node {node['name'] or '<unnamed>'})")
@@ -245,7 +360,10 @@ class OnnxGraph:
         for node in self.graph["nodes"]:
             outs = node["outputs"]
             result = _eval_node(node, env)
-            if len(outs) == 1:
+            if isinstance(result, tuple):      # multi-output op (Split)
+                for o, r in zip(outs, result):
+                    env[o] = r
+            elif len(outs) == 1:
                 env[outs[0]] = result
             else:  # e.g. Dropout with mask output
                 env[outs[0]] = result

@@ -143,3 +143,120 @@ class TestONNXModelTransformer:
         X = rng.normal(size=(3, 48))
         out = m.transform({"features": X})
         assert out["out"].shape == (3, 2)
+
+
+class TestExtendedOps:
+    """The tensor-manipulation op tier (Gather/Slice/Split/Shape/...):
+    checked against numpy semantics through the wire codec."""
+
+    def _run(self, nodes, weights, inputs, outputs, feeds):
+        blob = proto.encode_model(nodes, weights, inputs=inputs,
+                                  outputs=outputs)
+        g = OnnxGraph(blob)
+        return g(*feeds)
+
+    def test_gather_slice_shape(self, rng):
+        x = rng.normal(size=(5, 7)).astype(np.float32)
+        idx = np.asarray([0, 3], np.int64)
+        nodes = [
+            proto.encode_node("Gather", ["x", "idx"], ["g"], axis=0),
+            proto.encode_node("Slice", ["g", "st", "en", "ax"], ["s"]),
+            proto.encode_node("Shape", ["s"], ["sh"]),
+        ]
+        out = self._run(
+            nodes,
+            {"idx": idx, "st": np.asarray([1], np.int64),
+             "en": np.asarray([6], np.int64),
+             "ax": np.asarray([1], np.int64)},
+            [("x", [5, 7])], [("s", [2, 5]), ("sh", [2])], [x])
+        np.testing.assert_allclose(out[0], x[idx][:, 1:6])
+        assert list(np.asarray(out[1])) == [2, 5]
+
+    def test_split_where_equal(self, rng):
+        x = rng.normal(size=(4, 6)).astype(np.float32)
+        nodes = [
+            proto.encode_node("Split", ["x"], ["a", "b"], axis=1),
+            proto.encode_node("Greater", ["a", "b"], ["m"]),
+            proto.encode_node("Where", ["m", "a", "b"], ["w"]),
+        ]
+        out = self._run(nodes, {}, [("x", [4, 6])], [("w", [4, 3])], [x])
+        a, b = x[:, :3], x[:, 3:]
+        np.testing.assert_allclose(out, np.where(a > b, a, b), rtol=1e-6)
+
+    def test_reduce_argmax_expand(self, rng):
+        x = rng.normal(size=(3, 5)).astype(np.float32)
+        nodes = [
+            proto.encode_node("ReduceSum", ["x"], ["r"], axes=[1],
+                              keepdims=1),
+            proto.encode_node("ArgMax", ["x"], ["am"], axis=1, keepdims=0),
+            proto.encode_node("Expand", ["r", "shape"], ["e"]),
+        ]
+        out = self._run(
+            nodes, {"shape": np.asarray([3, 5], np.int64)},
+            [("x", [3, 5])], [("e", [3, 5]), ("am", [3])], [x])
+        np.testing.assert_allclose(
+            out[0], np.broadcast_to(x.sum(1, keepdims=True), (3, 5)),
+            rtol=1e-5)
+        assert (np.asarray(out[1]) == x.argmax(1)).all()
+
+    def test_pad_tile_layernorm(self, rng):
+        x = rng.normal(size=(2, 4)).astype(np.float32)
+        scale = rng.normal(size=(4,)).astype(np.float32)
+        bias = rng.normal(size=(4,)).astype(np.float32)
+        nodes = [
+            proto.encode_node("LayerNormalization", ["x", "sc", "bi"],
+                              ["ln"], axis=-1),
+            proto.encode_node("Pad", ["ln", "pads"], ["p"]),
+            proto.encode_node("Tile", ["p", "reps"], ["t"]),
+        ]
+        out = self._run(
+            nodes,
+            {"sc": scale, "bi": bias,
+             "pads": np.asarray([0, 1, 0, 1], np.int64),
+             "reps": np.asarray([2, 1], np.int64)},
+            [("x", [2, 4])], [("t", [4, 6])], [x])
+        mu = x.mean(1, keepdims=True)
+        sd = x.std(1, keepdims=True)
+        ln = (x - mu) / np.sqrt(sd ** 2 + 1e-5) * scale + bias
+        want = np.tile(np.pad(ln, [(0, 0), (1, 1)]), (2, 1))
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_constantofshape_range(self):
+        nodes = [
+            proto.encode_node("ConstantOfShape", ["sh"], ["z"]),
+            proto.encode_node("Range", ["st", "li", "de"], ["r"]),
+            proto.encode_node("Add", ["z", "r"], ["o"]),
+        ]
+        out = self._run(
+            nodes,
+            {"sh": np.asarray([4], np.int64),
+             "st": np.asarray(0.0, np.float32),
+             "li": np.asarray(4.0, np.float32),
+             "de": np.asarray(1.0, np.float32)},
+            [], [("o", [4])], [])
+        np.testing.assert_allclose(out, [0, 1, 2, 3])
+
+
+    def test_shape_start_end_and_split_remainder(self, rng):
+        x = rng.normal(size=(7, 3)).astype(np.float32)
+        nodes = [
+            proto.encode_node("Shape", ["x"], ["s0"], start=0, end=1),
+            proto.encode_node("Split", ["x"], ["a", "b"], axis=0),
+        ]
+        out = self._run(nodes, {}, [("x", [7, 3])],
+                        [("s0", [1]), ("a", [4, 3]), ("b", [3, 3])], [x])
+        assert list(np.asarray(out[0])) == [7]
+        assert out[1].shape == (4, 3) and out[2].shape == (3, 3)
+        np.testing.assert_allclose(np.concatenate([out[1], out[2]]), x)
+
+    def test_layernorm_multi_axis(self, rng):
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        sc = np.ones((3, 4), np.float32)
+        nodes = [proto.encode_node("LayerNormalization", ["x", "sc"],
+                                   ["ln"], axis=1)]
+        out = self._run(nodes, {"sc": sc}, [("x", [2, 3, 4])],
+                        [("ln", [2, 3, 4])], [x])
+        mu = x.reshape(2, -1).mean(1).reshape(2, 1, 1)
+        var = x.reshape(2, -1).var(1).reshape(2, 1, 1)
+        np.testing.assert_allclose(out, (x - mu) / np.sqrt(var + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
