@@ -7,7 +7,11 @@ and the whole forward is one jitted XLA program — operator fusion comes from
 the compiler rather than onnxruntime's executor.  Supports the core
 CNN/MLP operator set (Conv, Gemm/MatMul, BatchNorm, pooling, activations,
 elementwise, Reshape/Flatten/Concat/Transpose, Softmax, LRN, Dropout-as-
-identity); unsupported ops raise with the op name.
+identity) plus the tensor-manipulation tier (Gather, Shape, Slice, Split,
+Reduce*/Arg*, Where, comparisons, Expand, Tile, ConstantOfShape, Range,
+Pad, LayerNormalization).  Shape-like operands (Reshape/Slice/Expand/...)
+must be constants/initializers — static shapes are the XLA contract.
+Unsupported ops (or unsupported attribute forms) raise with the op name.
 """
 
 from __future__ import annotations
@@ -270,13 +274,21 @@ def _eval_node(node: Dict[str, Any], env: Dict[str, Any]):
         axes = attrs.get("axes") or (
             np.asarray(env[ins[1]]).tolist() if len(ins) > 1 and ins[1]
             else None)
+        if not axes and attrs.get("noop_with_empty_axes"):
+            return env[ins[0]]       # spec: empty axes + flag = identity
         return fn(env[ins[0]], axis=tuple(axes) if axes else None,
                   keepdims=bool(attrs.get("keepdims", 1)))
     if op in ("ArgMax", "ArgMin"):
         fn = jnp.argmax if op == "ArgMax" else jnp.argmin
-        out = fn(env[ins[0]], axis=attrs.get("axis", 0))
+        x = env[ins[0]]
+        ax = attrs.get("axis", 0)
+        if attrs.get("select_last_index"):
+            # last tied index = n-1 - first index over the reversed axis
+            out = x.shape[ax] - 1 - fn(jnp.flip(x, axis=ax), axis=ax)
+        else:
+            out = fn(x, axis=ax)
         if attrs.get("keepdims", 1):
-            out = jnp.expand_dims(out, attrs.get("axis", 0))
+            out = jnp.expand_dims(out, ax)
         return out.astype(jnp.int64)
     if op == "Where":
         return jnp.where(env[ins[0]], env[ins[1]], env[ins[2]])
@@ -313,8 +325,16 @@ def _eval_node(node: Dict[str, Any], env: Dict[str, Any]):
                 if len(ins) > 2 and ins[2] else attrs.get("value", 0.0))
         mode = attrs.get("mode", b"constant")
         mode = mode.decode() if isinstance(mode, bytes) else mode
-        nd = x.ndim
-        widths = [(int(pads[i]), int(pads[i + nd])) for i in range(nd)]
+        pairs = _pads_to_lax(pads, x.ndim)   # per-listed-axis (beg, end)
+        if len(ins) > 3 and ins[3]:
+            # opset-18 axes input: pads are ordered per the axes list
+            axes = [int(a) + (x.ndim if a < 0 else 0)
+                    for a in np.asarray(env[ins[3]]).tolist()]
+            widths = [(0, 0)] * x.ndim
+            for a, pr in zip(axes, pairs):
+                widths[a] = pr
+        else:
+            widths = pairs
         if mode == "constant":
             return jnp.pad(x, widths, constant_values=cval)
         return jnp.pad(x, widths,

@@ -2,10 +2,11 @@
 
 The reference's quickstart story (train a LightGBMClassifier, save the
 native model, score it elsewhere, stand it up behind Spark Serving) on the
-TPU-native stack.  Runs on any jax backend; force CPU with
-``JAX_PLATFORMS=cpu``.
+TPU-native stack.  Runs on any jax backend; pass ``--cpu`` to force the
+CPU backend (some images pin ``JAX_PLATFORMS`` at interpreter startup,
+so the env var alone may not stick).
 
-    python samples/train_export_serve.py
+    python samples/train_export_serve.py [--cpu]
 """
 
 import json
@@ -19,6 +20,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    if "--cpu" in sys.argv[1:]:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     from mmlspark_tpu.gbdt import (LightGBMClassificationModel,
                                    LightGBMClassifier)
 

@@ -260,3 +260,24 @@ class TestExtendedOps:
         var = x.reshape(2, -1).var(1).reshape(2, 1, 1)
         np.testing.assert_allclose(out, (x - mu) / np.sqrt(var + 1e-5),
                                    rtol=1e-4, atol=1e-5)
+
+
+    def test_pad_axes_argmax_last_reduce_noop(self, rng):
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        x[0, 0] = x[0, 2] = x[0].max() + 1.0     # tie for ArgMax
+        nodes = [
+            proto.encode_node("Pad", ["x", "pads", "", "axes"], ["p"]),
+            proto.encode_node("ArgMax", ["x"], ["am"], axis=1, keepdims=0,
+                              select_last_index=1),
+            proto.encode_node("ReduceSum", ["x"], ["rs"],
+                              noop_with_empty_axes=1, keepdims=0),
+        ]
+        out = self._run(
+            nodes,
+            {"pads": np.asarray([2, 1], np.int64),
+             "axes": np.asarray([1], np.int64)},
+            [("x", [2, 3])], [("p", [2, 6]), ("am", [2]), ("rs", [2, 3])],
+            [x])
+        np.testing.assert_allclose(out[0], np.pad(x, [(0, 0), (2, 1)]))
+        assert np.asarray(out[1])[0] == 2        # LAST tied index
+        np.testing.assert_allclose(out[2], x)    # noop reduce = identity
