@@ -1,0 +1,113 @@
+"""Spark interop — the deployment-shape adapter (SURVEY.md §7 step 7).
+
+The reference IS a Spark library; this framework replaces its execution
+engine but keeps the Spark deployment story available: drive
+mmlspark_tpu stages from a PySpark session, with executors running the
+jitted compute against their local accelerator.  Nothing here imports
+pyspark at module load — every entry point degrades cleanly when Spark
+is absent (the common case for pure-TPU deployments), and the
+``mapInPandas``-shaped scoring closure is a plain iterator-of-pandas
+contract, so the executor-side path is testable without a JVM.
+
+Pattern::
+
+    from mmlspark_tpu.spark import from_spark, score_udf, to_spark
+
+    table = from_spark(spark_df)               # driver: Arrow -> columns
+    model = LightGBMClassifier(...).fit(table) # TPU training
+    scored = spark_df.mapInPandas(             # executors: batched score
+        score_udf(model, result_cols=["probability", "prediction"]),
+        schema="...")
+
+Reference analog: the generated PySpark wrappers + JNI scoring UDFs
+(codegen/PySparkWrapper.scala, lightgbm scoring UDF; expected paths,
+UNVERIFIED).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+
+def spark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def from_spark(df, columns: Optional[List[str]] = None):
+    """PySpark DataFrame → a pandas table our estimators consume.
+
+    Uses Arrow-backed ``toPandas`` (enable
+    ``spark.sql.execution.arrow.pyspark.enabled`` for zero-copy
+    collection).  ``columns`` optionally projects before collecting —
+    always project: the driver materializes what you collect.
+    """
+    if not (hasattr(df, "toPandas") and hasattr(df, "select")):
+        raise TypeError(
+            f"from_spark expects a PySpark DataFrame (got {type(df)!r})")
+    if columns is not None:
+        df = df.select(*columns)
+    return df.toPandas()
+
+
+def to_spark(table, spark):
+    """Pandas/dict table → PySpark DataFrame via ``createDataFrame``.
+
+    Vector columns become plain Python lists (``tolist``): numpy cells
+    break Spark's non-Arrow row-type inference."""
+    import pandas as pd
+    from .core.schema import to_table
+    if not isinstance(table, pd.DataFrame):
+        table = to_table(table).toPandas()
+    table = table.copy()
+    for c in table.columns:
+        first = table[c].iloc[0] if len(table) else None
+        if isinstance(first, np.ndarray):
+            table[c] = [np.asarray(v).tolist() for v in table[c]]
+    return spark.createDataFrame(table)
+
+
+def score_udf(stage, result_cols: Optional[List[str]] = None,
+              passthrough_cols: Optional[List[str]] = None
+              ) -> Callable[[Iterable], Iterator]:
+    """Executor-side scoring closure with the ``mapInPandas`` contract:
+    ``Iterator[pandas.DataFrame] -> Iterator[pandas.DataFrame]``.
+
+    Each executor deserializes the (broadcast-pickled) fitted stage once,
+    then streams batches through ``stage.transform`` on its local jax
+    backend — the analog of the reference's per-executor JNI scoring UDF,
+    minus the per-row JNI calls.  Vector-valued outputs (probability,
+    SHAP) flatten to list columns so they fit a Spark ``array<double>``
+    schema.
+
+    Works with any fitted mmlspark_tpu Transformer/Model; also directly
+    callable on an iterator of pandas frames for Spark-free testing.
+    """
+
+    def fn(batches: Iterable) -> Iterator:
+        import pandas as pd
+        from .core.schema import to_table
+        for pdf in batches:
+            out = stage.transform(pdf)
+            if not isinstance(out, pd.DataFrame):
+                out = to_table(out).toPandas()
+            cols = list(out.columns)
+            if result_cols is not None or passthrough_cols is not None:
+                keep = (passthrough_cols or []) + (result_cols or [])
+                missing = [c for c in keep if c not in cols]
+                if missing:
+                    # fail fast on the driver-visible first batch — a
+                    # schema mismatch otherwise surfaces as an opaque
+                    # Arrow serializer error on the executors
+                    raise KeyError(
+                        f"score_udf: requested columns {missing} not in "
+                        f"transform output; available: {cols}")
+                cols = [c for c in cols if c in keep]
+            yield out[cols]
+
+    return fn

@@ -1,0 +1,121 @@
+"""Spark interop adapter (mmlspark_tpu/spark.py).
+
+pyspark is not installed here, so the tests exercise the Spark-free
+contracts: the ``mapInPandas``-shaped scoring closure on a plain iterator
+of pandas batches, and ``from_spark`` against a duck-typed DataFrame.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mmlspark_tpu import spark as sk
+from mmlspark_tpu.gbdt import LightGBMClassifier
+
+
+@pytest.fixture(scope="module")
+def fitted(rng):
+    X = rng.normal(size=(1500, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    model = LightGBMClassifier(numIterations=10, numLeaves=15,
+                               verbosity=0, parallelism="serial").fit(
+        {"features": X, "label": y})
+    return model, X, y
+
+
+class TestScoreUDF:
+    def test_batched_scoring_matches_direct(self, fitted):
+        model, X, y = fitted
+        fn = sk.score_udf(model, result_cols=["prediction"])
+        batches = [pd.DataFrame({"features": list(X[i:i + 400])})
+                   for i in range(0, len(X), 400)]
+        out = pd.concat(list(fn(iter(batches))), ignore_index=True)
+        direct = np.asarray(
+            model.transform({"features": X})["prediction"])
+        assert (out["prediction"].to_numpy() == direct).all()
+        assert list(out.columns) == ["prediction"]
+
+    def test_vector_outputs_flatten_to_lists(self, fitted):
+        model, X, _ = fitted
+        fn = sk.score_udf(model, result_cols=["probability"])
+        (out,) = list(fn(iter([pd.DataFrame(
+            {"features": list(X[:32])})])))
+        first = out["probability"].iloc[0]
+        assert len(np.asarray(first)) == 2      # array<double> shaped
+
+    def test_passthrough_columns(self, fitted):
+        model, X, _ = fitted
+        fn = sk.score_udf(model, result_cols=["prediction"],
+                          passthrough_cols=["row_id"])
+        pdf = pd.DataFrame({"features": list(X[:16]),
+                            "row_id": np.arange(16)})
+        (out,) = list(fn(iter([pdf])))
+        assert set(out.columns) == {"row_id", "prediction"}
+        assert (out["row_id"].to_numpy() == np.arange(16)).all()
+
+
+class TestDriverSide:
+    def test_from_spark_duck_typed(self):
+        class FakeSparkDF:
+            def __init__(self):
+                self.projected = None
+
+            def select(self, *cols):
+                self.projected = cols
+                return self
+
+            def toPandas(self):
+                return pd.DataFrame({"a": [1.0, 2.0]})
+
+        df = FakeSparkDF()
+        out = sk.from_spark(df, columns=["a"])
+        assert df.projected == ("a",)
+        assert list(out["a"]) == [1.0, 2.0]
+
+    def test_from_spark_rejects_non_spark(self):
+        with pytest.raises(TypeError, match="PySpark"):
+            sk.from_spark({"a": [1]})
+        with pytest.raises(TypeError, match="PySpark"):
+            sk.from_spark({"a": [1]}, columns=["a"])   # guard BEFORE select
+
+    def test_spark_available_is_honest(self):
+        try:
+            import pyspark  # noqa: F401
+            assert sk.spark_available()
+        except ImportError:
+            assert not sk.spark_available()
+
+    def test_score_udf_unknown_column_fails_fast(self, fitted):
+        model, X, _ = fitted
+        fn = sk.score_udf(model, result_cols=["probabilty"])   # typo
+        with pytest.raises(KeyError, match="probabilty"):
+            list(fn(iter([pd.DataFrame({"features": list(X[:8])})])))
+
+    def test_passthrough_without_result_cols(self, fitted):
+        model, X, _ = fitted
+        fn = sk.score_udf(model, passthrough_cols=["row_id"])
+        pdf = pd.DataFrame({"features": list(X[:8]),
+                            "row_id": np.arange(8)})
+        (out,) = list(fn(iter([pdf])))
+        assert list(out.columns) == ["row_id"]
+
+    def test_to_spark_vector_cells_are_plain_lists(self):
+        class FakeSession:
+            def createDataFrame(self, pdf):
+                return pdf
+
+        pdf = sk.to_spark({"x": np.zeros((3, 2)), "y": np.arange(3.0)},
+                          FakeSession())
+        assert isinstance(pdf["x"].iloc[0], list)
+        assert isinstance(pdf["x"].iloc[0][0], float)
+
+    def test_to_spark_dict_conversion(self):
+        class FakeSession:
+            def createDataFrame(self, pdf):
+                return ("df", pdf)
+
+        tag, pdf = sk.to_spark(
+            {"x": np.zeros((3, 2)), "y": np.arange(3.0)}, FakeSession())
+        assert tag == "df"
+        assert list(pdf.columns) == ["x", "y"]
+        assert len(np.asarray(pdf["x"].iloc[0])) == 2
