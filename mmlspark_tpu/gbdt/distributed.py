@@ -76,14 +76,19 @@ def _sharded_cfg(mesh: Mesh, cfg: GrowerConfig) -> GrowerConfig:
 
 
 def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
-                   k1: int, k2: int, amp: float, has_val: bool = False):
+                   k1: int, k2: int, amp: float, has_val: bool = False,
+                   num_class: int = 1):
     """Mesh GOSS: every data shard samples its own top-|g·h| rows plus an
     amplified random remainder (per-machine sampling, exactly like
     distributed LightGBM's boosting=goss), then the sampled sub-shards
     train one tree data-parallel with psum histograms.  ``k1``/``k2`` are
     PER-SHARD row counts; the per-iteration PRNG key is folded with the
-    shard index so shards draw independent remainders."""
+    shard index so shards draw independent remainders.
+
+    ``num_class > 1``: rows rank by the class-summed influence
+    Σ_k |g_k·h_k| and one per-shard sample feeds all K class trees."""
     cfg = _sharded_cfg(mesh, cfg)
+    K = num_class
 
     def steps(bins, scores, labels, weights, real, keys, fis,
               val_bins, val_scores):
@@ -94,10 +99,12 @@ def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
                 key = jax.random.fold_in(
                     key, jax.lax.axis_index(cfg.axis_name))
             g, h = obj.grad_hess(scores, labels, weights)
-            g = g * real
-            h = h * real
+            g = g * (real if K == 1 else real[:, None])
+            h = h * (real if K == 1 else real[:, None])
             n_local = g.shape[0]
-            rank = jnp.argsort(-jnp.abs(g * h))      # pads (0) sort last
+            infl = (jnp.abs(g * h) if K == 1
+                    else jnp.sum(jnp.abs(g * h), axis=1))
+            rank = jnp.argsort(-infl)                # pads (0) sort last
             top_idx = rank[:k1]
             rest = rank[k1:]
             rk = jax.random.uniform(key, (n_local - k1,))
@@ -107,33 +114,61 @@ def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
                 jnp.ones(k1, jnp.float32), jnp.full(k2, amp, jnp.float32)])
             valid = jnp.take(real, idx)
             bins_g = jnp.take(bins, idx, axis=0)
-            gh = jnp.stack([jnp.take(g, idx) * amp_vec,
-                            jnp.take(h, idx) * amp_vec,
-                            valid], axis=1)
-            tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
-            scores = scores + lr * predict_tree_binned(tree, bins,
-                                                       cfg.num_leaves)
-            tree = apply_shrinkage(tree, lr)
+            if K == 1:
+                gh = jnp.stack([jnp.take(g, idx) * amp_vec,
+                                jnp.take(h, idx) * amp_vec,
+                                valid], axis=1)
+                tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
+                scores = scores + lr * predict_tree_binned(
+                    tree, bins, cfg.num_leaves)
+                trees = apply_shrinkage(tree, lr)
+                if has_val:
+                    val_scores = val_scores + predict_tree_binned(
+                        trees, val_bins, cfg.num_leaves)
+            else:
+                trees_k = []
+                for k in range(K):
+                    gh = jnp.stack([jnp.take(g[:, k], idx) * amp_vec,
+                                    jnp.take(h[:, k], idx) * amp_vec,
+                                    valid], axis=1)
+                    tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
+                    scores = scores.at[:, k].add(
+                        lr * predict_tree_binned(tree, bins,
+                                                 cfg.num_leaves))
+                    tree = apply_shrinkage(tree, lr)
+                    if has_val:
+                        val_scores = val_scores.at[:, k].add(
+                            predict_tree_binned(tree, val_bins,
+                                                cfg.num_leaves))
+                    trees_k.append(tree)
+                trees = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *trees_k)
             if has_val:
-                val_scores = val_scores + predict_tree_binned(
-                    tree, val_bins, cfg.num_leaves)
                 out_v = val_scores
             else:
-                out_v = jnp.zeros((0,), jnp.float32)
-            return (scores, val_scores), (tree, out_v)
+                out_v = jnp.zeros((0,) if K == 1 else (0, K), jnp.float32)
+            return (scores, val_scores), (trees, out_v)
 
         (scores, val_scores), (trees, val_hist) = jax.lax.scan(
             body, (scores, val_scores), (keys, fis))
+        if K > 1:
+            trees = jax.tree_util.tree_map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), trees)
         return trees, scores, val_scores, val_hist
 
-    val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
+    sc_spec = P(DATA_AXIS) if K == 1 else P(DATA_AXIS, None)
+    if has_val:
+        val_hist_spec = (P(None, DATA_AXIS) if K == 1
+                         else P(None, DATA_AXIS, None))
+    else:
+        val_hist_spec = P(None, None) if K == 1 else P(None, None, None)
     mapped = jax.shard_map(
         steps, mesh=mesh,
-        in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+        in_specs=(P(DATA_AXIS, FEATURE_AXIS), sc_spec, P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), P(None, None),
                   P(None, FEATURE_AXIS, None),
-                  P(DATA_AXIS, None), P(DATA_AXIS)),
-        out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), val_hist_spec),
+                  P(DATA_AXIS, None), sc_spec),
+        out_specs=(P(), sc_spec, sc_spec, val_hist_spec),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(1, 8))
 
@@ -210,10 +245,14 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
 
 def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
                          lr: float, num_class: int, bag_sharded: bool,
-                         has_val: bool = False, efb=None):
+                         has_val: bool = False, efb=None,
+                         rf: bool = False):
     """Multiclass distributed chunk: grad/hess once per iteration for all K
     trees (LightGBM softmax semantics), K grow steps per scan iteration.
-    Trees come back stacked (C*K, ...), iteration-major."""
+    Trees come back stacked (C*K, ...), iteration-major.
+
+    ``rf``: random-forest mode — trees fit the gradient at the CONSTANT
+    init scores, unshrunk (per-class averaging at export)."""
     cfg = _sharded_cfg(mesh, cfg)
     K = num_class
 
@@ -228,8 +267,10 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
             for k in range(K):
                 gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
                 tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
-                scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
-                tree = apply_shrinkage(tree, lr)
+                if not rf:
+                    scores = scores.at[:, k].add(
+                        lr * tree.leaf_value[row_leaf])
+                    tree = apply_shrinkage(tree, lr)
                 if has_val:
                     val_scores = val_scores.at[:, k].add(
                         predict_tree_binned(tree, val_bins,
@@ -258,6 +299,49 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
                    val_hist_spec),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(1, 8))
+
+
+def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
+                   lr: float):
+    """One dart iteration over a data-only mesh: fit a tree to the gradient
+    at the dropped-out score vector ``s_minus`` (histogram psums over the
+    ``data`` axis inside the grower), returning the replicated lr-shrunk
+    tree and its data-sharded base contribution.  The host applies the
+    1/(k+1) dart normalization and tracks per-tree scales, exactly like
+    the serial path — dropout bookkeeping is tiny host metadata, only the
+    fit and the scoring ride the mesh."""
+    cfg = _sharded_cfg(mesh, cfg)
+
+    def step(bins, s_minus, labels, weights, bag, fi):
+        g, h = obj.grad_hess(s_minus, labels, weights)
+        gh = jnp.stack([g * bag, h * bag, bag], axis=1)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+        tree = apply_shrinkage(tree, lr)
+        b_new = tree.leaf_value[row_leaf]
+        return tree, b_new
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(DATA_AXIS), P(None, None)),
+        out_specs=(P(), P(DATA_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_tree_predict(mesh: Mesh, num_leaves: int):
+    """Replicated-tree scoring of data-sharded binned rows (each shard
+    holds ALL features of its rows) — dart's dropped-tree subtraction and
+    validation scoring under a data mesh."""
+    def pred(tree, bins):
+        return predict_tree_binned(tree, bins, num_leaves)
+
+    mapped = jax.shard_map(
+        pred, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS, None)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False)
+    return jax.jit(mapped)
 
 
 def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,

@@ -164,6 +164,20 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
     return trees, scores, val_scores, val_hist
 
 
+def _dart_draw_drops(dart_rng, n_trees: int, params) -> np.ndarray:
+    """Per-iteration dart dropout draw — ONE shared RNG-stream consumer so
+    the serial and mesh dart loops make bit-identical dropout decisions
+    for the same dropSeed (the serial↔mesh parity contract)."""
+    if n_trees and dart_rng.random() >= params.skip_drop:
+        sel = np.nonzero(dart_rng.random(n_trees) < params.drop_rate)[0]
+        # maxDrop <= 0 means "no limit" (LightGBM max_drop docs)
+        if params.max_drop > 0 and len(sel) > params.max_drop:
+            sel = dart_rng.choice(sel, size=params.max_drop,
+                                  replace=False)
+        return sel
+    return np.zeros(0, np.int64)
+
+
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"))
 def _dart_step(bins, s_minus, labels, weights, bag, fi, obj: Objective,
                cfg: GrowerConfig, lr: float):
@@ -180,16 +194,21 @@ def _dart_step(bins, s_minus, labels, weights, bag, fi, obj: Objective,
 
 @functools.partial(jax.jit,
                    static_argnames=("obj", "cfg", "lr", "k1", "k2", "amp",
-                                    "has_val"),
+                                    "has_val", "K"),
                    donate_argnums=(1, 7))
 def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
                      val_bins, val_scores, obj: Objective, cfg: GrowerConfig,
-                     lr: float, k1: int, k2: int, amp: float, has_val: bool):
+                     lr: float, k1: int, k2: int, amp: float, has_val: bool,
+                     K: int = 1):
     """GOSS chunk: each iteration grows its tree on the top-|g·h| rows plus
     an amplified random sample of the rest (Ke et al. 2017; LightGBM
     boosting=goss).  Histogram work shrinks to ``(topRate + otherRate)·n``
     rows via a gather; scores still update for every row via a full binned
-    traversal of the new tree."""
+    traversal of the new tree.
+
+    ``K > 1`` (multiclass): rows rank by the class-summed influence
+    Σ_k |g_k·h_k| and ONE sample feeds all K per-class trees, matching
+    LightGBM's multiclass GOSS (one sampling pass per iteration)."""
     # pre-gather checks: GOSS hands _grow_tree_impl only the influence
     # SAMPLE, but predict_tree_binned walks the FULL matrix every
     # iteration, and the argsort pushes NaN rows to the sample's tail —
@@ -202,7 +221,9 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
         g, h = obj.grad_hess(scores, labels, weights)
         _debug.check_finite("gradients/hessians", g, h)
         n = g.shape[0]
-        rank = jnp.argsort(-jnp.abs(g * h))          # descending influence
+        infl = (jnp.abs(g * h) if K == 1
+                else jnp.sum(jnp.abs(g * h), axis=1))
+        rank = jnp.argsort(-infl)                    # descending influence
         top_idx = rank[:k1]
         rest = rank[k1:]
         rk = jax.random.uniform(key, (n - k1,))
@@ -211,37 +232,60 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
         amp_vec = jnp.concatenate([
             jnp.ones(k1, jnp.float32), jnp.full(k2, amp, jnp.float32)])
         bins_g = jnp.take(bins, idx, axis=0)
-        gh = jnp.stack([jnp.take(g, idx) * amp_vec,
-                        jnp.take(h, idx) * amp_vec,
-                        jnp.ones(k1 + k2, jnp.float32)], axis=1)
-        tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
-        scores = scores + lr * predict_tree_binned(tree, bins,
-                                                   cfg.num_leaves)
-        tree = apply_shrinkage(tree, lr)
-        if has_val:
-            val_scores = val_scores + predict_tree_binned(
-                tree, val_bins, cfg.num_leaves)
-            out_val = val_scores
+        if K == 1:
+            gh = jnp.stack([jnp.take(g, idx) * amp_vec,
+                            jnp.take(h, idx) * amp_vec,
+                            jnp.ones(k1 + k2, jnp.float32)], axis=1)
+            tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
+            scores = scores + lr * predict_tree_binned(tree, bins,
+                                                       cfg.num_leaves)
+            trees = apply_shrinkage(tree, lr)
+            if has_val:
+                val_scores = val_scores + predict_tree_binned(
+                    trees, val_bins, cfg.num_leaves)
         else:
-            out_val = _dummy_val(1)
-        return (scores, val_scores), (tree, out_val)
+            trees_k = []
+            for k in range(K):
+                gh = jnp.stack([jnp.take(g[:, k], idx) * amp_vec,
+                                jnp.take(h[:, k], idx) * amp_vec,
+                                jnp.ones(k1 + k2, jnp.float32)], axis=1)
+                tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
+                scores = scores.at[:, k].add(
+                    lr * predict_tree_binned(tree, bins, cfg.num_leaves))
+                tree = apply_shrinkage(tree, lr)
+                if has_val:
+                    val_scores = val_scores.at[:, k].add(
+                        predict_tree_binned(tree, val_bins,
+                                            cfg.num_leaves))
+                trees_k.append(tree)
+            trees = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *trees_k)
+        out_val = val_scores if has_val else _dummy_val(K)
+        return (scores, val_scores), (trees, out_val)
 
     (scores, val_scores), (trees, val_hist) = jax.lax.scan(
         body, (scores, val_scores), (keys, fi_stack))
+    if K > 1:
+        trees = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), trees)
     return trees, scores, val_scores, val_hist
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("obj", "cfg", "lr", "K", "has_val"),
+                   static_argnames=("obj", "cfg", "lr", "K", "has_val",
+                                    "rf"),
                    donate_argnums=(1, 7))
 def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
                       val_bins, val_scores, obj: Objective,
                       cfg: GrowerConfig, lr: float, K: int, has_val: bool,
-                      efb=None):
+                      efb=None, rf: bool = False):
     """Multiclass chunk: grad/hess computed ONCE per iteration for all K
     trees (LightGBM softmax semantics), then K grow steps consume the fixed
     gradients.  Emits trees flattened to (C*K, ...), iteration-major,
-    class-minor — the order the model file expects."""
+    class-minor — the order the model file expects.
+
+    ``rf``: random-forest mode — every tree fits the gradient at the
+    CONSTANT init scores, unshrunk (per-class averaging at export)."""
     def body(carry, xs):
         scores, val_scores = carry
         bag, fi = xs
@@ -251,8 +295,10 @@ def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
         for k in range(K):
             gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
             tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
-            scores = scores.at[:, k].add(lr * tree.leaf_value[row_leaf])
-            tree = apply_shrinkage(tree, lr)
+            if not rf:
+                scores = scores.at[:, k].add(
+                    lr * tree.leaf_value[row_leaf])
+                tree = apply_shrinkage(tree, lr)
             if has_val:
                 val_scores = val_scores.at[:, k].add(predict_tree_binned(
                     tree, val_bins, cfg.num_leaves))
@@ -433,10 +479,6 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         if params.boost_from_average and init_scores is None else 0.0
 
     use_voting = params.parallelism == "voting"
-    if use_voting and mapper.has_categorical:
-        raise NotImplementedError(
-            "parallelism='voting' does not support categorical features "
-            "yet; use parallelism='data'")
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
         num_bins=mapper.num_total_bins, lambda_l1=params.lambda_l1,
@@ -464,10 +506,10 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 "boostingType='rf' requires bagging: set "
                 "baggingFraction in (0,1) and baggingFreq > 0 "
                 "(as in LightGBM)")
-        if grad_fn_override is not None or K > 1:
+        if grad_fn_override is not None:
             raise NotImplementedError(
-                "boostingType='rf' currently supports single-model "
-                "objectives (binary/regression)")
+                "boostingType='rf' does not support custom gradient "
+                "objectives (ranking); use boostingType='gbdt'")
     if use_dart:
         if K > 1 or grad_fn_override is not None:
             raise NotImplementedError(
@@ -479,10 +521,10 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 "(dropped-tree rescaling is not invertible by truncation); "
                 "unset earlyStoppingRound")
     if use_goss:
-        if K > 1 or grad_fn_override is not None:
+        if grad_fn_override is not None:
             raise NotImplementedError(
-                "boostingType='goss' currently supports single-model "
-                "objectives (binary/regression)")
+                "boostingType='goss' does not support custom gradient "
+                "objectives (ranking); use boostingType='gbdt'")
         if params.bagging_freq > 0 and params.bagging_fraction < 1.0:
             raise ValueError("Cannot use bagging in GOSS "
                              "(as in LightGBM); unset baggingFraction/"
@@ -525,19 +567,24 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 "custom gradient overrides are not supported with a "
                 "mesh (only lambdarank, which provides ranking_info)")
         if use_dart:
-            raise NotImplementedError(
-                "boostingType='dart' with an explicit mesh is not yet "
-                "supported (per-tree dropout bookkeeping is host-side); "
-                "drop setMesh(...) or use boostingType='gbdt'")
-        if callbacks:
-            raise NotImplementedError(
-                "callbacks are not yet supported with an explicit mesh; "
-                "drop setMesh(...)")
+            from ..core.mesh import FEATURE_AXIS as _FAX
+            if int(mesh.shape[_FAX]) > 1:
+                raise NotImplementedError(
+                    "boostingType='dart' requires a data-only mesh (the "
+                    "dropped-tree score update reads whole feature rows); "
+                    "use parallelism='data' / feature=1")
+            return _train_distributed_dart(
+                bins, labels, w, mapper, objective, params, cfg, mesh,
+                feature_names, init, rng, bag_rng, init_scores,
+                val_bins=val_bins, val_labels=val_labels,
+                val_weights=val_weights, val_metric=val_metric,
+                callbacks=callbacks)
         return _train_distributed(
             bins, labels, w, mapper, objective, params, cfg, mesh,
             feature_names, init, rng, bag_rng, init_scores,
             val_bins=val_bins, val_labels=val_labels,
-            val_weights=val_weights, val_metric=val_metric)
+            val_weights=val_weights, val_metric=val_metric,
+            callbacks=callbacks)
 
     # Exclusive Feature Bundling (serial paths; uint8 bins only — a
     # bundle's encoded width is capped at num_total_bins).  GOSS/dart
@@ -679,15 +726,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                            ).astype(np.float32)
             bag_mask = jnp.asarray(cur_bag)
             fi = jnp.asarray(iter_fi(it))
-            if trees_list and dart_rng.random() >= params.skip_drop:
-                sel = np.nonzero(
-                    dart_rng.random(len(trees_list)) < params.drop_rate)[0]
-                # maxDrop <= 0 means "no limit" (LightGBM max_drop docs)
-                if params.max_drop > 0 and len(sel) > params.max_drop:
-                    sel = dart_rng.choice(sel, size=params.max_drop,
-                                          replace=False)
-            else:
-                sel = np.zeros(0, np.int64)
+            sel = _dart_draw_drops(dart_rng, len(trees_list), params)
             k = len(sel)
             if k:
                 P = scales[sel[0]] * predict_tree_binned(
@@ -740,12 +779,12 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             run_goss = _debug.checked(functools.partial(
                 _boost_scan_goss, obj=objective, cfg=cfg,
                 lr=params.learning_rate, k1=k1, k2=k2, amp=goss_amp,
-                has_val=has_val))
+                has_val=has_val, K=K))
         if K > 1:
             run_multi = _debug.checked(functools.partial(
                 _boost_scan_multi, obj=objective, cfg=cfg,
                 lr=params.learning_rate, K=K, has_val=has_val,
-                efb=efb_dev))
+                efb=efb_dev, rf=use_rf))
         cb_list: List[TreeArrays] = []
         it = 0
         while it < T:
@@ -822,7 +861,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                                         _boost_scan_multi, obj=objective,
                                         cfg=cfg, lr=params.learning_rate,
                                         K=K, has_val=has_val,
-                                        efb=efb_dev))
+                                        efb=efb_dev, rf=use_rf))
                         log.warning(
                             "chunk at iteration %d failed (attempt %d/%d);"
                             " re-uploading state and replaying",
@@ -919,11 +958,6 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         raise NotImplementedError(
             "bagging with sharded ingestion is not yet supported (no "
             "global row order to draw against)")
-    if params.parallelism == "voting" and mapper.has_categorical:
-        raise NotImplementedError(
-            "parallelism='voting' does not support categorical features "
-            "yet; use parallelism='data'")
-
     if any(b is None for b in bins_shards):
         raise NotImplementedError(
             "engine.train's sharded entrypoint is single-controller: all "
@@ -1174,10 +1208,111 @@ def _finalize_booster(trees, K, init, params, objective, mapper,
         max_feature_idx=f - 1, params=engine_params)
 
 
+def _train_distributed_dart(bins, labels, w, mapper, objective, params,
+                            cfg, mesh, feature_names, init, rng, bag_rng,
+                            init_scores, val_bins=None, val_labels=None,
+                            val_weights=None, val_metric=None,
+                            callbacks=None) -> Booster:
+    """Dart boosting over a data-only mesh.
+
+    Dropout bookkeeping (which trees drop, per-tree scales) is host-side
+    RNG over scalars — identical to the serial dart path, so a mesh run
+    with the same dropSeed reproduces the serial ensemble structure.  Only
+    the array work rides the mesh: the grow step (histogram psums inside)
+    via :func:`make_dart_step` and the dropped-tree subtraction via
+    :func:`make_tree_predict` on replicated trees over data-sharded rows.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..core.mesh import DATA_AXIS
+    from .distributed import (make_dart_step, make_tree_predict,
+                              prepare_arrays)
+
+    n, f = bins.shape
+    T = params.num_iterations
+    use_bag = params.bagging_freq > 0 and params.bagging_fraction < 1.0
+    use_ff = params.feature_fraction < 1.0
+    if params.fault_tolerant_retries > 0:
+        log.warning("faultTolerantRetries is inert for boostingType='dart'"
+                    " (per-iteration host loop; no chunk snapshots)")
+
+    bins_np = np.asarray(bins, mapper.bin_dtype)
+    bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
+        bins_np, np.asarray(labels), np.asarray(w, np.float32), mesh, 1,
+        init, init_scores)
+    fi_base = np.zeros((f + fp, 3), np.float32)
+    fi_base[:f] = _feat_info_from_mapper(mapper, f)
+    L = params.num_leaves
+
+    step = make_dart_step(mesh, objective, cfg, params.learning_rate)
+    pred = make_tree_predict(mesh, L)
+
+    # dart rejects early stopping upstream (the dropped-tree rescaling is
+    # not invertible by truncation), so a validation set has nothing to
+    # decide here — val args are accepted for signature parity and ignored,
+    # exactly like the serial dart path's inert metric would be.
+    dart_rng = np.random.default_rng(params.drop_seed)
+    trees_list: List[TreeArrays] = []
+    scales: List[float] = []
+    real_np = np.concatenate([np.ones(n, np.float32),
+                              np.zeros(rp, np.float32)])
+    bag_sh = NamedSharding(mesh, P(DATA_AXIS))
+
+    def upload_bag(mask_n):
+        padded = np.concatenate([mask_n, np.zeros(rp, np.float32)])
+        return jax.device_put(jnp.asarray(padded * real_np), bag_sh)
+
+    bagm = upload_bag(np.ones(n, np.float32))
+    for it in range(T):
+        if use_bag and it % params.bagging_freq == 0:
+            bagm = upload_bag((bag_rng.random(n) < params.bagging_fraction
+                               ).astype(np.float32))
+        if use_ff:
+            fi = jnp.asarray(_draw_feature_fraction(
+                rng, fi_base, f, params.feature_fraction))
+        else:
+            fi = jnp.asarray(fi_base)
+        sel = _dart_draw_drops(dart_rng, len(trees_list), params)
+        k = len(sel)
+        if k:
+            Pd = scales[sel[0]] * pred(trees_list[sel[0]], bins_d)
+            for i in sel[1:]:
+                Pd = Pd + scales[i] * pred(trees_list[i], bins_d)
+            s_minus = scores - Pd
+        else:
+            s_minus = scores
+        tree, b_new = step(bins_d, s_minus, labels_d, w_d, bagm, fi)
+        norm = 1.0 / (k + 1)
+        scores = s_minus + norm * b_new
+        if k:
+            scores = scores + (k * norm) * Pd
+            for i in sel:
+                scales[i] *= k * norm
+        trees_list.append(tree)
+        scales.append(norm)
+        if callbacks:
+            for cb in callbacks:
+                cb(it, trees_list)
+
+    trees_chunks = []
+    if trees_list:
+        trees_chunks = [jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *trees_list)]
+    trees, nls = _fetch_host_trees(trees_chunks, L, mapper)
+    trees, stop_iter = _truncate_no_growth(trees, nls, 1, T,
+                                           params.verbosity)
+    for t, s in zip(trees, scales):
+        t.leaf_value = t.leaf_value * s
+        t.internal_value = t.internal_value * s
+        t.shrinkage = s
+    return _finalize_booster(trees, 1, init, params, objective, mapper,
+                             feature_names, f, stop_iter)
+
+
 def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                        feature_names, init, rng, bag_rng,
                        init_scores=None, val_bins=None, val_labels=None,
-                       val_weights=None, val_metric=None) -> Booster:
+                       val_weights=None, val_metric=None,
+                       callbacks=None) -> Booster:
     """Distributed boosting: the whole iteration loop is ONE shard_mapped
     ``lax.scan`` launch (no per-iteration host round-trips); with a
     validation set the loop chunks and the host replays per-iteration
@@ -1235,18 +1370,14 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     def build_step(efb_arg):
         """(Re)build the shard_mapped chunk program — the fault-tolerance
         replay needs fresh EFB closure constants after a device loss."""
-        if use_goss_m and K == 1:
+        if use_goss_m:
             return make_goss_scan(
                 mesh, objective, cfg, params.learning_rate, k1, k2,
-                goss_amp_m, has_val)
+                goss_amp_m, has_val, num_class=K)
         if K > 1:
-            if use_goss_m or use_rf_m:
-                raise NotImplementedError(
-                    f"boostingType={params.boosting!r} with a mesh "
-                    "currently supports single-model objectives")
             return make_multiclass_scan(
                 mesh, objective, cfg, params.learning_rate, K, use_bag,
-                has_val, efb=efb_arg)
+                has_val, efb=efb_arg, rf=use_rf_m)
         return make_boost_scan(
             mesh, objective, cfg, params.learning_rate, use_bag, has_val,
             rf=use_rf_m, efb=efb_arg)
@@ -1307,6 +1438,10 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         chunk = min(chunk, 64)
     if has_val:
         chunk = min(chunk, max(min(esr, 64), 8) if esr > 0 else 64)
+    if callbacks:
+        # callbacks are a per-iteration host contract: bound the chunk so
+        # the host syncs often enough to replay them in order
+        chunk = min(chunk, 8)
     ftr = params.fault_tolerant_retries
     if ftr > 0:
         # the mesh gang-restart analog (SURVEY.md §5.3): bounded chunks
@@ -1320,6 +1455,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         ft_vb = vb if has_val else None   # already padded
     cur = np.ones(n, np.float32)
     chunks: List[TreeArrays] = []
+    cb_list: List[TreeArrays] = []
     best_metric, best_iter = np.inf, -1
     stop_iter = T
     it = 0
@@ -1346,7 +1482,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
             fi_host = np.broadcast_to(fi_base, (C,) + fi_base.shape)
         fi_stack = jnp.asarray(fi_host)
         def run_step(scores_in, val_scores_in):
-            if use_goss_m and K == 1:
+            if use_goss_m:
                 return step(
                     bins_d, scores_in, labels_d, w_d, real,
                     goss_keys_m[it:it + C], fi_stack, val_bins_d,
@@ -1387,7 +1523,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                     bins_d, labels_d, w_d, real, scores, _, _ = \
                         prepare_arrays(ft_bins, ft_labels, ft_w, mesh, K,
                                        init, init_scores)
-                    if use_goss_m and K == 1:
+                    if use_goss_m:
                         # the PRNG key stack is a device buffer too
                         goss_keys_m = jax.random.split(
                             jax.random.PRNGKey(params.bagging_seed),
@@ -1443,6 +1579,16 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                     stop_iter = best_iter + 1
                     stop = True
                     break
+        if callbacks:
+            # per-iteration host replay, same contract as the serial path:
+            # cb(global_iter, flat list of per-iteration/per-class trees)
+            upto = stop_iter if stop else it + C
+            for j in range(upto - it):
+                for kk in range(K):
+                    cb_list.append(jax.tree_util.tree_map(
+                        lambda a, j=j, kk=kk: a[j * K + kk], trees_st))
+                for cb in callbacks:
+                    cb(it + j, cb_list)
         if stop:
             break
         it += C

@@ -203,11 +203,13 @@ def bin_in_bitset(bits: jnp.ndarray, col: jnp.ndarray) -> jnp.ndarray:
     return ((word >> (col & 31).astype(jnp.uint32)) & 1).astype(bool)
 
 
-def _find_best_cat_split(hist, parent_g, parent_h, parent_c, cat_allowed,
-                         feat_nbins, cfg: GrowerConfig):
-    """Best categorical split: per-feature gradient-ratio-sorted subset scan
-    (LightGBM's Fisher-grouping sorted-histogram search) plus a one-vs-rest
-    scan for low-cardinality features (max_cat_to_onehot)."""
+def _cat_split_gains(hist, parent_g, parent_h, parent_c, cat_allowed,
+                     feat_nbins, cfg: GrowerConfig):
+    """Per-feature categorical split gains: the (f, B) gain matrix plus the
+    sorted-bin order and onehot flags needed to reconstruct the winning
+    left-subset bitset.  Shared by the exact finder and the voting
+    learner's local-vote scoring (which needs per-FEATURE maxima, not the
+    global argmax)."""
     B = hist.shape[1]
     g_b, h_b, c_b = hist[..., 0], hist[..., 1], hist[..., 2]
     # The trailing missing bin (NaN + overflow categories) may never join a
@@ -251,6 +253,17 @@ def _find_best_cat_split(hist, parent_g, parent_h, parent_c, cat_allowed,
     use_onehot = (feat_nbins <= cfg.max_cat_to_onehot)       # (f,)
     gains_cat = jnp.where(use_onehot[:, None], gains_1, gains_s)
     gains_cat = jnp.where(cat_allowed[:, None], gains_cat, -jnp.inf)
+    return gains_cat, order, use_onehot
+
+
+def _find_best_cat_split(hist, parent_g, parent_h, parent_c, cat_allowed,
+                         feat_nbins, cfg: GrowerConfig):
+    """Best categorical split: per-feature gradient-ratio-sorted subset scan
+    (LightGBM's Fisher-grouping sorted-histogram search) plus a one-vs-rest
+    scan for low-cardinality features (max_cat_to_onehot)."""
+    B = hist.shape[1]
+    gains_cat, order, use_onehot = _cat_split_gains(
+        hist, parent_g, parent_h, parent_c, cat_allowed, feat_nbins, cfg)
     flat = gains_cat.reshape(-1)
     idx = jnp.argmax(flat)
     gain = flat[idx]
@@ -367,11 +380,15 @@ def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
     votes are allgathered, and only the globally top-2k voted features'
     histograms are psum-reduced for the exact global decision.
 
-    Numeric features only (the engine guards categorical + voting).
+    Categorical features vote with their local Fisher-grouping gain
+    (:func:`_cat_split_gains`) and, when voted into the candidate set, get
+    the exact sorted-subset search over the psum-reduced candidate
+    histograms — same two-phase shape as the numeric path.
     Returns the same tuple as :func:`find_best_split`.
     """
     f, B = hist_local.shape[0], hist_local.shape[1]
     feature_mask = feat_info[:, 0]
+    is_cat_f = feat_info[:, 1] > 0
     md, mh = cfg.min_data_in_leaf, cfg.min_sum_hessian_in_leaf
 
     def per_feature_gains(hist, pg, ph, pc, mask_cols):
@@ -387,10 +404,19 @@ def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
 
     # 1. local votes: top-k features by local best gain vs local totals
     s_loc = jnp.sum(hist_local[0], axis=0)
+    num_mask = ((feature_mask > 0) & (~is_cat_f if cfg.use_categorical
+                                      else True))
     gains_loc = per_feature_gains(hist_local, s_loc[0], s_loc[1], s_loc[2],
-                                  (feature_mask > 0)[:, None])
+                                  num_mask[:, None])
+    score_f = jnp.max(gains_loc, axis=1)
+    if cfg.use_categorical:
+        cat_allowed = is_cat_f & (feature_mask > 0) & depth_ok
+        gains_cat_loc, _, _ = _cat_split_gains(
+            hist_local, s_loc[0], s_loc[1], s_loc[2], cat_allowed,
+            feat_info[:, 2], cfg)
+        score_f = jnp.maximum(score_f, jnp.max(gains_cat_loc, axis=1))
     k = min(cfg.voting_k, f)
-    _, votes = jax.lax.top_k(jnp.max(gains_loc, axis=1), k)
+    _, votes = jax.lax.top_k(score_f, k)
     votes_all = jax.lax.all_gather(votes, cfg.axis_name)        # (S, k)
     counts = jnp.zeros(f, jnp.int32).at[votes_all.reshape(-1)].add(1)
     # 2. global candidates: top-2k by vote count (feature id tie-break
@@ -401,15 +427,27 @@ def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
     # 3. exact decision over the psum-reduced candidate histograms
     hist_cand = jax.lax.psum(hist_local[cand], cfg.axis_name)   # (k2, B, 3)
     gains_cand = per_feature_gains(hist_cand, parent_g, parent_h, parent_c,
-                                   (feature_mask[cand] > 0)[:, None])
+                                   num_mask[cand][:, None])
     flat = gains_cand.reshape(-1)
     idx = jnp.argmax(flat)
     best_gain = flat[idx]
     feat = cand[(idx // B).astype(jnp.int32)]
     b = (idx % B).astype(jnp.int32)
+    is_cat = jnp.asarray(0, jnp.int32)
+    cat_bits = jnp.zeros(cfg.cat_words, jnp.uint32)
+    if cfg.use_categorical:
+        cat_gain, cat_feat_loc, _, cat_bits_w = _find_best_cat_split(
+            hist_cand, parent_g, parent_h, parent_c, cat_allowed[cand],
+            feat_info[cand, 2], cfg)
+        cat_wins = cat_gain > best_gain
+        best_gain = jnp.maximum(best_gain, cat_gain)
+        feat = jnp.where(cat_wins, cand[cat_feat_loc], feat)
+        b = jnp.where(cat_wins, 0, b)
+        is_cat = cat_wins.astype(jnp.int32)
+        cat_bits = jnp.where(cat_wins, cat_bits_w, cat_bits)
     gain_ok = best_gain > jnp.maximum(cfg.min_gain_to_split, EPS_GAIN)
-    return (jnp.where(gain_ok, best_gain, -jnp.inf), feat, b,
-            jnp.asarray(0, jnp.int32), jnp.zeros(cfg.cat_words, jnp.uint32))
+    return (jnp.where(gain_ok, best_gain, -jnp.inf), feat, b, is_cat,
+            cat_bits)
 
 
 def _bucket_sizes(n: int, cfg: GrowerConfig):
