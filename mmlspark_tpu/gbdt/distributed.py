@@ -405,7 +405,8 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
 
 def prepare_arrays_from_shards(bins_shards, label_shards, weight_shards,
                                mesh: Mesh, num_class: int, init: float,
-                               bin_dtype, shard_rows=None, _piece_spy=None):
+                               bin_dtype, shard_rows=None,
+                               init_score_shards=None, _piece_spy=None):
     """Multi-host ingestion (SURVEY.md §7 hard part 4): assemble the global
     sharded training arrays from PER-SHARD inputs without materializing the
     full matrix on any single host.
@@ -496,14 +497,22 @@ def prepare_arrays_from_shards(bins_shards, label_shards, weight_shards,
     real_d = make(P(DATA_AXIS), np.float32, 0.0,
                   lambda d: np.ones(sizes[d], np.float32))
     # scores ride the callback path too — no transient global array on any
-    # single device (the arrays this function exists to avoid)
+    # single device (the arrays this function exists to avoid); per-shard
+    # init scores (initScoreCol) offset the local slice, pad rows keep the
+    # plain init (their weight is zero anyway)
+    def score_shard(d):
+        if init_score_shards is None or init_score_shards[d] is None:
+            base = np.full(sizes[d], init, np.float32)
+        else:
+            base = init + np.asarray(init_score_shards[d], np.float32)
+        return base if num_class == 1 else \
+            np.broadcast_to(base[:, None], (sizes[d], num_class))
+
     if num_class > 1:
-        scores = make(P(DATA_AXIS, None), np.float32, init,
-                      lambda d: np.full((S, num_class), init, np.float32),
+        scores = make(P(DATA_AXIS, None), np.float32, init, score_shard,
                       width=num_class)
     else:
-        scores = make(P(DATA_AXIS), np.float32, init,
-                      lambda d: np.full(S, init, np.float32))
+        scores = make(P(DATA_AXIS), np.float32, init, score_shard)
     rp = n_global - sum(sizes)
     return bins_d, lab_d, w_d, real_d, scores, rp, f_padded - f
 

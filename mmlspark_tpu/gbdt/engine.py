@@ -457,13 +457,16 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     ``bins`` may also be a LIST of per-shard binned matrices (with
     ``labels``/``weights`` lists to match) for multi-host ingestion: each
     data shard's rows go straight to its mesh slice with no global
-    materialization (SURVEY.md §7 hard part 4; requires ``mesh``, plain
-    gbdt, no validation/bagging/callbacks).
+    materialization (SURVEY.md §7 hard part 4; requires ``mesh``;
+    supports validation/early stopping, per-machine bagging, callbacks,
+    init scores, goss and rf — ranking and dart stay monolithic).
     """
     if isinstance(bins, (list, tuple)):
         return _train_distributed_sharded(
             bins, labels, weights, mapper, objective, params, mesh,
-            feature_names, val_bins=val_bins, callbacks=callbacks,
+            feature_names, val_bins=val_bins, val_labels=val_labels,
+            val_weights=val_weights, val_metric=val_metric,
+            callbacks=callbacks,
             grad_fn_override=grad_fn_override, init_scores=init_scores,
             ranking_info=ranking_info)
     n, f = bins.shape
@@ -933,51 +936,67 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
 
 def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
                                mapper, objective, params, mesh,
-                               feature_names, val_bins=None, callbacks=None,
-                               grad_fn_override=None, init_scores=None,
+                               feature_names, val_bins=None, val_labels=None,
+                               val_weights=None, val_metric=None,
+                               callbacks=None, grad_fn_override=None,
+                               init_scores=None,
                                ranking_info=None) -> Booster:
     """Multi-host mesh training from per-shard inputs: each data shard's
     rows feed its own mesh slice via ``make_array_from_callback`` — the
     full binned matrix never exists on one host (SURVEY.md §7 hard part
-    4; the reference's per-executor Dataset construction)."""
-    from .distributed import make_boost_scan, make_multiclass_scan, \
-        prepare_arrays_from_shards
+    4; the reference's per-executor Dataset construction).
 
+    Supports the full chunked mesh loop via ``_train_distributed``'s
+    ``shard_data`` path: validation/early stopping (the validation set is
+    assumed host-small and arrives monolithic), per-machine bagging,
+    callbacks, per-shard init scores, goss and rf.  Still gated: ranking
+    (query packing needs a global sort) and dart (host loop keeps full
+    prediction rows).  ``init_scores`` may be a per-shard LIST or one
+    array in shard-concatenation order."""
     if mesh is None:
         raise ValueError("sharded input requires a mesh (setMesh or "
                          "multi-device default)")
-    if (val_bins is not None or callbacks or grad_fn_override is not None
-            or init_scores is not None or ranking_info is not None):
+    if grad_fn_override is not None or ranking_info is not None:
         raise NotImplementedError(
-            "sharded ingestion supports plain distributed gbdt only "
-            "(no validation, callbacks, ranking, or init scores yet)")
-    if params.boosting != "gbdt":
+            "sharded ingestion does not support ranking objectives yet "
+            "(query packing needs a global per-query sort); pass "
+            "monolithic arrays for lambdarank")
+    if params.boosting == "dart":
         raise NotImplementedError(
-            "sharded ingestion requires boostingType='gbdt'")
-    if params.bagging_freq > 0 and params.bagging_fraction < 1.0:
-        raise NotImplementedError(
-            "bagging with sharded ingestion is not yet supported (no "
-            "global row order to draw against)")
+            "sharded ingestion does not support boostingType='dart' "
+            "(the dart host loop scores full prediction rows); pass "
+            "monolithic arrays")
     if any(b is None for b in bins_shards):
         raise NotImplementedError(
             "engine.train's sharded entrypoint is single-controller: all "
             "shard slots must be present (a multi-controller deployment "
             "calls prepare_arrays_from_shards with None slots + "
-            "shard_rows and drives the scan steps directly)")
+            "shard_rows and drives the scan steps directly; see "
+            "tests/test_multicontroller.py)")
     K = objective.num_model_per_iteration
-    T = params.num_iterations
     rng = np.random.default_rng(params.seed)
-    f = bins_shards[0].shape[1]
+    bag_rng = np.random.default_rng(params.bagging_seed)
     if weight_shards is None:
         weight_shards = [np.ones(b.shape[0], np.float64)
                          for b in bins_shards]
+    sizes = [b.shape[0] for b in bins_shards]
     # objective statistics need the global label/weight vectors — 1-D and
     # tiny relative to bins, which is what must never be concatenated
     y_global = np.concatenate([np.asarray(y) for y in label_shards])
     w_global = np.concatenate([np.asarray(w) for w in weight_shards])
     objective.prepare(y_global, w_global)
+    if init_scores is not None:
+        if isinstance(init_scores, (list, tuple)):
+            init_score_shards = list(init_scores)
+        else:
+            offs = np.cumsum([0] + sizes)
+            init_score_shards = [
+                np.asarray(init_scores)[offs[d]:offs[d + 1]]
+                for d in range(len(sizes))]
+    else:
+        init_score_shards = None
     init = objective.init_score(y_global, w_global) \
-        if params.boost_from_average else 0.0
+        if params.boost_from_average and init_scores is None else 0.0
 
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
@@ -992,41 +1011,17 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         max_cat_threshold=params.max_cat_threshold,
         max_cat_to_onehot=params.max_cat_to_onehot)
 
-    bins_d, labels_d, w_d, real, scores, rp, fp = \
-        prepare_arrays_from_shards(
-            bins_shards, label_shards, weight_shards, mesh, K, init,
-            mapper.bin_dtype)
-    f_padded = f + fp
-    fi_base = np.zeros((f_padded, 3), np.float32)
-    fi_base[:f] = _feat_info_from_mapper(mapper, f)
-    if params.feature_fraction < 1.0:
-        fi_stack = jnp.asarray(np.stack([
-            _draw_feature_fraction(rng, fi_base, f,
-                                   params.feature_fraction)
-            for _ in range(T)]))
-    else:
-        fi_stack = jnp.asarray(np.broadcast_to(fi_base,
-                                               (T,) + fi_base.shape))
-    bags = jnp.ones((T, 1), jnp.float32)
-    dummy_vb = jnp.zeros((int(mesh.shape["data"]), f), mapper.bin_dtype)
-    dummy_vs = jnp.zeros(
-        (int(mesh.shape["data"]), K) if K > 1
-        else (int(mesh.shape["data"]),), jnp.float32)
-
-    if K > 1:
-        step = make_multiclass_scan(mesh, objective, cfg,
-                                    params.learning_rate, K, False)
-    else:
-        step = make_boost_scan(mesh, objective, cfg,
-                               params.learning_rate, False)
-    trees_st, scores, _, _ = step(bins_d, scores, labels_d, w_d, real,
-                                  bags, fi_stack, dummy_vb, dummy_vs)
-
-    trees, nls = _fetch_host_trees([trees_st], params.num_leaves, mapper)
-    trees, stop_iter = _truncate_no_growth(trees, nls, K, T,
-                                           params.verbosity)
-    return _finalize_booster(trees, K, init, params, objective, mapper,
-                             feature_names, f, stop_iter)
+    return _train_distributed(
+        None, None, None, mapper, objective, params, cfg, mesh,
+        feature_names, init, rng, bag_rng,
+        val_bins=val_bins, val_labels=val_labels,
+        val_weights=val_weights, val_metric=val_metric,
+        callbacks=callbacks,
+        shard_data={"bins_shards": list(bins_shards),
+                    "label_shards": list(label_shards),
+                    "weight_shards": list(weight_shards),
+                    "sizes": sizes,
+                    "init_score_shards": init_score_shards})
 
 
 def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
@@ -1312,18 +1307,38 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                        feature_names, init, rng, bag_rng,
                        init_scores=None, val_bins=None, val_labels=None,
                        val_weights=None, val_metric=None,
-                       callbacks=None) -> Booster:
+                       callbacks=None, shard_data=None) -> Booster:
     """Distributed boosting: the whole iteration loop is ONE shard_mapped
     ``lax.scan`` launch (no per-iteration host round-trips); with a
     validation set the loop chunks and the host replays per-iteration
-    metrics for early stopping, exactly like the serial path."""
+    metrics for early stopping, exactly like the serial path.
+
+    ``shard_data``: multi-host ingestion (SURVEY.md §7 hard part 4) — a
+    dict of per-shard inputs (``bins_shards``/``label_shards``/
+    ``weight_shards``/``sizes``/``init_score_shards``) that feed the mesh
+    through ``prepare_arrays_from_shards`` so the global binned matrix is
+    never materialized; ``bins`` is then ignored.  Bagging masks scatter
+    to each shard's padded slice (per-machine bagging, as distributed
+    LightGBM), and the fault-tolerance replay re-runs the same per-shard
+    upload."""
     from .distributed import (make_boost_scan, make_goss_scan,
-                              make_multiclass_scan, prepare_arrays)
+                              make_multiclass_scan, prepare_arrays,
+                              prepare_arrays_from_shards)
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..core.mesh import DATA_AXIS, FEATURE_AXIS, pad_to_multiple
 
-    n, f = bins.shape
+    if shard_data is not None:
+        sizes = list(shard_data["sizes"])
+        S_sh = max(sizes)
+        n = sum(sizes)
+        f = shard_data["bins_shards"][0].shape[1]
+        # positions of real rows inside the (D*S,) padded global layout
+        real_pos = np.concatenate(
+            [d * S_sh + np.arange(s) for d, s in enumerate(sizes)])
+        n_padded = len(sizes) * S_sh
+    else:
+        n, f = bins.shape
     K = objective.num_model_per_iteration
     T = params.num_iterations
     esr = params.early_stopping_round
@@ -1339,7 +1354,8 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                 "sampled-tree score update reads whole feature rows); "
                 "use parallelism='data' / feature=1")
         dn_pre = int(mesh.shape[DATA_AXIS])
-        s_local = pad_to_multiple(n, dn_pre) // dn_pre  # rows per shard
+        s_local = (S_sh if shard_data is not None
+                   else pad_to_multiple(n, dn_pre) // dn_pre)
         k1 = max(1, int(np.ceil(s_local * params.top_rate)))
         k2 = max(1, int(np.ceil(s_local * params.other_rate)))
         if k1 + k2 >= s_local:
@@ -1361,7 +1377,8 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     if params.enable_bundle and not mapper.has_categorical \
             and mapper.num_total_bins <= 256 \
             and int(mesh.shape[FEATURE_AXIS]) == 1 \
-            and cfg.voting_k == 0 and not use_goss_m:
+            and cfg.voting_k == 0 and not use_goss_m \
+            and shard_data is None:  # EFB plans need the full host matrix
         efb_dev_m, efb_host_m, bundled = _build_efb(
             bins, mapper, params, f, verbosity_tag=" (mesh)")
         if efb_dev_m is not None:
@@ -1383,11 +1400,25 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
             rf=use_rf_m, efb=efb_arg)
 
     step = build_step(efb_dev_m)
-    bins_np = np.asarray(bins, mapper.bin_dtype)
-    labels_np = np.asarray(labels)
-    w_np = np.asarray(w, np.float32)
-    bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
-        bins_np, labels_np, w_np, mesh, K, init, init_scores)
+    if shard_data is not None:
+        def prep_arrays():
+            return prepare_arrays_from_shards(
+                shard_data["bins_shards"], shard_data["label_shards"],
+                shard_data["weight_shards"], mesh, K, init,
+                mapper.bin_dtype,
+                init_score_shards=shard_data.get("init_score_shards"))
+    else:
+        bins_np = np.asarray(bins, mapper.bin_dtype)
+        labels_np = np.asarray(labels)
+        w_np = np.asarray(w, np.float32)
+
+        def prep_arrays():
+            return prepare_arrays(bins_np, labels_np, w_np, mesh, K, init,
+                                  init_scores)
+    bins_d, labels_d, w_d, real, scores, rp, fp = prep_arrays()
+    if shard_data is None:
+        real_pos = np.arange(n)
+        n_padded = n + rp
     f_padded = f + fp
 
     # feat_info stays per ORIGINAL feature under EFB (histograms expand
@@ -1445,13 +1476,11 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     ftr = params.fault_tolerant_retries
     if ftr > 0:
         # the mesh gang-restart analog (SURVEY.md §5.3): bounded chunks
-        # bound the replay; host copies make full re-upload possible when
-        # a failure kills every device buffer in the gang.  The converted
-        # host arrays from dataset prep are reused — no second copy.
+        # bound the replay; the replay re-runs prep_arrays(), which closes
+        # over the host inputs (monolithic arrays or per-host shards), so
+        # a failure that kills every device buffer in the gang re-uploads
+        # from the same source — no second host copy.
         chunk = min(chunk, 32)
-        ft_bins = bins_np
-        ft_labels = labels_np
-        ft_w = w_np
         ft_vb = vb if has_val else None   # already padded
     cur = np.ones(n, np.float32)
     chunks: List[TreeArrays] = []
@@ -1466,10 +1495,14 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
             for j in range(C):
                 if (it + j) % params.bagging_freq == 0:
                     # draw exactly n randoms so the stream matches a
-                    # serial run with the same baggingSeed, then pad
+                    # serial run with the same baggingSeed, then scatter
+                    # into the padded layout (pad rows stay 0; under
+                    # sharded ingestion real rows sit per-shard slice)
                     cur = (bag_rng.random(n) < params.bagging_fraction
                            ).astype(np.float32)
-                rows.append(np.concatenate([cur, np.zeros(rp, np.float32)]))
+                row = np.zeros(n_padded, np.float32)
+                row[real_pos] = cur
+                rows.append(row)
             bags_host = np.stack(rows)
             bags = jax.device_put(jnp.asarray(bags_host),
                                   NamedSharding(mesh, P(None, DATA_AXIS)))
@@ -1521,8 +1554,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                         "%d/%d); re-uploading the gang's inputs and "
                         "replaying", it, attempt + 1, ftr)
                     bins_d, labels_d, w_d, real, scores, _, _ = \
-                        prepare_arrays(ft_bins, ft_labels, ft_w, mesh, K,
-                                       init, init_scores)
+                        prep_arrays()
                     if use_goss_m:
                         # the PRNG key stack is a device buffer too
                         goss_keys_m = jax.random.split(

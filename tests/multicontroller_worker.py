@@ -1,0 +1,96 @@
+"""Multi-controller ingestion worker (driven by test_multicontroller.py).
+
+Each process owns ONE data shard and passes ``None`` in every other slot of
+``prepare_arrays_from_shards`` — the configuration a real multi-host
+deployment (Criteo-1TB class, SURVEY.md §7 hard part 4) runs, where no
+host ever sees another host's rows.  Run modes:
+
+* ``multi``:  2 OS processes x 1 CPU device, ``jax.distributed``
+  rendezvous over localhost — a faithful miniature of multi-host TPU.
+* ``single``: 1 process x 2 virtual devices, all slots present — the
+  reference output the multi-controller run must reproduce.
+"""
+
+import sys
+
+
+def main():
+    mode, port, pid, outdir = (sys.argv[1], sys.argv[2], int(sys.argv[3]),
+                               sys.argv[4])
+    import os
+    n_local_dev = 1 if mode == "multi" else 2
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_local_dev}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if mode == "multi":
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=2, process_id=pid)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from mmlspark_tpu.core.mesh import DATA_AXIS, FEATURE_AXIS
+    from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+    from mmlspark_tpu.gbdt.distributed import (make_boost_scan,
+                                               prepare_arrays_from_shards)
+    from mmlspark_tpu.gbdt.engine import _feat_info_from_mapper
+    from mmlspark_tpu.gbdt.grower import GrowerConfig
+    from mmlspark_tpu.gbdt.objectives import get_objective
+
+    # Deterministic data every controller can regenerate from the seed; a
+    # real deployment reads per-host files instead.  Each process BINS
+    # ONLY ITS OWN SHARD (the bin bounds come from a shared mapper fit,
+    # like the reference's distributed bin-bound sync).
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(401, 6)).astype(np.float32)   # odd on purpose
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.2 * X[:, 2] > 0).astype(np.float64)
+    mapper = fit_bin_mapper(X, max_bin=31)
+    shard_idx = [np.arange(190), np.arange(190, 401)]  # unequal shards
+    shard_rows = [len(i) for i in shard_idx]
+
+    devs = np.asarray(jax.devices()).reshape(2, 1)
+    mesh = Mesh(devs, (DATA_AXIS, FEATURE_AXIS))
+
+    slots_b = [None, None]
+    slots_l = [None, None]
+    slots_w = [None, None]
+    owned = [pid] if mode == "multi" else [0, 1]
+    for d in owned:
+        my = shard_idx[d]
+        slots_b[d] = mapper.transform_packed(X[my])
+        slots_l[d] = y[my]
+        slots_w[d] = np.ones(len(my), np.float64)
+
+    bins_d, lab_d, w_d, real, scores, rp, fp = prepare_arrays_from_shards(
+        slots_b, slots_l, slots_w, mesh, 1, 0.0, mapper.bin_dtype,
+        shard_rows=shard_rows)
+
+    obj = get_objective("binary")
+    obj.prepare(y, np.ones(len(y)))   # global stats are tiny metadata
+    cfg = GrowerConfig(num_leaves=7, max_depth=-1,
+                       num_bins=mapper.num_total_bins, min_data_in_leaf=5)
+    T, f = 4, X.shape[1]
+    step = make_boost_scan(mesh, obj, cfg, 0.1, False)
+    fi = np.broadcast_to(_feat_info_from_mapper(mapper, f), (T, f, 3))
+    bags = jnp.ones((T, 1), jnp.float32)
+    dummy_vb = jnp.zeros((2, f + fp), mapper.bin_dtype)
+    dummy_vs = jnp.zeros((2,), jnp.float32)
+    trees, scores, _, _ = step(bins_d, scores, lab_d, w_d, real, bags,
+                               jnp.asarray(fi), dummy_vb, dummy_vs)
+    jax.block_until_ready(trees)
+
+    if pid == 0:
+        # trees are replicated (out_specs P()), so process 0's local
+        # shard holds the full stacked forest
+        np.savez(os.path.join(outdir, f"forest_{mode}.npz"),
+                 split_feature=np.asarray(jax.device_get(
+                     trees.node_feat)),
+                 leaf_value=np.asarray(jax.device_get(trees.leaf_value)))
+        print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
