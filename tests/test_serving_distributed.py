@@ -11,7 +11,14 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu.io.serving import (DistributedHTTPServer, HTTPServer,
+                                     MultiprocessHTTPServer,
                                      reply_from_table, request_table)
+
+
+def _make_server(kind, num_workers=3, reply_timeout=30.0):
+    cls = (DistributedHTTPServer if kind == "threads"
+           else MultiprocessHTTPServer)
+    return cls(num_workers=num_workers, reply_timeout=reply_timeout)
 
 
 def _post(addr, payload, timeout=10.0):
@@ -23,10 +30,12 @@ def _post(addr, payload, timeout=10.0):
 
 
 class TestDistributedServing:
-    def test_cross_worker_reply_routing(self):
+    @pytest.mark.parametrize("kind", ["threads", "processes"])
+    def test_cross_worker_reply_routing(self, kind):
         """Requests parked on DIFFERENT workers arrive in one shared batch
-        and every reply finds its own worker's socket."""
-        srv = DistributedHTTPServer(num_workers=3).start()
+        and every reply finds its own worker's socket — whether workers
+        are threads in one process or separate OS processes."""
+        srv = _make_server(kind).start()
         try:
             results = {}
             threads = []
@@ -53,11 +62,12 @@ class TestDistributedServing:
         finally:
             srv.stop()
 
-    def test_concurrent_clients_race_microbatch_boundaries(self):
+    @pytest.mark.parametrize("kind", ["threads", "processes"])
+    def test_concurrent_clients_race_microbatch_boundaries(self, kind):
         """30 concurrent clients across 3 workers, driver draining in
         batches of 4: every client must receive exactly its own answer
         (no lost, swapped, or duplicated replies)."""
-        srv = DistributedHTTPServer(num_workers=3).start()
+        srv = _make_server(kind).start()
         stop = threading.Event()
 
         def driver():
@@ -134,5 +144,43 @@ class TestDistributedServing:
             srv.reply(batch[0][0], {"ok": batch[0][1]["v"]})
             t.join(5)
             assert out == {"ok": 7}
+        finally:
+            srv.stop()
+
+
+    def test_multiprocess_timeout_504_and_late_reply_false(self):
+        """Worker-side timeout across a PROCESS boundary: the client gets
+        504 from the worker process, and the driver's late reply()
+        reports undelivered (the socket owner decides atomically)."""
+        srv = MultiprocessHTTPServer(num_workers=1,
+                                     reply_timeout=0.5).start()
+        try:
+            got = {}
+
+            def client():
+                try:
+                    _post(srv.addresses[0], {"x": 1}, timeout=10)
+                    got["status"] = 200
+                except urllib.error.HTTPError as e:
+                    got["status"] = e.code
+
+            t = threading.Thread(target=client)
+            t.start()
+            batch = srv.get_batch(max_rows=1, timeout=5.0)
+            assert len(batch) == 1
+            rid = batch[0][0]
+            t.join(10)
+            assert got["status"] == 504
+            assert srv.reply(rid, {"y": 1}) is False
+        finally:
+            srv.stop()
+
+    def test_multiprocess_workers_are_real_processes(self):
+        srv = MultiprocessHTTPServer(num_workers=2).start()
+        try:
+            import os
+            pids = {p.pid for p in srv._procs}
+            assert len(pids) == 2 and os.getpid() not in pids
+            assert all(p.is_alive() for p in srv._procs)
         finally:
             srv.stop()
