@@ -1,0 +1,116 @@
+"""Fit-time HBM budget for GBDT training (BASELINE config 5 scale guard).
+
+The reference streams rows through LightGBM's C++ histogram pools and can
+page; an XLA program cannot — every array in the jitted boost step must
+fit HBM simultaneously, so a Criteo-class configuration (numLeaves=255,
+maxBin=255, tens of millions of rows) must be budgeted BEFORE the first
+compile, not discovered as a device OOM after minutes of tracing.
+(Reference expected paths: LightGBM histogram pool sizing in
+src/treelearner/serial_tree_learner.cpp, UNVERIFIED; SURVEY.md §7.)
+
+The model below counts the resident arrays of one device's shard for the
+dominant training path (the DataPartition grower inside the chunked
+scan), plus the largest transient the bucket-ladder compaction
+materializes.  It deliberately over-counts slightly (gradients and their
+gh-stack both appear) — a guard that errs a few percent high beats an
+OOM at iteration 40.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def estimate_fit_bytes(n_local: int, num_features: int, num_bins: int,
+                       num_leaves: int, num_class: int = 1,
+                       chunk: int = 64, bin_itemsize: int = 1,
+                       bagging: bool = False, n_val_local: int = 0,
+                       min_bucket: int = 2048) -> Dict[str, int]:
+    """Per-device resident-bytes breakdown for one training fit.
+
+    ``n_local``: this device's row count (global rows / data-mesh size).
+    Returns a dict of named costs plus ``"total"``.
+    """
+    n, f, B, L, K, C = (n_local, num_features, num_bins, num_leaves,
+                        num_class, chunk)
+    costs: Dict[str, int] = {}
+    costs["bins"] = n * f * bin_itemsize
+    # scores + labels + weights + real/bag mask + row_order
+    costs["row_vectors"] = n * 4 * (K + 4)
+    # grad/hess (n, K) each + the (n, 3) gh stack the grower consumes
+    costs["gradients"] = n * 4 * (2 * K + 3)
+    # per-leaf histogram state: (L, f, B, 3) f32
+    costs["leaf_hist"] = L * f * B * 3 * 4
+    # largest compaction bucket: one (2^ceil(lg n), f) bins gather plus
+    # its (size, 3) gh gather — the transient peak of _segment_hist
+    n_pow = 1 << (n - 1).bit_length() if n > 1 else 1
+    bucket = max(min_bucket, n_pow)
+    costs["bucket_transient"] = bucket * (f * bin_itemsize + 12)
+    # stacked per-chunk trees (C*K trees x ~14 L-sized f32/i32 fields)
+    costs["chunk_trees"] = C * K * L * 14 * 4
+    if bagging:
+        costs["bag_masks"] = C * n * 4
+    if n_val_local:
+        costs["validation"] = n_val_local * (f * bin_itemsize
+                                             + 4 * K * (C + 1))
+    costs["total"] = sum(costs.values())
+    return costs
+
+
+def device_capacity_bytes() -> Optional[int]:
+    """This device's usable memory, or None when unknown.
+
+    ``MMLSPARK_TPU_HBM_BYTES`` overrides (also how tests pin a tiny
+    budget); TPU backends report ``bytes_limit`` via ``memory_stats``;
+    CPU reports nothing and the guard stays advisory.
+    """
+    env = os.environ.get("MMLSPARK_TPU_HBM_BYTES")
+    if env:
+        return int(float(env))
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 - backend without memory_stats
+        pass
+    return None
+
+
+def check_fit_budget(n_local: int, num_features: int, num_bins: int,
+                     num_leaves: int, num_class: int = 1, chunk: int = 64,
+                     bin_itemsize: int = 1, bagging: bool = False,
+                     n_val_local: int = 0, data_shards: int = 1,
+                     verbosity: int = 1) -> Dict[str, int]:
+    """Estimate, log, and fail FAST when the fit cannot fit.
+
+    Raises ``MemoryError`` with the breakdown and concrete remediations
+    (more data shards, smaller maxBin/numLeaves) instead of letting XLA
+    OOM after a long compile.  Returns the breakdown.
+    """
+    costs = estimate_fit_bytes(
+        n_local, num_features, num_bins, num_leaves, num_class, chunk,
+        bin_itemsize, bagging, n_val_local)
+    cap = device_capacity_bytes()
+    if verbosity > 0:
+        import logging
+        logging.getLogger("mmlspark_tpu.gbdt").info(
+            "fit memory budget: %.2f GB/device estimated%s",
+            costs["total"] / 1e9,
+            "" if cap is None else f" of {cap / 1e9:.2f} GB available")
+    if cap is not None and costs["total"] > cap:
+        detail = ", ".join(f"{k}={v / 1e9:.2f}GB"
+                           for k, v in costs.items() if k != "total")
+        need_shards = int(np.ceil(costs["total"] / cap * data_shards))
+        raise MemoryError(
+            f"GBDT fit needs ~{costs['total'] / 1e9:.2f} GB per device "
+            f"({detail}) but only {cap / 1e9:.2f} GB is available. "
+            f"Remedies: shard rows over a larger data mesh (>= "
+            f"{need_shards} shards at this scale), lower maxBin "
+            f"(uint8 bins at <=255), lower numLeaves, or reduce "
+            f"baggingFreq chunking. Set MMLSPARK_TPU_HBM_BYTES to "
+            f"override the detected capacity.")
+    return costs

@@ -554,6 +554,19 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
 
     use_mesh = mesh is not None and int(np.prod(
         [mesh.shape[a] for a in mesh.axis_names])) > 1
+    # scale guard (BASELINE config 5): estimate per-device HBM before the
+    # first compile and fail fast with remediation if the fit can't fit
+    from .budget import check_fit_budget
+    _dn = (int(mesh.shape["data"]) if use_mesh else 1)
+    check_fit_budget(
+        n_local=-(-n // _dn), num_features=f,
+        num_bins=mapper.num_total_bins, num_leaves=params.num_leaves,
+        num_class=K, chunk=min(64, params.num_iterations),
+        bin_itemsize=np.dtype(mapper.bin_dtype).itemsize,
+        bagging=params.bagging_freq > 0 and params.bagging_fraction < 1.0,
+        n_val_local=(-(-val_bins.shape[0] // _dn)
+                     if val_bins is not None else 0),
+        data_shards=_dn, verbosity=params.verbosity)
     if use_mesh:
         if ranking_info is not None:
             if use_goss or use_dart or use_rf:
@@ -1011,6 +1024,16 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         max_cat_threshold=params.max_cat_threshold,
         max_cat_to_onehot=params.max_cat_to_onehot)
 
+    from .budget import check_fit_budget
+    check_fit_budget(
+        n_local=max(sizes), num_features=bins_shards[0].shape[1],
+        num_bins=mapper.num_total_bins, num_leaves=params.num_leaves,
+        num_class=K, chunk=min(64, params.num_iterations),
+        bin_itemsize=np.dtype(mapper.bin_dtype).itemsize,
+        bagging=params.bagging_freq > 0 and params.bagging_fraction < 1.0,
+        n_val_local=(-(-val_bins.shape[0] // int(mesh.shape["data"]))
+                     if val_bins is not None else 0),
+        data_shards=int(mesh.shape["data"]), verbosity=params.verbosity)
     return _train_distributed(
         None, None, None, mapper, objective, params, cfg, mesh,
         feature_names, init, rng, bag_rng,
