@@ -438,7 +438,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
           callbacks: Optional[List[Callable]] = None,
           mesh=None,
           init_scores: Optional[np.ndarray] = None,
-          ranking_info: Optional[Dict] = None) -> Booster:
+          ranking_info: Optional[Dict] = None,
+          shard_rows: Optional[List[int]] = None) -> Booster:
     """Train a forest.  ``bins``: (n, f) int32 pre-binned features.
 
     ``grad_fn_override``: optional ``(scores) -> (g, h)`` replacing the
@@ -468,7 +469,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             val_weights=val_weights, val_metric=val_metric,
             callbacks=callbacks,
             grad_fn_override=grad_fn_override, init_scores=init_scores,
-            ranking_info=ranking_info)
+            ranking_info=ranking_info, shard_rows=shard_rows)
     n, f = bins.shape
     K = objective.num_model_per_iteration
     rng = np.random.default_rng(params.seed)
@@ -952,8 +953,8 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
                                feature_names, val_bins=None, val_labels=None,
                                val_weights=None, val_metric=None,
                                callbacks=None, grad_fn_override=None,
-                               init_scores=None,
-                               ranking_info=None) -> Booster:
+                               init_scores=None, ranking_info=None,
+                               shard_rows=None) -> Booster:
     """Multi-host mesh training from per-shard inputs: each data shard's
     rows feed its own mesh slice via ``make_array_from_callback`` — the
     full binned matrix never exists on one host (SURVEY.md §7 hard part
@@ -980,19 +981,33 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
             "(the dart host loop scores full prediction rows); pass "
             "monolithic arrays")
     if any(b is None for b in bins_shards):
-        raise NotImplementedError(
-            "engine.train's sharded entrypoint is single-controller: all "
-            "shard slots must be present (a multi-controller deployment "
-            "calls prepare_arrays_from_shards with None slots + "
-            "shard_rows and drives the scan steps directly; see "
-            "tests/test_multicontroller.py)")
+        # multi-controller: each controller passes None for slots other
+        # hosts own; shard_rows (tiny global metadata) sizes them, and
+        # the 1-D label/weight lists must be COMPLETE on every
+        # controller (global objective statistics need them; they are
+        # metadata-sized next to bins)
+        if shard_rows is None:
+            raise ValueError(
+                "multi-controller sharded training (None bins slots) "
+                "requires shard_rows — the global per-shard row counts")
+        if any(y is None for y in label_shards):
+            raise ValueError(
+                "label_shards must be complete on every controller "
+                "(labels are 1-D metadata; allgather them, e.g. "
+                "jax.experimental.multihost_utils.process_allgather)")
     K = objective.num_model_per_iteration
     rng = np.random.default_rng(params.seed)
     bag_rng = np.random.default_rng(params.bagging_seed)
     if weight_shards is None:
-        weight_shards = [np.ones(b.shape[0], np.float64)
-                         for b in bins_shards]
-    sizes = [b.shape[0] for b in bins_shards]
+        weight_shards = [None if y is None else
+                         np.ones(len(y), np.float64)
+                         for y in label_shards]
+    sizes = (list(shard_rows) if shard_rows is not None
+             else [b.shape[0] for b in bins_shards])
+    if any(w is None for w in weight_shards):
+        raise ValueError(
+            "weight_shards must be complete on every controller (1-D "
+            "metadata, like labels)")
     # objective statistics need the global label/weight vectors — 1-D and
     # tiny relative to bins, which is what must never be concatenated
     y_global = np.concatenate([np.asarray(y) for y in label_shards])
@@ -1025,8 +1040,9 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         max_cat_to_onehot=params.max_cat_to_onehot)
 
     from .budget import check_fit_budget
+    f_sh = next(b.shape[1] for b in bins_shards if b is not None)
     check_fit_budget(
-        n_local=max(sizes), num_features=bins_shards[0].shape[1],
+        n_local=max(sizes), num_features=f_sh,
         num_bins=mapper.num_total_bins, num_leaves=params.num_leaves,
         num_class=K, chunk=min(64, params.num_iterations),
         bin_itemsize=np.dtype(mapper.bin_dtype).itemsize,
@@ -1044,6 +1060,7 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
                     "label_shards": list(label_shards),
                     "weight_shards": list(weight_shards),
                     "sizes": sizes,
+                    "shard_rows": shard_rows,
                     "init_score_shards": init_score_shards})
 
 
@@ -1355,7 +1372,8 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         sizes = list(shard_data["sizes"])
         S_sh = max(sizes)
         n = sum(sizes)
-        f = shard_data["bins_shards"][0].shape[1]
+        f = next(b.shape[1] for b in shard_data["bins_shards"]
+                 if b is not None)
         # positions of real rows inside the (D*S,) padded global layout
         real_pos = np.concatenate(
             [d * S_sh + np.arange(s) for d, s in enumerate(sizes)])
@@ -1429,6 +1447,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                 shard_data["bins_shards"], shard_data["label_shards"],
                 shard_data["weight_shards"], mesh, K, init,
                 mapper.bin_dtype,
+                shard_rows=shard_data.get("shard_rows"),
                 init_score_shards=shard_data.get("init_score_shards"))
     else:
         bins_np = np.asarray(bins, mapper.bin_dtype)

@@ -1,0 +1,45 @@
+"""Barrier-task worker for test_spark_adapter.py's executor-side
+training test: runs mmlspark_tpu.spark.executor_train_fn exactly as a
+Spark barrier task would, in a real separate OS process."""
+
+import sys
+
+
+def main():
+    port, task_index, num_tasks, outdir = (sys.argv[1], int(sys.argv[2]),
+                                           int(sys.argv[3]), sys.argv[4])
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import pandas as pd
+
+    from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+    from mmlspark_tpu.gbdt.engine import TrainParams
+    from mmlspark_tpu.spark import executor_train_fn
+
+    # deterministic table all tasks can regenerate; each keeps ITS
+    # partition only (Spark would hand each barrier task its partition)
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(500, 7)).astype(np.float64)
+    y = (X[:, 0] - 0.7 * X[:, 3] > 0).astype(np.float64)
+    mapper = fit_bin_mapper(X, max_bin=31)     # driver-side, on a sample
+    cut = 230                                  # unequal partitions
+    part = slice(0, cut) if task_index == 0 else slice(cut, 500)
+    pdf = pd.DataFrame({"features": list(X[part]), "label": y[part]})
+
+    fn = executor_train_fn(
+        mapper, TrainParams(num_iterations=5, num_leaves=7,
+                            min_data_in_leaf=5, verbosity=0),
+        num_tasks, f"127.0.0.1:{port}")
+    out = list(fn(task_index, iter([pdf])))
+    if task_index == 0:
+        with open(os.path.join(outdir, "model.txt"), "w") as fh:
+            fh.write(out[0]["model"].iloc[0])
+        print("TASK0_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

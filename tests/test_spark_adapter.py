@@ -5,6 +5,7 @@ contracts: the ``mapInPandas``-shaped scoring closure on a plain iterator
 of pandas batches, and ``from_spark`` against a duck-typed DataFrame.
 """
 
+import os
 import numpy as np
 import pandas as pd
 import pytest
@@ -119,3 +120,65 @@ class TestDriverSide:
         assert tag == "df"
         assert list(pdf.columns) == ["x", "y"]
         assert len(np.asarray(pdf["x"].iloc[0])) == 2
+
+
+class TestExecutorSideTraining:
+    """Executor-side training (VERDICT r3 next #7): the barrier-task
+    closure trains INSIDE separate worker processes via None-slot sharded
+    ingestion — the reference's executors-train deployment shape — and
+    must reproduce a driver-side fit of the same data."""
+
+    def test_barrier_tasks_train_and_match_driver_side(self, tmp_path):
+        import socket
+        import subprocess
+        import sys
+
+        import numpy as np
+
+        port_s = socket.socket()
+        port_s.bind(("127.0.0.1", 0))
+        port = port_s.getsockname()[1]
+        port_s.close()
+        worker = os.path.join(os.path.dirname(__file__),
+                              "executor_train_worker.py")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(port), str(i), "2",
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for i in range(2)]
+        outs = [p.communicate(timeout=540) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"barrier task failed:\n{err[-3000:]}"
+        assert "TASK0_OK" in outs[0][0]
+
+        # driver-side reference on the same data / same bin bounds
+        from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+        from mmlspark_tpu.gbdt.booster import Booster
+        from mmlspark_tpu.gbdt.engine import TrainParams, train
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(500, 7)).astype(np.float64)
+        y = (X[:, 0] - 0.7 * X[:, 3] > 0).astype(np.float64)
+        mapper = fit_bin_mapper(X, max_bin=31)
+        import jax
+        from jax.sharding import Mesh
+
+        from mmlspark_tpu.core.mesh import DATA_AXIS, FEATURE_AXIS
+        mesh2 = Mesh(np.asarray(jax.devices()[:2]).reshape(2, 1),
+                     (DATA_AXIS, FEATURE_AXIS))
+        ref = train([mapper.transform_packed(X[:230]),
+                     mapper.transform_packed(X[230:])],
+                    [y[:230], y[230:]], None, mapper,
+                    get_objective("binary"),
+                    TrainParams(num_iterations=5, num_leaves=7,
+                                min_data_in_leaf=5, verbosity=0),
+                    mesh=mesh2)
+        executor_model = Booster.load_native_model_string(
+            open(os.path.join(str(tmp_path), "model.txt")).read())
+        np.testing.assert_allclose(
+            executor_model.predict_margin(X), ref.predict_margin(X),
+            rtol=2e-3, atol=1e-5)
