@@ -7,6 +7,9 @@ extensions compiled on first use with the in-image toolchain:
 * ``fastio.cc``  — directory scan / bulk parallel file read / murmur3.
 * ``fastbin.cc`` — the BinMapper quantization inner loop
   (``bin_columns``), the single-core-hostile part of dataset prep.
+* ``fasthist_ffi.cc`` — XLA FFI custom-call gradient-histogram kernel
+  for the CPU backend's GBDT hot loop (``hist_ffi_handler``), compiled
+  against jaxlib's bundled ``xla/ffi/api`` headers.
 
 Public surface:
 
@@ -82,6 +85,54 @@ def available() -> bool:
 
 def bin_columns_available() -> bool:
     return _load("_fastbin", "fastbin.cc") is not None
+
+
+_FFI_LIB = None
+
+
+def _build_ffi(src_name: str, stem: str) -> bool:
+    """Compile an XLA FFI shared lib against jaxlib's bundled headers."""
+    src = os.path.join(_HERE, src_name)
+    # ".bin", not ".so": a bare .so in the package dir would be picked up
+    # as a CPython extension module by pkgutil walkers (it isn't one)
+    out = os.path.join(_HERE, f"{stem}.bin")
+    try:
+        import jax.ffi
+        ffi_inc = jax.ffi.include_dir()
+    except Exception:  # noqa: BLE001 - ancient jax
+        return False
+    for cxx in ("g++", "c++", "clang++"):
+        try:
+            proc = subprocess.run(
+                [cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+                 f"-I{ffi_inc}", src, "-o", out],
+                capture_output=True, text=True, timeout=180)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode == 0:
+            return True
+    return False
+
+
+def hist_ffi_handler():
+    """ctypes function pointer for the XLA FFI histogram custom call
+    (fasthist_ffi.cc), or None when the lib can't build/load.  Callers
+    wrap it with ``jax.ffi.pycapsule`` and register under platform
+    "cpu"."""
+    global _FFI_LIB
+    if _FFI_LIB is None:
+        _FFI_LIB = False
+        if not os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+            path = os.path.join(_HERE, "fasthist_ffi.bin")
+            if os.path.exists(path) or _build_ffi("fasthist_ffi.cc",
+                                                  "fasthist_ffi"):
+                import ctypes
+                try:
+                    _FFI_LIB = ctypes.cdll.LoadLibrary(path)
+                except OSError:
+                    _FFI_LIB = False
+    return getattr(_FFI_LIB, "MmlsparkFastHist", None) \
+        if _FFI_LIB else None
 
 
 def bin_columns(X, bext, nb, base, lo, scale, use_table, missing_bin,

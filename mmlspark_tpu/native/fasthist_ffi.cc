@@ -1,0 +1,66 @@
+// XLA FFI custom-call gradient-histogram kernel (CPU backend).
+//
+// The first cut of the native CPU histogram used jax.pure_callback, which
+// deadlocks on this box: the single-core XLA CPU runtime's worker waits
+// on the Python callback while the callback waits for the runtime (seen
+// as a stuck second fit in bench.py --force-cpu).  An XLA FFI custom
+// call runs synchronously INSIDE the compiled program on the executing
+// thread — no Python, no cross-thread handshake — and is the idiomatic
+// native-kernel seam jax provides for exactly this.
+//
+// Same accumulation loop as LightGBM's ConstructHistograms
+// (src/io/dense_bin.hpp; expected path, UNVERIFIED — SURVEY.md §3.1):
+// one row pass, three fused adds per row-feature into an L2-resident
+// (f, B, 3) float32 accumulator.  Masked rows (g == h == c == 0) skip.
+//
+// Built header-only against jaxlib's bundled xla/ffi/api headers; loaded
+// with ctypes and registered via jax.ffi.pycapsule (no pybind11 in this
+// image).
+
+#include <algorithm>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error HistImpl(ffi::Buffer<ffi::U8> bins,
+                           ffi::Buffer<ffi::F32> gh,
+                           ffi::ResultBuffer<ffi::F32> out) {
+  auto bd = bins.dimensions();
+  if (bd.size() != 2 || gh.dimensions().size() != 2 ||
+      out->dimensions().size() != 3) {
+    return ffi::Error::InvalidArgument(
+        "fasthist: need bins (n,f) u8, gh (n,3) f32, out (f,B,3) f32");
+  }
+  const int64_t n = bd[0];
+  const int64_t f = bd[1];
+  const int64_t B = out->dimensions()[1];
+  const uint8_t* b = bins.typed_data();
+  const float* g = gh.typed_data();
+  float* o = out->typed_data();
+  std::fill(o, o + f * B * 3, 0.f);
+  for (int64_t i = 0; i < n; ++i) {
+    const float gi = g[3 * i];
+    const float hi = g[3 * i + 1];
+    const float ci = g[3 * i + 2];
+    if (gi == 0.f && hi == 0.f && ci == 0.f) continue;  // masked row
+    const uint8_t* br = b + i * f;
+    for (int64_t j = 0; j < f; ++j) {
+      int64_t bin = br[j];
+      if (bin >= B) bin = B - 1;  // safety clamp; mapper guarantees < B
+      float* cell = o + (j * B + bin) * 3;
+      cell[0] += gi;
+      cell[1] += hi;
+      cell[2] += ci;
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    MmlsparkFastHist, HistImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U8>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
