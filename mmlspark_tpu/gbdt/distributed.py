@@ -205,6 +205,8 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
 
     def steps(bins, scores, labels, weights, real, bags, fis,
               val_bins, val_scores):
+        binsT = bins.T   # fit-invariant; hoisted out of the scan
+
         def body(carry, xs):
             scores, val_scores = carry
             bag, fi = xs
@@ -214,7 +216,8 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
             # efb rides the closure: the (f, B)-sized maps replicate as
             # baked constants; per-feature expansion happens SHARD-LOCAL
             # before the psum (expansion is linear, so it commutes)
-            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb,
+                                             binsT=binsT)
             if not rf:
                 scores = scores + lr * tree.leaf_value[row_leaf]
                 tree = apply_shrinkage(tree, lr)
@@ -258,6 +261,8 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
 
     def steps(bins, scores, labels, weights, real, bags, fis,
               val_bins, val_scores):
+        binsT = bins.T   # fit-invariant; hoisted out of the scan
+
         def body(carry, xs):
             scores, val_scores = carry
             bag, fi = xs
@@ -266,7 +271,8 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
             trees_k = []
             for k in range(K):
                 gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
-                tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
+                tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb,
+                                                 binsT=binsT)
                 if not rf:
                     scores = scores.at[:, k].add(
                         lr * tree.leaf_value[row_leaf])
@@ -312,18 +318,19 @@ def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
     fit and the scoring ride the mesh."""
     cfg = _sharded_cfg(mesh, cfg)
 
-    def step(bins, s_minus, labels, weights, bag, fi):
+    def step(bins, binsT, s_minus, labels, weights, bag, fi):
         g, h = obj.grad_hess(s_minus, labels, weights)
         gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
         tree = apply_shrinkage(tree, lr)
         b_new = tree.leaf_value[row_leaf]
         return tree, b_new
 
     mapped = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(DATA_AXIS), P(None, None)),
+        in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(None, None)),
         out_specs=(P(), P(DATA_AXIS)),
         check_vma=False)
     return jax.jit(mapped)
@@ -365,6 +372,7 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
     def steps(bins, scores, real, wmul, qidx, qmask, gains, labq, invmax,
               fis, val_bins, val_scores):
         nl = scores.shape[0]
+        binsT = bins.T   # fit-invariant; hoisted out of the scan
 
         def body(carry, fi):
             scores, val_scores = carry
@@ -374,7 +382,8 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
             # wmul = row weight * validity (LightGBM ranker weightCol
             # semantics); the count channel carries plain validity
             gh = jnp.stack([g * wmul, h * wmul, real], axis=1)
-            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg,
+                                             binsT=binsT)
             scores = scores + lr * tree.leaf_value[row_leaf]
             tree = apply_shrinkage(tree, lr)
             if has_val:

@@ -139,13 +139,16 @@ def _boost_scan(bins, scores, labels, weights, bag_masks, fi_stack,
     is the TPU-shaped analog of the reference keeping the whole iteration
     loop behind one JNI call (SURVEY.md §3.1).
     """
+    binsT = bins.T   # fit-invariant; hoisted out of the scan (PERF.md r4)
+
     def body(carry, xs):
         scores, val_scores = carry
         bag, fi = xs
         bag = jnp.broadcast_to(bag, scores.shape)
         g, h = obj.grad_hess(scores, labels, weights)
         gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb,
+                                         binsT=binsT)
         if not rf:
             # rf (random forest): every tree fits the gradient at the
             # CONSTANT init scores, unshrunk; averaging happens at export
@@ -179,14 +182,15 @@ def _dart_draw_drops(dart_rng, n_trees: int, params) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"))
-def _dart_step(bins, s_minus, labels, weights, bag, fi, obj: Objective,
-               cfg: GrowerConfig, lr: float):
+def _dart_step(bins, binsT, s_minus, labels, weights, bag, fi,
+               obj: Objective, cfg: GrowerConfig, lr: float):
     """One dart iteration body: fit a tree to the gradient at the dropped-
     out score vector; returns the lr-shrunk tree and its base contribution
-    (the host applies the 1/(k+1) dart normalization)."""
+    (the host applies the 1/(k+1) dart normalization).  ``binsT`` is the
+    fit-invariant transpose, computed once by the caller."""
     g, h = obj.grad_hess(s_minus, labels, weights)
     gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-    tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg)
+    tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
     tree = apply_shrinkage(tree, lr)
     b_new = tree.leaf_value[row_leaf]
     return tree, b_new
@@ -286,6 +290,8 @@ def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
 
     ``rf``: random-forest mode — every tree fits the gradient at the
     CONSTANT init scores, unshrunk (per-class averaging at export)."""
+    binsT = bins.T   # fit-invariant; hoisted out of the scan (PERF.md r4)
+
     def body(carry, xs):
         scores, val_scores = carry
         bag, fi = xs
@@ -294,7 +300,8 @@ def _boost_scan_multi(bins, scores, labels, weights, bag_masks, fi_stack,
         trees_k = []
         for k in range(K):
             gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
-            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb,
+                                             binsT=binsT)
             if not rf:
                 scores = scores.at[:, k].add(
                     lr * tree.leaf_value[row_leaf])
@@ -734,6 +741,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         dart_rng = np.random.default_rng(params.drop_seed)
         run_dart = _debug.checked(functools.partial(
             _dart_step, obj=objective, cfg=cfg, lr=params.learning_rate))
+        binsT_d = jnp.transpose(bins_d)   # fit-invariant, once per fit
         trees_list = []
         scales: List[float] = []
         L_steps = params.num_leaves
@@ -754,8 +762,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 s_minus = scores - P
             else:
                 s_minus = scores
-            tree, b_new = run_dart(bins_d, s_minus, labels_d, weights_d,
-                                   bag_mask, fi)
+            tree, b_new = run_dart(bins_d, binsT_d, s_minus, labels_d,
+                                   weights_d, bag_mask, fi)
             norm = 1.0 / (k + 1)
             scores = s_minus + norm * b_new
             if k:
@@ -1280,6 +1288,7 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
 
     step = make_dart_step(mesh, objective, cfg, params.learning_rate)
     pred = make_tree_predict(mesh, L)
+    binsT_d = jnp.transpose(bins_d)   # fit-invariant, once per fit
 
     # dart rejects early stopping upstream (the dropped-tree rescaling is
     # not invertible by truncation), so a validation set has nothing to
@@ -1315,7 +1324,8 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
             s_minus = scores - Pd
         else:
             s_minus = scores
-        tree, b_new = step(bins_d, s_minus, labels_d, w_d, bagm, fi)
+        tree, b_new = step(bins_d, binsT_d, s_minus, labels_d, w_d,
+                           bagm, fi)
         norm = 1.0 / (k + 1)
         scores = s_minus + norm * b_new
         if k:

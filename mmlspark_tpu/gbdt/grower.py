@@ -513,11 +513,18 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
     """Histogram the contiguous ``row_order[off:off+cnt]`` segment via the
     smallest power-of-two bucket gather.  Local (no psum) — the caller
     reduces over the data axis, keeping collectives out of switch
-    branches."""
+    branches.  On the CPU backend the gather fuses into the native FFI
+    kernel (no (size, f) materialization)."""
+    from ..ops.histogram import native_segment_hist
 
     def make(size):
         def fn(_):
             seg = jax.lax.dynamic_slice(row_order, (off,), (size,))
+            if cfg.hist_method in ("auto", "native"):
+                fused = native_segment_hist(bins, gh, seg, cnt,
+                                            cfg.num_bins)
+                if fused is not None:
+                    return fused
             valid = jnp.arange(size, dtype=jnp.int32) < cnt
             rows = jnp.minimum(seg, n - 1)
             b_sub = jnp.take(bins, rows, axis=0)
@@ -598,7 +605,8 @@ def make_feat_info(f: int, feature_mask=None, is_cat=None, nbins=None):
     return out
 
 
-def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None):
+def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
+                    binsT=None):
     # debug-mode invariants (no-ops unless the calling program is
     # checkified): every training path funnels through here, so corrupt
     # bins / non-finite gradients are caught regardless of entry point
@@ -615,9 +623,12 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None):
     neg_inf = jnp.float32(-jnp.inf)
     # Transposed copy for split-column reads: a column of row-major (n, f)
     # is a stride-f gather (slow on TPU); a row of (f, n) is one contiguous
-    # dynamic-slice.  Loop-invariant, so XLA hoists it out of scanned boost
-    # loops.
-    binsT = bins.T
+    # dynamic-slice.  It is loop-invariant across the whole FIT, not just
+    # this tree — XLA does NOT hoist it out of scanned boost loops (a
+    # 48 ms/tree transpose at bench scale on CPU), so the scan builders
+    # precompute it once and pass it in; the default covers direct calls.
+    if binsT is None:
+        binsT = bins.T
 
     hist0 = _hist(bins, gh, cfg, efb)
     g0, h0, c0 = _global_totals(*_totals_from_hist(hist0), cfg)
@@ -681,7 +692,15 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None):
         gain = state.best_gain[l]
         do_split = gain > neg_inf
 
-        def do(state: _GrowState) -> _GrowState:
+        # The step body runs UNCONDITIONALLY with its effects gated by
+        # ``do_split`` (see the merge at the end) instead of under
+        # ``lax.cond``: XLA materializes copies of the untouched carry
+        # buffers at every cond join, and the (L, f, B, 3) leaf_hist made
+        # that ~half the per-split cost at bench scale (PERF.md round 4).
+        # Inactive steps neutralize themselves: the partition/histogram
+        # run with cnt forced to 0 (identity permutation, empty segment),
+        # and every state write merges through ``ds``.
+        def do(state: _GrowState, ds) -> _GrowState:
             feat = state.best_feat[l]
             thr = state.best_bin[l]
             new_id = (i + 1).astype(jnp.int32)
@@ -713,7 +732,7 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None):
                 # the psum-reduced partials compose); sibling by
                 # subtraction.
                 off = state.leaf_start[l]
-                cnt = state.leaf_cnt[l]
+                cnt = jnp.where(ds, state.leaf_cnt[l], 0)
                 use_cat = state.best_is_cat[l] > 0
                 row_order, cnt_l_p, cnt_r_p = _partition_switch(
                     state.row_order, col, off, cnt, thr, use_cat,
@@ -743,7 +762,7 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None):
                 leaf_cnt = state.leaf_cnt.at[l].set(cnt_l_p) \
                                          .at[new_id].set(cnt_r_p)
             else:
-                in_leaf = state.row_leaf == l
+                in_leaf = (state.row_leaf == l) & ds
                 if cfg.use_categorical:
                     go_left_val = jnp.where(
                         state.best_is_cat[l] > 0,
@@ -807,8 +826,12 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None):
                 row_order=row_order,
                 leaf_start=leaf_start,
                 leaf_cnt=leaf_cnt,
-                leaf_hist=state.leaf_hist.at[l].set(hist_l)
-                                         .at[new_id].set(hist_r),
+                # slice-gated: a full-buffer where() would re-traverse the
+                # (L, f, B, 3) state — exactly the copy being avoided
+                leaf_hist=state.leaf_hist
+                    .at[l].set(jnp.where(ds, hist_l, state.leaf_hist[l]))
+                    .at[new_id].set(jnp.where(ds, hist_r,
+                                              state.leaf_hist[new_id])),
                 leaf_g=state.leaf_g.at[l].set(g_l).at[new_id].set(g_r),
                 leaf_h=state.leaf_h.at[l].set(h_l).at[new_id].set(h_r),
                 leaf_c=state.leaf_c.at[l].set(c_l).at[new_id].set(c_r),
@@ -831,7 +854,17 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None):
                 tree=tree,
             )
 
-        return jax.lax.cond(do_split, do, lambda s: s, state)
+        new_state = do(state, do_split)
+        big = ("row_leaf", "row_order", "leaf_hist")
+        merged = {}
+        for name in _GrowState._fields:
+            nv, ov = getattr(new_state, name), getattr(state, name)
+            if name in big:   # self-neutralizing or slice-gated above
+                merged[name] = nv
+            else:             # L-sized (or smaller) — cheap full where
+                merged[name] = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(do_split, a, b), nv, ov)
+        return _GrowState(**merged)
 
     state = jax.lax.fori_loop(0, L - 1, split_step, state)
     if cfg.compact_rows:

@@ -114,11 +114,7 @@ def _build_ffi(src_name: str, stem: str) -> bool:
     return False
 
 
-def hist_ffi_handler():
-    """ctypes function pointer for the XLA FFI histogram custom call
-    (fasthist_ffi.cc), or None when the lib can't build/load.  Callers
-    wrap it with ``jax.ffi.pycapsule`` and register under platform
-    "cpu"."""
+def _ffi_lib():
     global _FFI_LIB
     if _FFI_LIB is None:
         _FFI_LIB = False
@@ -131,8 +127,22 @@ def hist_ffi_handler():
                     _FFI_LIB = ctypes.cdll.LoadLibrary(path)
                 except OSError:
                     _FFI_LIB = False
-    return getattr(_FFI_LIB, "MmlsparkFastHist", None) \
-        if _FFI_LIB else None
+    return _FFI_LIB
+
+
+def hist_ffi_handler():
+    """ctypes function pointer for the XLA FFI histogram custom call
+    (fasthist_ffi.cc), or None when the lib can't build/load.  Callers
+    wrap it with ``jax.ffi.pycapsule`` and register under platform
+    "cpu"."""
+    lib = _ffi_lib()
+    return getattr(lib, "MmlsparkFastHist", None) if lib else None
+
+
+def hist_gather_ffi_handler():
+    """Fused gather+histogram FFI handler (leaf-segment hot path)."""
+    lib = _ffi_lib()
+    return getattr(lib, "MmlsparkFastHistGather", None) if lib else None
 
 
 def bin_columns(X, bext, nb, base, lo, scale, use_table, missing_bin,

@@ -64,3 +64,63 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Arg<ffi::Buffer<ffi::U8>>()
         .Arg<ffi::Buffer<ffi::F32>>()
         .Ret<ffi::Buffer<ffi::F32>>());
+
+// Fused gather + histogram: the DataPartition grower's per-split hot
+// path histograms a leaf's contiguous row_order segment.  XLA's version
+// materializes the gathered (size, f) sub-matrix in memory before the
+// histogram reads it back; here the row indirection happens in the
+// accumulation loop itself (PERF.md round-3 headroom note: the bucket
+// gather costs as much as the histogram).  ``seg`` is the bucket-sized
+// index slice, ``cnt`` (1,) i32 the number of live leaf rows at its
+// head.
+static ffi::Error HistGatherImpl(ffi::Buffer<ffi::U8> bins,
+                                 ffi::Buffer<ffi::F32> gh,
+                                 ffi::Buffer<ffi::S32> seg,
+                                 ffi::Buffer<ffi::S32> cnt,
+                                 ffi::ResultBuffer<ffi::F32> out) {
+  auto bd = bins.dimensions();
+  if (bd.size() != 2 || gh.dimensions().size() != 2 ||
+      seg.dimensions().size() != 1 || out->dimensions().size() != 3) {
+    return ffi::Error::InvalidArgument(
+        "fasthist_gather: need bins (n,f) u8, gh (n,3) f32, seg (m,) "
+        "i32, cnt (1,) i32, out (f,B,3) f32");
+  }
+  const int64_t n = bd[0];
+  const int64_t f = bd[1];
+  const int64_t m = seg.dimensions()[0];
+  const int64_t B = out->dimensions()[1];
+  const uint8_t* b = bins.typed_data();
+  const float* g = gh.typed_data();
+  const int32_t* s = seg.typed_data();
+  int64_t live = cnt.typed_data()[0];
+  if (live > m) live = m;
+  float* o = out->typed_data();
+  std::fill(o, o + f * B * 3, 0.f);
+  for (int64_t i = 0; i < live; ++i) {
+    int64_t row = s[i];
+    if (row < 0 || row >= n) continue;  // pad sentinel
+    const float gi = g[3 * row];
+    const float hi = g[3 * row + 1];
+    const float ci = g[3 * row + 2];
+    if (gi == 0.f && hi == 0.f && ci == 0.f) continue;  // bagged out
+    const uint8_t* br = b + row * f;
+    for (int64_t j = 0; j < f; ++j) {
+      int64_t bin = br[j];
+      if (bin >= B) bin = B - 1;
+      float* cell = o + (j * B + bin) * 3;
+      cell[0] += gi;
+      cell[1] += hi;
+      cell[2] += ci;
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    MmlsparkFastHistGather, HistGatherImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U8>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());

@@ -66,21 +66,44 @@ _NATIVE_OK: Optional[bool] = None
 
 
 def _native_available() -> bool:
-    """Whether the XLA FFI histogram custom call is registered (CPU)."""
+    """Whether the XLA FFI histogram custom calls are registered (CPU)."""
     global _NATIVE_OK
     if _NATIVE_OK is None:
         _NATIVE_OK = False
         try:
             from .. import native
             handler = native.hist_ffi_handler()
-            if handler is not None:
+            gather = native.hist_gather_ffi_handler()
+            if handler is not None and gather is not None:
                 jax.ffi.register_ffi_target(
                     "mmlspark_fasthist", jax.ffi.pycapsule(handler),
+                    platform="cpu")
+                jax.ffi.register_ffi_target(
+                    "mmlspark_fasthist_gather", jax.ffi.pycapsule(gather),
                     platform="cpu")
                 _NATIVE_OK = True
         except Exception:  # noqa: BLE001 - no toolchain / old jax
             _NATIVE_OK = False
     return _NATIVE_OK
+
+
+def native_segment_hist(bins, gh, seg, cnt, num_bins):
+    """Fused gather+histogram of ``bins[seg[:cnt]]`` via the FFI kernel,
+    or None when the native CPU path doesn't apply — callers fall back to
+    gather + :func:`compute_histogram`.  ``seg``: (m,) int32 row indices,
+    ``cnt``: () int32 live count at the head of ``seg``.  This removes
+    the gathered (m, f) materialization XLA's version writes and re-reads
+    (PERF.md round-3 headroom: the bucket gather cost matched the
+    histogram's)."""
+    if num_bins > 256 or jax.default_backend() != "cpu" \
+            or not _native_available():
+        return None
+    f = bins.shape[1]
+    return jax.ffi.ffi_call(
+        "mmlspark_fasthist_gather",
+        jax.ShapeDtypeStruct((f, num_bins, GH_CHANNELS), jnp.float32),
+    )(bins.astype(jnp.uint8), gh.astype(jnp.float32),
+      seg.astype(jnp.int32), jnp.reshape(cnt, (1,)).astype(jnp.int32))
 
 
 def _auto_method(n_rows: Optional[int] = None) -> str:
