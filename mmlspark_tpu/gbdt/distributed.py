@@ -308,7 +308,7 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
 
 
 def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
-                   lr: float):
+                   lr: float, num_class: int = 1):
     """One dart iteration over a data-only mesh: fit a tree to the gradient
     at the dropped-out score vector ``s_minus`` (histogram psums over the
     ``data`` axis inside the grower), returning the replicated lr-shrunk
@@ -317,36 +317,59 @@ def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
     the serial path — dropout bookkeeping is tiny host metadata, only the
     fit and the scoring ride the mesh."""
     cfg = _sharded_cfg(mesh, cfg)
+    K = num_class
 
     def step(bins, binsT, s_minus, labels, weights, bag, fi):
         g, h = obj.grad_hess(s_minus, labels, weights)
-        gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
-        tree = apply_shrinkage(tree, lr)
-        b_new = tree.leaf_value[row_leaf]
-        return tree, b_new
+        if K == 1:
+            gh = jnp.stack([g * bag, h * bag, bag], axis=1)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg,
+                                             binsT=binsT)
+            tree = apply_shrinkage(tree, lr)
+            return tree, tree.leaf_value[row_leaf]
+        trees_k, bnews = [], []
+        for k in range(K):
+            gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
+            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg,
+                                             binsT=binsT)
+            tree = apply_shrinkage(tree, lr)
+            trees_k.append(tree)
+            bnews.append(tree.leaf_value[row_leaf])
+        trees = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                       *trees_k)
+        return trees, jnp.stack(bnews, axis=1)
 
+    sc_spec = P(DATA_AXIS) if K == 1 else P(DATA_AXIS, None)
     mapped = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(DATA_AXIS),
+        in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), sc_spec,
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(None, None)),
-        out_specs=(P(), P(DATA_AXIS)),
+        out_specs=(P(), sc_spec),
         check_vma=False)
     return jax.jit(mapped)
 
 
-def make_tree_predict(mesh: Mesh, num_leaves: int):
+def make_tree_predict(mesh: Mesh, num_leaves: int, num_class: int = 1):
     """Replicated-tree scoring of data-sharded binned rows (each shard
     holds ALL features of its rows) — dart's dropped-tree subtraction and
-    validation scoring under a data mesh."""
-    def pred(tree, bins):
-        return predict_tree_binned(tree, bins, num_leaves)
+    validation scoring under a data mesh.  ``num_class > 1`` scores one
+    dart iteration's K stacked trees to (n, K)."""
+    if num_class == 1:
+        def pred(tree, bins):
+            return predict_tree_binned(tree, bins, num_leaves)
+        out_spec = P(DATA_AXIS)
+    else:
+        def pred(trees_st, bins):
+            return jax.vmap(
+                lambda t: predict_tree_binned(t, bins, num_leaves)
+            )(trees_st).T
+        out_spec = P(DATA_AXIS, None)
 
     mapped = jax.shard_map(
         pred, mesh=mesh,
         in_specs=(P(), P(DATA_AXIS, None)),
-        out_specs=P(DATA_AXIS),
+        out_specs=out_spec,
         check_vma=False)
     return jax.jit(mapped)
 

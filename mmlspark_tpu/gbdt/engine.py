@@ -181,19 +181,39 @@ def _dart_draw_drops(dart_rng, n_trees: int, params) -> np.ndarray:
     return np.zeros(0, np.int64)
 
 
-@functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"))
+@functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr", "K"))
 def _dart_step(bins, binsT, s_minus, labels, weights, bag, fi,
-               obj: Objective, cfg: GrowerConfig, lr: float):
-    """One dart iteration body: fit a tree to the gradient at the dropped-
-    out score vector; returns the lr-shrunk tree and its base contribution
-    (the host applies the 1/(k+1) dart normalization).  ``binsT`` is the
-    fit-invariant transpose, computed once by the caller."""
+               obj: Objective, cfg: GrowerConfig, lr: float, K: int = 1):
+    """One dart iteration body: fit tree(s) to the gradient at the
+    dropped-out score vector; returns the lr-shrunk tree(s) and the base
+    contribution (the host applies the 1/(k+1) dart normalization).
+    ``binsT`` is the fit-invariant transpose, computed once by the caller.
+
+    ``K > 1`` (multiclass): LightGBM's dart drops whole ITERATIONS — the
+    K class trees of an iteration share one weight — so the step grows K
+    trees at the shared dropped-out scores and returns them stacked
+    (K, ...) with a (n, K) contribution."""
     g, h = obj.grad_hess(s_minus, labels, weights)
-    gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-    tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
-    tree = apply_shrinkage(tree, lr)
-    b_new = tree.leaf_value[row_leaf]
-    return tree, b_new
+    if K == 1:
+        gh = jnp.stack([g * bag, h * bag, bag], axis=1)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
+        tree = apply_shrinkage(tree, lr)
+        return tree, tree.leaf_value[row_leaf]
+    trees_k, bnews = [], []
+    for k in range(K):
+        gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
+        tree = apply_shrinkage(tree, lr)
+        trees_k.append(tree)
+        bnews.append(tree.leaf_value[row_leaf])
+    trees = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees_k)
+    return trees, jnp.stack(bnews, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def _dart_iter_margin(trees_st, bins, L: int):
+    """(n, K) margins of one dart iteration's K stacked trees."""
+    return jax.vmap(lambda t: predict_tree_binned(t, bins, L))(trees_st).T
 
 
 @functools.partial(jax.jit,
@@ -522,10 +542,10 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 "boostingType='rf' does not support custom gradient "
                 "objectives (ranking); use boostingType='gbdt'")
     if use_dart:
-        if K > 1 or grad_fn_override is not None:
+        if grad_fn_override is not None:
             raise NotImplementedError(
-                "boostingType='dart' currently supports single-model "
-                "objectives (binary/regression)")
+                "boostingType='dart' does not support custom gradient "
+                "objectives (ranking); use boostingType='gbdt'")
         if params.early_stopping_round > 0:
             raise NotImplementedError(
                 "boostingType='dart' does not support early stopping "
@@ -740,52 +760,67 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         # weights are tracked on host and baked into the exported trees.
         dart_rng = np.random.default_rng(params.drop_seed)
         run_dart = _debug.checked(functools.partial(
-            _dart_step, obj=objective, cfg=cfg, lr=params.learning_rate))
+            _dart_step, obj=objective, cfg=cfg, lr=params.learning_rate,
+            K=K))
         binsT_d = jnp.transpose(bins_d)   # fit-invariant, once per fit
-        trees_list = []
-        scales: List[float] = []
         L_steps = params.num_leaves
+
+        def unit_margin(unit, b):
+            """One dart unit's contribution: a tree (K=1) or the stacked
+            K class trees of one iteration (dart drops whole iterations,
+            as LightGBM does)."""
+            if K == 1:
+                return predict_tree_binned(unit, b, L_steps)
+            return _dart_iter_margin(unit, b, L_steps)
+
+        units = []          # per-iteration unit (tree or K-stack)
+        trees_list = []     # flat, iteration-major class-minor (export)
+        scales: List[float] = []
         for it in range(T):
             if use_bag and it % params.bagging_freq == 0:
                 cur_bag = (bag_rng.random(n) < params.bagging_fraction
                            ).astype(np.float32)
             bag_mask = jnp.asarray(cur_bag)
             fi = jnp.asarray(iter_fi(it))
-            sel = _dart_draw_drops(dart_rng, len(trees_list), params)
+            sel = _dart_draw_drops(dart_rng, len(units), params)
             k = len(sel)
             if k:
-                P = scales[sel[0]] * predict_tree_binned(
-                    trees_list[sel[0]], bins_d, L_steps)
+                P = scales[sel[0]] * unit_margin(units[sel[0]], bins_d)
                 for i in sel[1:]:
-                    P = P + scales[i] * predict_tree_binned(
-                        trees_list[i], bins_d, L_steps)
+                    P = P + scales[i] * unit_margin(units[i], bins_d)
                 s_minus = scores - P
             else:
                 s_minus = scores
-            tree, b_new = run_dart(bins_d, binsT_d, s_minus, labels_d,
+            unit, b_new = run_dart(bins_d, binsT_d, s_minus, labels_d,
                                    weights_d, bag_mask, fi)
             norm = 1.0 / (k + 1)
             scores = s_minus + norm * b_new
             if k:
                 scores = scores + (k * norm) * P
                 if has_val:
-                    P_val = scales[sel[0]] * predict_tree_binned(
-                        trees_list[sel[0]], val_bins_d, L_steps)
+                    P_val = scales[sel[0]] * unit_margin(units[sel[0]],
+                                                         val_bins_d)
                     for i in sel[1:]:
-                        P_val = P_val + scales[i] * predict_tree_binned(
-                            trees_list[i], val_bins_d, L_steps)
+                        P_val = P_val + scales[i] * unit_margin(
+                            units[i], val_bins_d)
                     val_scores = val_scores - norm * P_val
                 for i in sel:
                     scales[i] *= k * norm
             if has_val:
-                val_scores = val_scores + norm * predict_tree_binned(
-                    tree, val_bins_d, L_steps)
+                val_scores = val_scores + norm * unit_margin(unit,
+                                                             val_bins_d)
                 metric = float(val_metric(np.asarray(val_scores),
                                           val_labels_np, val_weights))
                 if metric < best_metric - 1e-12:
                     best_metric, best_iter = metric, it
-            trees_list.append(tree)
+            units.append(unit)
             scales.append(norm)
+            if K == 1:
+                trees_list.append(unit)
+            else:
+                trees_list.extend(
+                    jax.tree_util.tree_map(lambda a, kk=kk: a[kk], unit)
+                    for kk in range(K))
             if callbacks:
                 for cb in callbacks:
                     cb(it, trees_list)
@@ -945,8 +980,9 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     trees, stop_iter = _truncate_no_growth(trees, nls, K, stop_iter,
                                            params.verbosity)
     if use_dart:
-        # bake the final per-tree dart weights into the exported trees
-        for t, s in zip(trees, scales):
+        # bake the final dart weights into the exported trees (one scale
+        # per ITERATION, shared by its K class trees)
+        for t, s in zip(trees, np.repeat(scales, K)):
             t.leaf_value = t.leaf_value * s
             t.internal_value = t.internal_value * s
             t.shrinkage = s
@@ -1271,6 +1307,7 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
                               prepare_arrays)
 
     n, f = bins.shape
+    K = objective.num_model_per_iteration
     T = params.num_iterations
     use_bag = params.bagging_freq > 0 and params.bagging_fraction < 1.0
     use_ff = params.feature_fraction < 1.0
@@ -1280,14 +1317,15 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
 
     bins_np = np.asarray(bins, mapper.bin_dtype)
     bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
-        bins_np, np.asarray(labels), np.asarray(w, np.float32), mesh, 1,
+        bins_np, np.asarray(labels), np.asarray(w, np.float32), mesh, K,
         init, init_scores)
     fi_base = np.zeros((f + fp, 3), np.float32)
     fi_base[:f] = _feat_info_from_mapper(mapper, f)
     L = params.num_leaves
 
-    step = make_dart_step(mesh, objective, cfg, params.learning_rate)
-    pred = make_tree_predict(mesh, L)
+    step = make_dart_step(mesh, objective, cfg, params.learning_rate,
+                          num_class=K)
+    pred = make_tree_predict(mesh, L, num_class=K)
     binsT_d = jnp.transpose(bins_d)   # fit-invariant, once per fit
 
     # dart rejects early stopping upstream (the dropped-tree rescaling is
@@ -1295,7 +1333,8 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
     # decide here — val args are accepted for signature parity and ignored,
     # exactly like the serial dart path's inert metric would be.
     dart_rng = np.random.default_rng(params.drop_seed)
-    trees_list: List[TreeArrays] = []
+    units: List[TreeArrays] = []      # per-iteration unit (tree | K-stack)
+    trees_list: List[TreeArrays] = []  # flat, iteration-major class-minor
     scales: List[float] = []
     real_np = np.concatenate([np.ones(n, np.float32),
                               np.zeros(rp, np.float32)])
@@ -1315,16 +1354,16 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
                 rng, fi_base, f, params.feature_fraction))
         else:
             fi = jnp.asarray(fi_base)
-        sel = _dart_draw_drops(dart_rng, len(trees_list), params)
+        sel = _dart_draw_drops(dart_rng, len(units), params)
         k = len(sel)
         if k:
-            Pd = scales[sel[0]] * pred(trees_list[sel[0]], bins_d)
+            Pd = scales[sel[0]] * pred(units[sel[0]], bins_d)
             for i in sel[1:]:
-                Pd = Pd + scales[i] * pred(trees_list[i], bins_d)
+                Pd = Pd + scales[i] * pred(units[i], bins_d)
             s_minus = scores - Pd
         else:
             s_minus = scores
-        tree, b_new = step(bins_d, binsT_d, s_minus, labels_d, w_d,
+        unit, b_new = step(bins_d, binsT_d, s_minus, labels_d, w_d,
                            bagm, fi)
         norm = 1.0 / (k + 1)
         scores = s_minus + norm * b_new
@@ -1332,8 +1371,14 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
             scores = scores + (k * norm) * Pd
             for i in sel:
                 scales[i] *= k * norm
-        trees_list.append(tree)
+        units.append(unit)
         scales.append(norm)
+        if K == 1:
+            trees_list.append(unit)
+        else:
+            trees_list.extend(
+                jax.tree_util.tree_map(lambda a, kk=kk: a[kk], unit)
+                for kk in range(K))
         if callbacks:
             for cb in callbacks:
                 cb(it, trees_list)
@@ -1343,13 +1388,13 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
         trees_chunks = [jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *trees_list)]
     trees, nls = _fetch_host_trees(trees_chunks, L, mapper)
-    trees, stop_iter = _truncate_no_growth(trees, nls, 1, T,
+    trees, stop_iter = _truncate_no_growth(trees, nls, K, T,
                                            params.verbosity)
-    for t, s in zip(trees, scales):
+    for t, s in zip(trees, np.repeat(scales, K)):
         t.leaf_value = t.leaf_value * s
         t.internal_value = t.internal_value * s
         t.shrinkage = s
-    return _finalize_booster(trees, 1, init, params, objective, mapper,
+    return _finalize_booster(trees, K, init, params, objective, mapper,
                              feature_names, f, stop_iter)
 
 

@@ -179,3 +179,60 @@ class TestRFValidation:
         # TRUNCATED count
         assert all(abs(tr.shrinkage - 1.0 / k) < 1e-12
                    for tr in m.getModel().trees)
+
+
+class TestDartMulticlass:
+    """dart x multiclass (round-4 matrix completion): LightGBM's dart
+    drops whole iterations — the K class trees of an iteration share one
+    dropout decision and one weight."""
+
+    @pytest.fixture(scope="class")
+    def multi_table(self):
+        from sklearn.datasets import make_classification
+        X, y = make_classification(n_samples=700, n_features=8,
+                                   n_informative=6, n_classes=3,
+                                   random_state=33)
+        return {"features": X, "label": y.astype(float)}
+
+    def test_skip_drop_one_degenerates_to_gbdt(self, multi_table):
+        kw = dict(numIterations=5, numLeaves=7, minDataInLeaf=5,
+                  verbosity=0)
+        a = LightGBMClassifier(boostingType="dart", skipDrop=1.0,
+                               **kw).fit(multi_table)
+        b = LightGBMClassifier(boostingType="gbdt", **kw).fit(multi_table)
+        np.testing.assert_allclose(
+            np.asarray(a.transform(multi_table)["probability"]),
+            np.asarray(b.transform(multi_table)["probability"]),
+            rtol=1e-4, atol=1e-6)
+
+    def test_learns_and_roundtrips(self, multi_table, tmp_path):
+        m = LightGBMClassifier(boostingType="dart", numIterations=12,
+                               numLeaves=7, dropRate=0.3,
+                               minDataInLeaf=5, verbosity=0).fit(
+            multi_table)
+        assert len(m.getModel().trees) == 36
+        acc = (np.asarray(m.transform(multi_table)["prediction"])
+               == multi_table["label"]).mean()
+        assert acc > 0.8
+        p = str(tmp_path / "dart_mc.txt")
+        m.saveNativeModel(p)
+        m2 = type(m).loadNativeModel(p)
+        np.testing.assert_allclose(
+            np.asarray(m.transform(multi_table)["probability"]),
+            np.asarray(m2.transform(multi_table)["probability"]),
+            rtol=1e-5, atol=1e-6)
+
+    def test_mesh_matches_serial(self, multi_table):
+        from mmlspark_tpu.core.mesh import build_mesh
+        kw = dict(boostingType="dart", numIterations=6, numLeaves=7,
+                  dropRate=0.5, minDataInLeaf=5, verbosity=0)
+        serial = LightGBMClassifier(**kw).fit(multi_table)
+        dist = LightGBMClassifier(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(multi_table)
+        st, dt = serial.getModel().trees, dist.getModel().trees
+        assert len(st) == len(dt) == 18
+        for a, b in zip(st, dt):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            assert abs(a.shrinkage - b.shrinkage) < 1e-12
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=2e-3, atol=1e-5)
