@@ -537,25 +537,14 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 "boostingType='rf' requires bagging: set "
                 "baggingFraction in (0,1) and baggingFreq > 0 "
                 "(as in LightGBM)")
-        if grad_fn_override is not None:
-            raise NotImplementedError(
-                "boostingType='rf' does not support custom gradient "
-                "objectives (ranking); use boostingType='gbdt'")
+
     if use_dart:
-        if grad_fn_override is not None:
-            raise NotImplementedError(
-                "boostingType='dart' does not support custom gradient "
-                "objectives (ranking); use boostingType='gbdt'")
         if params.early_stopping_round > 0:
             raise NotImplementedError(
                 "boostingType='dart' does not support early stopping "
                 "(dropped-tree rescaling is not invertible by truncation); "
                 "unset earlyStoppingRound")
     if use_goss:
-        if grad_fn_override is not None:
-            raise NotImplementedError(
-                "boostingType='goss' does not support custom gradient "
-                "objectives (ranking); use boostingType='gbdt'")
         if params.bagging_freq > 0 and params.bagging_fraction < 1.0:
             raise ValueError("Cannot use bagging in GOSS "
                              "(as in LightGBM); unset baggingFraction/"
@@ -710,11 +699,14 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     trees_chunks: List[TreeArrays] = []
     stop_iter = T
 
-    if grad_fn_override is not None:
+    if grad_fn_override is not None and not use_dart:
         # Per-iteration host loop: the ranking gradient closes over query
         # structure on the host (not a hashable static), so it can't ride
         # the scan.  Trees still cross to the host as one packed chunk.
+        # goss samples inside the loop (Σ|g·h| ranking per iteration); rf
+        # fits every tree at the constant init scores, unshrunk.
         run_grow = _debug.checked(functools.partial(grow_tree, cfg=cfg))
+        binsT_d = jnp.transpose(bins_d)   # fit-invariant, once per fit
         trees_list: List[TreeArrays] = []
         for it in range(T):
             if use_bag and it % params.bagging_freq == 0:
@@ -723,18 +715,41 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             bag_mask = jnp.asarray(cur_bag)
             fi = jnp.asarray(iter_fi(it))
             g, h = grad_fn_override(scores)
-            gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask], axis=1)
-            tree, row_leaf = run_grow(bins_d, gh, fi)
-            scores = scores + params.learning_rate * \
-                tree.leaf_value[row_leaf]
-            tree = apply_shrinkage(tree, params.learning_rate)
-            trees_list.append(tree)
+            if use_goss:
+                infl = jnp.abs(g * h)
+                rank = jnp.argsort(-infl)
+                top_idx = rank[:k1]
+                rk = jax.random.uniform(goss_keys[it], (n - k1,))
+                other_idx = jnp.take(rank[k1:], jnp.argsort(rk)[:k2])
+                idx = jnp.concatenate([top_idx, other_idx])
+                amp_vec = jnp.concatenate([
+                    jnp.ones(k1, jnp.float32),
+                    jnp.full(k2, goss_amp, jnp.float32)])
+                gh = jnp.stack([jnp.take(g, idx) * amp_vec,
+                                jnp.take(h, idx) * amp_vec,
+                                jnp.ones(k1 + k2, jnp.float32)], axis=1)
+                tree, _ = run_grow(jnp.take(bins_d, idx, axis=0), gh, fi)
+                scores = scores + params.learning_rate * \
+                    predict_tree_binned(tree, bins_d, params.num_leaves)
+                tree = apply_shrinkage(tree, params.learning_rate)
+                trees_list.append(tree)
+            else:
+                gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask],
+                               axis=1)
+                tree, row_leaf = run_grow(bins_d, gh, fi, binsT=binsT_d)
+                if not use_rf:
+                    scores = scores + params.learning_rate * \
+                        tree.leaf_value[row_leaf]
+                    tree = apply_shrinkage(tree, params.learning_rate)
+                trees_list.append(tree)
             if has_val:
                 # trees are already shrunk, so val scores add at lr=1.0
                 val_scores = val_scores + predict_tree_binned(
                     tree, val_bins_d, params.num_leaves)
-                metric = float(val_metric(np.asarray(val_scores),
-                                          val_labels_np, val_weights))
+                margins = (_rf_margins(init, np.asarray(val_scores), it)
+                           if use_rf else np.asarray(val_scores))
+                metric = float(val_metric(margins, val_labels_np,
+                                          val_weights))
                 if metric < best_metric - 1e-12:
                     best_metric, best_iter = metric, it
                 elif esr > 0 and it - best_iter >= esr:
@@ -762,6 +777,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         run_dart = _debug.checked(functools.partial(
             _dart_step, obj=objective, cfg=cfg, lr=params.learning_rate,
             K=K))
+        run_grow_dart = _debug.checked(functools.partial(grow_tree,
+                                                         cfg=cfg))
         binsT_d = jnp.transpose(bins_d)   # fit-invariant, once per fit
         L_steps = params.num_leaves
 
@@ -791,8 +808,19 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 s_minus = scores - P
             else:
                 s_minus = scores
-            unit, b_new = run_dart(bins_d, binsT_d, s_minus, labels_d,
-                                   weights_d, bag_mask, fi)
+            if grad_fn_override is not None:
+                # ranking dart (single-model): gradients at the dropped-
+                # out scores through the query-structured closure
+                g, h = grad_fn_override(s_minus)
+                gh = jnp.stack([g * bag_mask, h * bag_mask, bag_mask],
+                               axis=1)
+                unit, row_leaf = run_grow_dart(bins_d, gh, fi,
+                                               binsT=binsT_d)
+                unit = apply_shrinkage(unit, params.learning_rate)
+                b_new = unit.leaf_value[row_leaf]
+            else:
+                unit, b_new = run_dart(bins_d, binsT_d, s_minus, labels_d,
+                                       weights_d, bag_mask, fi)
             norm = 1.0 / (k + 1)
             scores = s_minus + norm * b_new
             if k:

@@ -584,13 +584,16 @@ def _find_split(hist, pg, ph, pc, fi, depth_ok, cfg: GrowerConfig):
 def grow_tree(bins: jnp.ndarray, gh: jnp.ndarray,
               feat_info: jnp.ndarray,
               cfg: GrowerConfig,
-              efb: Optional[EFBArrays] = None
+              efb: Optional[EFBArrays] = None,
+              binsT: Optional[jnp.ndarray] = None
               ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree.  ``gh``: (n, 3) masked (grad, hess, count);
     ``feat_info``: (f, 3) [mask, is_cat, n_value_bins] (see
     :func:`make_feat_info`); ``efb``: optional bundle maps — then
-    ``bins`` holds bundle columns (gbdt/efb.py)."""
-    return _grow_tree_impl(bins, gh, feat_info, cfg, efb)
+    ``bins`` holds bundle columns (gbdt/efb.py); ``binsT``: optional
+    precomputed ``bins.T`` (fit-invariant — pass it when calling in a
+    loop)."""
+    return _grow_tree_impl(bins, gh, feat_info, cfg, efb, binsT=binsT)
 
 
 def make_feat_info(f: int, feature_mask=None, is_cat=None, nbins=None):

@@ -130,3 +130,54 @@ class TestRankerReviewRegressions:
             LightGBMRegressor(objective="lambdarank", numIterations=2).fit(
                 {"features": binary_table["features"],
                  "label": binary_table["label"]})
+
+
+class TestRankerBoostingModes:
+    """dart/goss/rf x lambdarank (round-4 matrix completion): the
+    reference exposes every boostingType with the ranking objective."""
+
+    @pytest.fixture(scope="class")
+    def rank_table(self):
+        return _synthetic_ranking(seed=7)
+
+    def _ndcg(self, model, t, k=5):
+        out = model.transform(t)
+        return float(np.mean(ndcg_at_k(np.asarray(out["prediction"]),
+                                       t["label"], t["query"], k)))
+
+    def test_dart_ranker_learns(self, rank_table):
+        m = LightGBMRanker(boostingType="dart", numIterations=20,
+                           numLeaves=15, minDataInLeaf=5, dropRate=0.2,
+                           groupCol="query", verbosity=0).fit(rank_table)
+        base = LightGBMRanker(numIterations=1, numLeaves=3,
+                              groupCol="query", verbosity=0).fit(
+            rank_table)
+        assert self._ndcg(m, rank_table) > self._ndcg(base, rank_table)
+        assert self._ndcg(m, rank_table) > 0.75
+
+    def test_dart_skip_drop_one_matches_gbdt_ranker(self, rank_table):
+        kw = dict(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                  groupCol="query", verbosity=0)
+        a = LightGBMRanker(boostingType="dart", skipDrop=1.0,
+                           **kw).fit(rank_table)
+        b = LightGBMRanker(boostingType="gbdt", **kw).fit(rank_table)
+        np.testing.assert_allclose(
+            np.asarray(a.transform(rank_table)["prediction"]),
+            np.asarray(b.transform(rank_table)["prediction"]),
+            rtol=1e-4, atol=1e-6)
+
+    def test_goss_ranker_learns(self, rank_table):
+        m = LightGBMRanker(boostingType="goss", numIterations=20,
+                           numLeaves=15, minDataInLeaf=5,
+                           groupCol="query", verbosity=0).fit(rank_table)
+        assert self._ndcg(m, rank_table) > 0.75
+
+    def test_rf_ranker_trains(self, rank_table):
+        m = LightGBMRanker(boostingType="rf", numIterations=8,
+                           numLeaves=15, minDataInLeaf=5,
+                           baggingFraction=0.6, baggingFreq=1,
+                           groupCol="query", verbosity=0).fit(rank_table)
+        trees = m.getModel().trees
+        assert len(trees) == 8
+        assert all(abs(t.shrinkage - 1 / 8) < 1e-12 for t in trees)
+        assert self._ndcg(m, rank_table) > 0.6
