@@ -474,8 +474,16 @@ def _partition_switch(row_order, col, off, cnt, thr, use_cat, cat_bits,
 
     Returns ``(row_order', cnt_left, cnt_right)`` (counts of ALL leaf rows
     per side, bagged-out rows included — the partition tracks membership,
-    histograms track contribution).
+    histograms track contribution).  On the CPU backend the whole
+    partition is one in-place native pass (ops/histogram.py
+    native_partition).
     """
+    if cfg.hist_method in ("auto", "native"):
+        from ..ops.histogram import native_partition
+        res = native_partition(row_order, col, off, cnt, thr, use_cat,
+                               cat_bits, cfg.num_bins)
+        if res is not None:
+            return res
 
     def make(size):
         def fn(_):
@@ -516,15 +524,15 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
     branches.  On the CPU backend the gather fuses into the native FFI
     kernel (no (size, f) materialization)."""
     from ..ops.histogram import native_segment_hist
+    if cfg.hist_method in ("auto", "native"):
+        fused = native_segment_hist(bins, gh, row_order, off, cnt,
+                                    cfg.num_bins)
+        if fused is not None:
+            return fused
 
     def make(size):
         def fn(_):
             seg = jax.lax.dynamic_slice(row_order, (off,), (size,))
-            if cfg.hist_method in ("auto", "native"):
-                fused = native_segment_hist(bins, gh, seg, cnt,
-                                            cfg.num_bins)
-                if fused is not None:
-                    return fused
             valid = jnp.arange(size, dtype=jnp.int32) < cnt
             rows = jnp.minimum(seg, n - 1)
             b_sub = jnp.take(bins, rows, axis=0)

@@ -139,10 +139,16 @@ def hist_ffi_handler():
     return getattr(lib, "MmlsparkFastHist", None) if lib else None
 
 
-def hist_gather_ffi_handler():
-    """Fused gather+histogram FFI handler (leaf-segment hot path)."""
+def seg_hist_ffi_handler():
+    """Dynamic-offset segment histogram FFI handler (leaf hot path)."""
     lib = _ffi_lib()
-    return getattr(lib, "MmlsparkFastHistGather", None) if lib else None
+    return getattr(lib, "MmlsparkFastSegHist", None) if lib else None
+
+
+def partition_ffi_handler():
+    """In-place DataPartition::Split FFI handler."""
+    lib = _ffi_lib()
+    return getattr(lib, "MmlsparkFastPartition", None) if lib else None
 
 
 def bin_columns(X, bext, nb, base, lo, scale, use_table, missing_bin,

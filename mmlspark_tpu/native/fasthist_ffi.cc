@@ -19,6 +19,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <vector>
 
 #include "xla/ffi/api/ffi.h"
 
@@ -65,39 +66,31 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Arg<ffi::Buffer<ffi::F32>>()
         .Ret<ffi::Buffer<ffi::F32>>());
 
-// Fused gather + histogram: the DataPartition grower's per-split hot
-// path histograms a leaf's contiguous row_order segment.  XLA's version
-// materializes the gathered (size, f) sub-matrix in memory before the
-// histogram reads it back; here the row indirection happens in the
-// accumulation loop itself (PERF.md round-3 headroom note: the bucket
-// gather costs as much as the histogram).  ``seg`` is the bucket-sized
-// index slice, ``cnt`` (1,) i32 the number of live leaf rows at its
-// head.
-static ffi::Error HistGatherImpl(ffi::Buffer<ffi::U8> bins,
-                                 ffi::Buffer<ffi::F32> gh,
-                                 ffi::Buffer<ffi::S32> seg,
-                                 ffi::Buffer<ffi::S32> cnt,
-                                 ffi::ResultBuffer<ffi::F32> out) {
-  auto bd = bins.dimensions();
-  if (bd.size() != 2 || gh.dimensions().size() != 2 ||
-      seg.dimensions().size() != 1 || out->dimensions().size() != 3) {
-    return ffi::Error::InvalidArgument(
-        "fasthist_gather: need bins (n,f) u8, gh (n,3) f32, seg (m,) "
-        "i32, cnt (1,) i32, out (f,B,3) f32");
-  }
-  const int64_t n = bd[0];
-  const int64_t f = bd[1];
-  const int64_t m = seg.dimensions()[0];
+// Segment histogram with a DYNAMIC offset/count straight off the
+// DataPartition row permutation: no power-of-two bucket ladder, no
+// lax.switch, no padding work — C++ loops exactly `cnt` rows.
+// (bins (n,f) u8, gh (n,3) f32, row_order (m,) i32, meta (2,) i32
+// [off, cnt]) -> out (f,B,3) f32.
+static ffi::Error SegHistImpl(ffi::Buffer<ffi::U8> bins,
+                              ffi::Buffer<ffi::F32> gh,
+                              ffi::Buffer<ffi::S32> row_order,
+                              ffi::Buffer<ffi::S32> meta,
+                              ffi::ResultBuffer<ffi::F32> out) {
+  const int64_t n = bins.dimensions()[0];
+  const int64_t f = bins.dimensions()[1];
+  const int64_t m = row_order.dimensions()[0];
   const int64_t B = out->dimensions()[1];
   const uint8_t* b = bins.typed_data();
   const float* g = gh.typed_data();
-  const int32_t* s = seg.typed_data();
-  int64_t live = cnt.typed_data()[0];
-  if (live > m) live = m;
+  const int32_t* ro = row_order.typed_data();
+  int64_t off = meta.typed_data()[0];
+  int64_t cnt = meta.typed_data()[1];
+  if (off < 0) off = 0;
+  if (off + cnt > m) cnt = m - off;
   float* o = out->typed_data();
   std::fill(o, o + f * B * 3, 0.f);
-  for (int64_t i = 0; i < live; ++i) {
-    int64_t row = s[i];
+  for (int64_t i = 0; i < cnt; ++i) {
+    int64_t row = ro[off + i];
     if (row < 0 || row >= n) continue;  // pad sentinel
     const float gi = g[3 * row];
     const float hi = g[3 * row + 1];
@@ -117,10 +110,66 @@ static ffi::Error HistGatherImpl(ffi::Buffer<ffi::U8> bins,
 }
 
 XLA_FFI_DEFINE_HANDLER_SYMBOL(
-    MmlsparkFastHistGather, HistGatherImpl,
+    MmlsparkFastSegHist, SegHistImpl,
     ffi::Ffi::Bind()
         .Arg<ffi::Buffer<ffi::U8>>()
         .Arg<ffi::Buffer<ffi::F32>>()
         .Arg<ffi::Buffer<ffi::S32>>()
         .Arg<ffi::Buffer<ffi::S32>>()
         .Ret<ffi::Buffer<ffi::F32>>());
+
+// DataPartition::Split as one stable in-place pass (LightGBM
+// src/io/data_partition.hpp analog; expected path, UNVERIFIED).  The
+// leaf's contiguous row_order segment [off, off+cnt) is partitioned
+// into left|right by the split column; input_output_aliases makes the
+// row_order update zero-copy.  ``meta`` (4,) i32 = [off, cnt, thr,
+// use_cat]; ``counts`` out (2,) i32 = [cnt_left, cnt_right].
+static ffi::Error PartitionImpl(ffi::Buffer<ffi::S32> row_order,
+                                ffi::Buffer<ffi::U8> col,
+                                ffi::Buffer<ffi::S32> meta,
+                                ffi::Buffer<ffi::U32> cat_bits,
+                                ffi::ResultBuffer<ffi::S32> row_order_out,
+                                ffi::ResultBuffer<ffi::S32> counts) {
+  const int64_t m = row_order.dimensions()[0];
+  const int64_t n = col.dimensions()[0];
+  const int32_t* ro_in = row_order.typed_data();
+  int32_t* ro = row_order_out->typed_data();
+  if (ro != ro_in) std::copy(ro_in, ro_in + m, ro);  // alias miss: copy
+  const uint8_t* c = col.typed_data();
+  const int32_t* mt = meta.typed_data();
+  int64_t off = mt[0];
+  int64_t cnt = mt[1];
+  const int32_t thr = mt[2];
+  const bool use_cat = mt[3] != 0;
+  const uint32_t* bits = cat_bits.typed_data();
+  if (off < 0) off = 0;
+  if (off + cnt > m) cnt = m - off;
+  std::vector<int32_t> right;
+  right.reserve(static_cast<size_t>(cnt));
+  int64_t w = off;
+  for (int64_t i = 0; i < cnt; ++i) {
+    const int32_t row = ro[off + i];
+    int64_t bin = (row >= 0 && row < n) ? c[row] : 0;
+    const bool left = use_cat ? ((bits[bin >> 5] >> (bin & 31)) & 1u) != 0
+                              : bin <= thr;
+    if (left) {
+      ro[w++] = row;
+    } else {
+      right.push_back(row);
+    }
+  }
+  std::copy(right.begin(), right.end(), ro + w);
+  counts->typed_data()[0] = static_cast<int32_t>(w - off);
+  counts->typed_data()[1] = static_cast<int32_t>(right.size());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    MmlsparkFastPartition, PartitionImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::U8>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::U32>>()
+        .Ret<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());

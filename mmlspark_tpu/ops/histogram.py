@@ -66,44 +66,71 @@ _NATIVE_OK: Optional[bool] = None
 
 
 def _native_available() -> bool:
-    """Whether the XLA FFI histogram custom calls are registered (CPU)."""
+    """Whether the XLA FFI custom calls are registered (CPU backend)."""
     global _NATIVE_OK
     if _NATIVE_OK is None:
         _NATIVE_OK = False
         try:
             from .. import native
-            handler = native.hist_ffi_handler()
-            gather = native.hist_gather_ffi_handler()
-            if handler is not None and gather is not None:
-                jax.ffi.register_ffi_target(
-                    "mmlspark_fasthist", jax.ffi.pycapsule(handler),
-                    platform="cpu")
-                jax.ffi.register_ffi_target(
-                    "mmlspark_fasthist_gather", jax.ffi.pycapsule(gather),
-                    platform="cpu")
+            handlers = {
+                "mmlspark_fasthist": native.hist_ffi_handler(),
+                "mmlspark_fastseghist": native.seg_hist_ffi_handler(),
+                "mmlspark_fastpartition": native.partition_ffi_handler(),
+            }
+            if all(h is not None for h in handlers.values()):
+                for name, h in handlers.items():
+                    jax.ffi.register_ffi_target(
+                        name, jax.ffi.pycapsule(h), platform="cpu")
                 _NATIVE_OK = True
         except Exception:  # noqa: BLE001 - no toolchain / old jax
             _NATIVE_OK = False
     return _NATIVE_OK
 
 
-def native_segment_hist(bins, gh, seg, cnt, num_bins):
-    """Fused gather+histogram of ``bins[seg[:cnt]]`` via the FFI kernel,
-    or None when the native CPU path doesn't apply — callers fall back to
-    gather + :func:`compute_histogram`.  ``seg``: (m,) int32 row indices,
-    ``cnt``: () int32 live count at the head of ``seg``.  This removes
-    the gathered (m, f) materialization XLA's version writes and re-reads
+def _native_applies(num_bins) -> bool:
+    return (num_bins <= 256 and jax.default_backend() == "cpu"
+            and _native_available())
+
+
+def native_segment_hist(bins, gh, row_order, off, cnt, num_bins):
+    """Fused gather+histogram of the DataPartition segment
+    ``row_order[off:off+cnt]`` via the FFI kernel, or None when the
+    native CPU path doesn't apply (callers fall back to the bucket-ladder
+    gather + :func:`compute_histogram`).  C++ loops exactly ``cnt`` rows
+    — no power-of-two padding, no gathered sub-matrix materialization
     (PERF.md round-3 headroom: the bucket gather cost matched the
     histogram's)."""
-    if num_bins > 256 or jax.default_backend() != "cpu" \
-            or not _native_available():
+    if not _native_applies(num_bins):
         return None
     f = bins.shape[1]
+    meta = jnp.stack([off, cnt]).astype(jnp.int32)
     return jax.ffi.ffi_call(
-        "mmlspark_fasthist_gather",
+        "mmlspark_fastseghist",
         jax.ShapeDtypeStruct((f, num_bins, GH_CHANNELS), jnp.float32),
     )(bins.astype(jnp.uint8), gh.astype(jnp.float32),
-      seg.astype(jnp.int32), jnp.reshape(cnt, (1,)).astype(jnp.int32))
+      row_order.astype(jnp.int32), meta)
+
+
+def native_partition(row_order, col, off, cnt, thr, use_cat, cat_bits,
+                     num_bins):
+    """LightGBM ``DataPartition::Split`` as one in-place stable C++ pass
+    (input_output_aliases donates ``row_order``), or None when the native
+    CPU path doesn't apply.  Returns ``(row_order', cnt_left,
+    cnt_right)`` like the ``lax.switch`` bucket-ladder version it
+    replaces — without the ladder's padding work or branch dispatch."""
+    if not _native_applies(num_bins):
+        return None
+    m = row_order.shape[0]
+    meta = jnp.stack([off, cnt, thr,
+                      use_cat.astype(jnp.int32)]).astype(jnp.int32)
+    ro, counts = jax.ffi.ffi_call(
+        "mmlspark_fastpartition",
+        (jax.ShapeDtypeStruct((m,), jnp.int32),
+         jax.ShapeDtypeStruct((2,), jnp.int32)),
+        input_output_aliases={0: 0},
+    )(row_order.astype(jnp.int32), col.astype(jnp.uint8), meta,
+      cat_bits.astype(jnp.uint32))
+    return ro, counts[0], counts[1]
 
 
 def _auto_method(n_rows: Optional[int] = None) -> str:

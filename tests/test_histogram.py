@@ -111,3 +111,47 @@ class TestNativeHistogram:
         if not _native_available():
             pytest.skip("native toolchain unavailable")
         assert _auto_method(100_000) == "native"
+
+
+class TestNativePartitionParity:
+    """The native DataPartition/segment-histogram kernels must reproduce
+    the pure-XLA bucket-ladder path exactly — histogramMethod='segment'
+    forces the XLA path, 'auto' takes the native one on CPU."""
+
+    def test_forest_identical_native_vs_xla_path(self):
+        from sklearn.datasets import make_classification
+
+        from mmlspark_tpu.gbdt import LightGBMClassifier
+        X, y = make_classification(n_samples=2500, n_features=12,
+                                   n_informative=8, random_state=3)
+        t = {"features": X, "label": y.astype(float)}
+        kw = dict(numIterations=8, numLeaves=15, minDataInLeaf=5,
+                  baggingFraction=0.7, baggingFreq=2, verbosity=0)
+        a = LightGBMClassifier(histogramMethod="auto", **kw).fit(t)
+        b = LightGBMClassifier(histogramMethod="segment", **kw).fit(t)
+        st, dt = a.getModel().trees, b.getModel().trees
+        assert len(st) == len(dt)
+        for x, z in zip(st, dt):
+            np.testing.assert_array_equal(x.split_feature, z.split_feature)
+            np.testing.assert_allclose(x.leaf_value, z.leaf_value,
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_forest_identical_with_categoricals(self):
+        from mmlspark_tpu.gbdt import LightGBMClassifier
+        rng = np.random.default_rng(11)
+        n = 2000
+        c = rng.integers(0, 9, n)
+        x1 = rng.normal(size=n)
+        y = ((np.isin(c, [2, 5, 7]) * 2.0 + x1
+              + rng.normal(scale=0.5, size=n)) > 1.0).astype(float)
+        X = np.column_stack([c.astype(float), x1, rng.normal(size=(n, 3))])
+        t = {"features": X, "label": y}
+        kw = dict(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                  categoricalSlotIndexes=[0], verbosity=0)
+        a = LightGBMClassifier(histogramMethod="auto", **kw).fit(t)
+        b = LightGBMClassifier(histogramMethod="segment", **kw).fit(t)
+        for x, z in zip(a.getModel().trees, b.getModel().trees):
+            np.testing.assert_array_equal(x.split_feature, z.split_feature)
+            np.testing.assert_array_equal(x.decision_type, z.decision_type)
+            np.testing.assert_allclose(x.leaf_value, z.leaf_value,
+                                       rtol=1e-4, atol=1e-6)
