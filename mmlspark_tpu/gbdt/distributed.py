@@ -375,7 +375,8 @@ def make_tree_predict(mesh: Mesh, num_leaves: int, num_class: int = 1):
 
 
 def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
-                      sigma: float, trunc: int, has_val: bool = False):
+                      sigma: float, trunc: int, has_val: bool = False,
+                      goss=None):
     """Mesh-sharded lambdarank boosting (SURVEY.md §3.1 distributed
     lambdarank, BASELINE config MSLR): rows arrive query-packed per data
     shard (see :func:`mmlspark_tpu.gbdt.ranking.shard_queries`), so the
@@ -387,27 +388,58 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
     (D*n_chunks, chunk), sharded over ``data`` on the leading axis;
     ``real`` masks pad rows.  Validation margins ride the mesh as in
     :func:`make_boost_scan`.
+
+    ``goss``: optional ``(k1, k2, amp)`` — per-shard GOSS on top of the
+    full lambdarank gradients: pairwise ΔNDCG gradients are computed on
+    EVERY row (they need whole queries), then the tree grows on the
+    top-|g·h| sample plus an amplified random remainder, exactly like
+    distributed LightGBM's boosting=goss with a ranking objective.
+    ``keys`` feeds the per-iteration PRNG (ignored otherwise).
     """
     from .ranking import lambda_grad_sorted
 
     cfg = _sharded_cfg(mesh, cfg)
 
     def steps(bins, scores, real, wmul, qidx, qmask, gains, labq, invmax,
-              fis, val_bins, val_scores):
+              keys, fis, val_bins, val_scores):
         nl = scores.shape[0]
         binsT = bins.T   # fit-invariant; hoisted out of the scan
 
-        def body(carry, fi):
+        def body(carry, xs):
             scores, val_scores = carry
+            key, fi = xs
             g, h = lambda_grad_sorted(scores, qidx, qmask, gains, labq,
                                       invmax, sigma, trunc, nl)
             h = jnp.maximum(h, 1e-9)
             # wmul = row weight * validity (LightGBM ranker weightCol
             # semantics); the count channel carries plain validity
-            gh = jnp.stack([g * wmul, h * wmul, real], axis=1)
-            tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg,
-                                             binsT=binsT)
-            scores = scores + lr * tree.leaf_value[row_leaf]
+            if goss is None:
+                gh = jnp.stack([g * wmul, h * wmul, real], axis=1)
+                tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg,
+                                                 binsT=binsT)
+                scores = scores + lr * tree.leaf_value[row_leaf]
+            else:
+                k1, k2, amp = goss
+                if cfg.axis_name is not None:
+                    key = jax.random.fold_in(
+                        key, jax.lax.axis_index(cfg.axis_name))
+                gm = g * wmul
+                hm = h * wmul                     # pads carry wmul 0
+                rank = jnp.argsort(-jnp.abs(gm * hm))
+                top_idx = rank[:k1]
+                rk = jax.random.uniform(key, (nl - k1,))
+                other_idx = jnp.take(rank[k1:], jnp.argsort(rk)[:k2])
+                idx = jnp.concatenate([top_idx, other_idx])
+                amp_vec = jnp.concatenate([
+                    jnp.ones(k1, jnp.float32),
+                    jnp.full(k2, amp, jnp.float32)])
+                gh = jnp.stack([jnp.take(gm, idx) * amp_vec,
+                                jnp.take(hm, idx) * amp_vec,
+                                jnp.take(real, idx)], axis=1)
+                tree, _ = _grow_tree_impl(jnp.take(bins, idx, axis=0),
+                                          gh, fi, cfg)
+                scores = scores + lr * predict_tree_binned(
+                    tree, bins, cfg.num_leaves)
             tree = apply_shrinkage(tree, lr)
             if has_val:
                 val_scores = val_scores + predict_tree_binned(
@@ -418,7 +450,7 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
             return (scores, val_scores), (tree, out_v)
 
         (scores, val_scores), (trees, val_hist) = jax.lax.scan(
-            body, (scores, val_scores), fis)
+            body, (scores, val_scores), (keys, fis))
         return trees, scores, val_scores, val_hist
 
     val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
@@ -428,11 +460,12 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
                   P(DATA_AXIS), P(DATA_AXIS, None, None),
                   P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
                   P(DATA_AXIS, None, None), P(DATA_AXIS, None),
+                  P(None, None),
                   P(None, FEATURE_AXIS, None),
                   P(DATA_AXIS, None), P(DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), val_hist_spec),
         check_vma=False)
-    return jax.jit(mapped, donate_argnums=(1, 11))
+    return jax.jit(mapped, donate_argnums=(1, 12))
 
 
 def prepare_arrays_from_shards(bins_shards, label_shards, weight_shards,

@@ -586,10 +586,11 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         data_shards=_dn, verbosity=params.verbosity)
     if use_mesh:
         if ranking_info is not None:
-            if use_goss or use_dart or use_rf:
+            if use_dart or use_rf:
                 raise NotImplementedError(
                     f"boostingType={params.boosting!r} with a mesh "
-                    "lambdarank is not supported")
+                    "lambdarank is not supported (drop setMesh for the "
+                    "serial host loop, which supports every mode)")
             return _train_distributed_ranking(
                 bins, labels, w, mapper, objective, params, cfg, mesh,
                 feature_names, init, rng, ranking_info,
@@ -1205,9 +1206,29 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
 
     fi_base = np.zeros((f_padded, 3), np.float32)
     fi_base[:f] = _feat_info_from_mapper(mapper, f)
+    goss_rk = None
+    if params.boosting == "goss":
+        # per-shard GOSS over the packed rows (gradients stay full — the
+        # pairwise lambdas need whole queries; only tree growth samples)
+        if fn_shards > 1:
+            raise NotImplementedError(
+                "boostingType='goss' requires a data-only mesh; use "
+                "parallelism='data' / feature=1")
+        s_local = npk // dn
+        k1 = max(1, int(np.ceil(s_local * params.top_rate)))
+        k2 = max(1, int(np.ceil(s_local * params.other_rate)))
+        if k1 + k2 < s_local:
+            goss_rk = (k1, k2,
+                       (1.0 - params.top_rate) / params.other_rate)
+        elif params.verbosity > 0:
+            log.info("GOSS sample covers every local row; mesh ranking "
+                     "falls back to plain gbdt")
     step = make_ranking_scan(mesh, cfg, params.learning_rate,
                              ranking_info["sigma"],
-                             ranking_info["truncation_level"], has_val)
+                             ranking_info["truncation_level"], has_val,
+                             goss=goss_rk)
+    goss_keys_r = jax.random.split(
+        jax.random.PRNGKey(params.bagging_seed), T)
 
     chunk = T
     if has_val:
@@ -1228,7 +1249,8 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
                                                    (C,) + fi_base.shape))
         trees_st, scores, val_scores, val_hist = step(
             bins_d, scores, real_d, wmul_d, qidx_d, qmask_d, gains_d,
-            labq_d, invmax_d, fi_stack, val_bins_d, val_scores)
+            labq_d, invmax_d, goss_keys_r[it:it + C], fi_stack,
+            val_bins_d, val_scores)
         chunks.append(trees_st)
         stop = False
         if has_val:

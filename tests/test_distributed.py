@@ -586,3 +586,48 @@ class TestVotingApproximation:
         # ...but degrades gracefully: bounded AUC loss, still a model
         assert auc_vt > auc_dp - 0.05
         assert auc_vt > 0.85
+
+
+class TestMeshRankingGoss:
+    """GOSS under mesh lambdarank (distributed LightGBM supports
+    boosting=goss with a ranking objective): gradients stay full per
+    query, only tree growth samples per shard."""
+
+    def _rank_table(self):
+        rng = np.random.default_rng(5)
+        n_q, group, f = 100, 12, 8
+        n = n_q * group
+        X = rng.normal(size=(n, f))
+        w = rng.normal(size=f)
+        util = X @ w + rng.normal(size=n) * 0.5
+        q = np.repeat(np.arange(n_q), group)
+        labels = np.zeros(n)
+        for qq in range(n_q):
+            m = q == qq
+            labels[m] = np.clip(np.digitize(
+                util[m], np.quantile(util[m], [0.5, 0.75, 0.9])), 0, 3)
+        return {"features": X, "label": labels, "query": q}
+
+    def test_mesh_goss_ranker_learns(self):
+        from mmlspark_tpu.gbdt import LightGBMRanker, ndcg_at_k
+        t = self._rank_table()
+        m = LightGBMRanker(boostingType="goss", numIterations=20,
+                           numLeaves=15, minDataInLeaf=5,
+                           groupCol="query", verbosity=0).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        out = m.transform(t)
+        ndcg = float(np.mean(ndcg_at_k(np.asarray(out["prediction"]),
+                                       t["label"], t["query"], 5)))
+        assert ndcg > 0.75
+
+    def test_mesh_goss_ranker_deterministic(self):
+        from mmlspark_tpu.gbdt import LightGBMRanker
+        t = self._rank_table()
+        kw = dict(boostingType="goss", numIterations=5, numLeaves=7,
+                  minDataInLeaf=5, groupCol="query", verbosity=0)
+        a = LightGBMRanker(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        b = LightGBMRanker(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        assert (a.getModel().save_native_model_string()
+                == b.getModel().save_native_model_string())
