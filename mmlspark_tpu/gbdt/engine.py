@@ -1048,11 +1048,11 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
             "sharded ingestion does not support ranking objectives yet "
             "(query packing needs a global per-query sort); pass "
             "monolithic arrays for lambdarank")
-    if params.boosting == "dart":
+    if params.boosting == "dart" and int(mesh.shape["feature"]) > 1:
         raise NotImplementedError(
-            "sharded ingestion does not support boostingType='dart' "
-            "(the dart host loop scores full prediction rows); pass "
-            "monolithic arrays")
+            "boostingType='dart' requires a data-only mesh (the "
+            "dropped-tree score update reads whole feature rows); use "
+            "parallelism='data' / feature=1")
     if any(b is None for b in bins_shards):
         # multi-controller: each controller passes None for slots other
         # hosts own; shard_rows (tiny global metadata) sizes them, and
@@ -1123,18 +1123,25 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         n_val_local=(-(-val_bins.shape[0] // int(mesh.shape["data"]))
                      if val_bins is not None else 0),
         data_shards=int(mesh.shape["data"]), verbosity=params.verbosity)
+    shard_data = {"bins_shards": list(bins_shards),
+                  "label_shards": list(label_shards),
+                  "weight_shards": list(weight_shards),
+                  "sizes": sizes,
+                  "shard_rows": shard_rows,
+                  "init_score_shards": init_score_shards}
+    if params.boosting == "dart":
+        return _train_distributed_dart(
+            None, None, None, mapper, objective, params, cfg, mesh,
+            feature_names, init, rng, bag_rng, None,
+            val_bins=val_bins, val_labels=val_labels,
+            val_weights=val_weights, val_metric=val_metric,
+            callbacks=callbacks, shard_data=shard_data)
     return _train_distributed(
         None, None, None, mapper, objective, params, cfg, mesh,
         feature_names, init, rng, bag_rng,
         val_bins=val_bins, val_labels=val_labels,
         val_weights=val_weights, val_metric=val_metric,
-        callbacks=callbacks,
-        shard_data={"bins_shards": list(bins_shards),
-                    "label_shards": list(label_shards),
-                    "weight_shards": list(weight_shards),
-                    "sizes": sizes,
-                    "shard_rows": shard_rows,
-                    "init_score_shards": init_score_shards})
+        callbacks=callbacks, shard_data=shard_data)
 
 
 def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
@@ -1341,7 +1348,7 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
                             cfg, mesh, feature_names, init, rng, bag_rng,
                             init_scores, val_bins=None, val_labels=None,
                             val_weights=None, val_metric=None,
-                            callbacks=None) -> Booster:
+                            callbacks=None, shard_data=None) -> Booster:
     """Dart boosting over a data-only mesh.
 
     Dropout bookkeeping (which trees drop, per-tree scales) is host-side
@@ -1354,9 +1361,8 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..core.mesh import DATA_AXIS
     from .distributed import (make_dart_step, make_tree_predict,
-                              prepare_arrays)
+                              prepare_arrays, prepare_arrays_from_shards)
 
-    n, f = bins.shape
     K = objective.num_model_per_iteration
     T = params.num_iterations
     use_bag = params.bagging_freq > 0 and params.bagging_fraction < 1.0
@@ -1365,10 +1371,29 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
         log.warning("faultTolerantRetries is inert for boostingType='dart'"
                     " (per-iteration host loop; no chunk snapshots)")
 
-    bins_np = np.asarray(bins, mapper.bin_dtype)
-    bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
-        bins_np, np.asarray(labels), np.asarray(w, np.float32), mesh, K,
-        init, init_scores)
+    if shard_data is not None:
+        sizes = list(shard_data["sizes"])
+        S_sh = max(sizes)
+        n = sum(sizes)
+        f = next(b.shape[1] for b in shard_data["bins_shards"]
+                 if b is not None)
+        real_pos = np.concatenate(
+            [d * S_sh + np.arange(sz) for d, sz in enumerate(sizes)])
+        n_padded = len(sizes) * S_sh
+        bins_d, labels_d, w_d, real, scores, rp, fp =             prepare_arrays_from_shards(
+                shard_data["bins_shards"], shard_data["label_shards"],
+                shard_data["weight_shards"], mesh, K, init,
+                mapper.bin_dtype,
+                shard_rows=shard_data.get("shard_rows"),
+                init_score_shards=shard_data.get("init_score_shards"))
+    else:
+        n, f = bins.shape
+        bins_np = np.asarray(bins, mapper.bin_dtype)
+        bins_d, labels_d, w_d, real, scores, rp, fp = prepare_arrays(
+            bins_np, np.asarray(labels), np.asarray(w, np.float32), mesh,
+            K, init, init_scores)
+        real_pos = np.arange(n)
+        n_padded = n + rp
     fi_base = np.zeros((f + fp, 3), np.float32)
     fi_base[:f] = _feat_info_from_mapper(mapper, f)
     L = params.num_leaves
@@ -1386,13 +1411,14 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
     units: List[TreeArrays] = []      # per-iteration unit (tree | K-stack)
     trees_list: List[TreeArrays] = []  # flat, iteration-major class-minor
     scales: List[float] = []
-    real_np = np.concatenate([np.ones(n, np.float32),
-                              np.zeros(rp, np.float32)])
     bag_sh = NamedSharding(mesh, P(DATA_AXIS))
 
     def upload_bag(mask_n):
-        padded = np.concatenate([mask_n, np.zeros(rp, np.float32)])
-        return jax.device_put(jnp.asarray(padded * real_np), bag_sh)
+        # scatter the n-row mask into the padded global layout (pad rows
+        # stay 0; under sharded ingestion real rows sit per-shard slice)
+        padded = np.zeros(n_padded, np.float32)
+        padded[real_pos] = mask_n
+        return jax.device_put(jnp.asarray(padded), bag_sh)
 
     bagm = upload_bag(np.ones(n, np.float32))
     for it in range(T):

@@ -80,10 +80,12 @@ class TestShardedIngestion:
         with pytest.raises(ValueError, match="requires a mesh"):
             train(bs, ls, ws, mapper, obj,
                   TrainParams(num_iterations=2), mesh=None)
-        with pytest.raises(NotImplementedError, match="dart"):
+        # ranking stays monolithic-only (query packing needs global sort)
+        with pytest.raises(NotImplementedError, match="ranking"):
             train(bs, ls, ws, mapper, obj,
-                  TrainParams(num_iterations=2, boosting="dart"),
-                  mesh=build_mesh(data=8, feature=1))
+                  TrainParams(num_iterations=2),
+                  mesh=build_mesh(data=8, feature=1),
+                  grad_fn_override=lambda s: (s, s))
 
 
 class TestShardedIngestionLifted:
@@ -178,3 +180,28 @@ class TestShardedIngestionLifted:
         margins = model.predict_margin(X)
         from sklearn.metrics import roc_auc_score
         assert roc_auc_score(y, margins) > 0.9
+
+
+    def test_sharded_dart_matches_monolithic(self, data):
+        """dart under sharded ingestion: same dropSeed and shard-concat
+        row order => identical forest vs monolithic mesh dart."""
+        X, y = data
+        mapper = fit_bin_mapper(X, max_bin=63)
+        bs, ls, ws, idx = _shards(X, y, mapper)
+        perm = np.concatenate(idx)
+        params = TrainParams(num_iterations=6, num_leaves=7,
+                             min_data_in_leaf=5, max_bin=63,
+                             boosting="dart", drop_rate=0.5, verbosity=0)
+        sharded = train(bs, ls, ws, mapper, get_objective("binary"),
+                        params, mesh=build_mesh(data=8, feature=1))
+        mono = train(mapper.transform_packed(X[perm]), y[perm],
+                     np.ones(len(y)), mapper, get_objective("binary"),
+                     TrainParams(**{**params.__dict__}),
+                     mesh=build_mesh(data=8, feature=1))
+        st, mt = sharded.trees, mono.trees
+        assert len(st) == len(mt) == 6
+        for a, b in zip(st, mt):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            assert abs(a.shrinkage - b.shrinkage) < 1e-12
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=2e-3, atol=1e-5)
