@@ -181,6 +181,58 @@ def _dart_draw_drops(dart_rng, n_trees: int, params) -> np.ndarray:
     return np.zeros(0, np.int64)
 
 
+def _dart_host_loop(T, K, dart_rng, params, scores, bag_draw, fi_draw,
+                    grow_unit, unit_margin, callbacks, val_hook=None,
+                    units_out=None):
+    """THE dart dropout bookkeeping — serial and mesh run this one loop
+    (the serial↔mesh same-dropSeed parity contract holds by
+    construction).  Per iteration: draw drops, subtract the dropped
+    units' scaled margins, grow at the dropped-out scores via
+    ``grow_unit(s_minus, bag, fi) -> (unit, b_new)``, apply the 1/(k+1)
+    normalization, rescale the dropped units.  ``unit_margin(unit)``
+    scores a unit on the TRAINING rows; ``val_hook(it, unit, sel,
+    scales, norm)`` (optional) sees the PRE-update scales, matching the
+    validation-margin algebra.  Returns (units, flat trees_list
+    iteration-major class-minor, per-iteration scales, scores)."""
+    units: List[TreeArrays] = units_out if units_out is not None else []
+    trees_list: List[TreeArrays] = []
+    scales: List[float] = []
+    for it in range(T):
+        bag = bag_draw(it)
+        fi = fi_draw(it)
+        sel = _dart_draw_drops(dart_rng, len(units), params)
+        k = len(sel)
+        if k:
+            P = scales[sel[0]] * unit_margin(units[sel[0]])
+            for i in sel[1:]:
+                P = P + scales[i] * unit_margin(units[i])
+            s_minus = scores - P
+        else:
+            s_minus = scores
+        unit, b_new = grow_unit(s_minus, bag, fi)
+        norm = 1.0 / (k + 1)
+        scores = s_minus + norm * b_new
+        if k:
+            scores = scores + (k * norm) * P
+        if val_hook is not None:
+            val_hook(it, unit, sel, scales, norm)
+        if k:
+            for i in sel:
+                scales[i] *= k * norm
+        units.append(unit)
+        scales.append(norm)
+        if K == 1:
+            trees_list.append(unit)
+        else:
+            trees_list.extend(
+                jax.tree_util.tree_map(lambda a, kk=kk: a[kk], unit)
+                for kk in range(K))
+        if callbacks:
+            for cb in callbacks:
+                cb(it, trees_list)
+    return units, trees_list, scales, scores
+
+
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr", "K"))
 def _dart_step(bins, binsT, s_minus, labels, weights, bag, fi,
                obj: Objective, cfg: GrowerConfig, lr: float, K: int = 1):
@@ -575,12 +627,24 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     # first compile and fail fast with remediation if the fit can't fit
     from .budget import check_fit_budget
     _dn = (int(mesh.shape["data"]) if use_mesh else 1)
+    _bagging = params.bagging_freq > 0 and params.bagging_fraction < 1.0
+    # model the chunk the loop will ACTUALLY use: with nothing forcing a
+    # host sync the whole fit is ONE scan stacking T*K trees on device
+    _chunk = params.num_iterations
+    if _bagging:
+        _chunk = min(_chunk, 64)
+    if val_bins is not None:
+        _chunk = min(_chunk, 64)
+    if callbacks:
+        _chunk = min(_chunk, 8)
+    if params.fault_tolerant_retries > 0:
+        _chunk = min(_chunk, 32)
     check_fit_budget(
         n_local=-(-n // _dn), num_features=f,
         num_bins=mapper.num_total_bins, num_leaves=params.num_leaves,
-        num_class=K, chunk=min(64, params.num_iterations),
+        num_class=K, chunk=_chunk,
         bin_itemsize=np.dtype(mapper.bin_dtype).itemsize,
-        bagging=params.bagging_freq > 0 and params.bagging_fraction < 1.0,
+        bagging=_bagging,
         n_val_local=(-(-val_bins.shape[0] // _dn)
                      if val_bins is not None else 0),
         data_shards=_dn, verbosity=params.verbosity)
@@ -791,24 +855,19 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 return predict_tree_binned(unit, b, L_steps)
             return _dart_iter_margin(unit, b, L_steps)
 
-        units = []          # per-iteration unit (tree or K-stack)
-        trees_list = []     # flat, iteration-major class-minor (export)
-        scales: List[float] = []
-        for it in range(T):
+        bag_state = {"cur": np.ones(n, np.float32)}
+
+        def bag_draw(it):
             if use_bag and it % params.bagging_freq == 0:
-                cur_bag = (bag_rng.random(n) < params.bagging_fraction
-                           ).astype(np.float32)
-            bag_mask = jnp.asarray(cur_bag)
-            fi = jnp.asarray(iter_fi(it))
-            sel = _dart_draw_drops(dart_rng, len(units), params)
-            k = len(sel)
-            if k:
-                P = scales[sel[0]] * unit_margin(units[sel[0]], bins_d)
-                for i in sel[1:]:
-                    P = P + scales[i] * unit_margin(units[i], bins_d)
-                s_minus = scores - P
-            else:
-                s_minus = scores
+                bag_state["cur"] = (
+                    bag_rng.random(n) < params.bagging_fraction
+                ).astype(np.float32)
+            return jnp.asarray(bag_state["cur"])
+
+        def fi_draw(it):
+            return jnp.asarray(iter_fi(it))
+
+        def grow_unit(s_minus, bag_mask, fi):
             if grad_fn_override is not None:
                 # ranking dart (single-model): gradients at the dropped-
                 # out scores through the query-structured closure
@@ -818,41 +877,38 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 unit, row_leaf = run_grow_dart(bins_d, gh, fi,
                                                binsT=binsT_d)
                 unit = apply_shrinkage(unit, params.learning_rate)
-                b_new = unit.leaf_value[row_leaf]
-            else:
-                unit, b_new = run_dart(bins_d, binsT_d, s_minus, labels_d,
-                                       weights_d, bag_mask, fi)
-            norm = 1.0 / (k + 1)
-            scores = s_minus + norm * b_new
-            if k:
-                scores = scores + (k * norm) * P
-                if has_val:
-                    P_val = scales[sel[0]] * unit_margin(units[sel[0]],
-                                                         val_bins_d)
-                    for i in sel[1:]:
-                        P_val = P_val + scales[i] * unit_margin(
-                            units[i], val_bins_d)
-                    val_scores = val_scores - norm * P_val
-                for i in sel:
-                    scales[i] *= k * norm
-            if has_val:
-                val_scores = val_scores + norm * unit_margin(unit,
-                                                             val_bins_d)
-                metric = float(val_metric(np.asarray(val_scores),
-                                          val_labels_np, val_weights))
-                if metric < best_metric - 1e-12:
-                    best_metric, best_iter = metric, it
-            units.append(unit)
-            scales.append(norm)
-            if K == 1:
-                trees_list.append(unit)
-            else:
-                trees_list.extend(
-                    jax.tree_util.tree_map(lambda a, kk=kk: a[kk], unit)
-                    for kk in range(K))
-            if callbacks:
-                for cb in callbacks:
-                    cb(it, trees_list)
+                return unit, unit.leaf_value[row_leaf]
+            return run_dart(bins_d, binsT_d, s_minus, labels_d,
+                            weights_d, bag_mask, fi)
+
+        val_state = {"scores": val_scores if has_val else None,
+                     "best": (np.inf, -1)}
+
+        def val_hook(it, unit, sel, scales_pre, norm):
+            if not has_val:
+                return
+            vs = val_state["scores"]
+            if len(sel):
+                P_val = scales_pre[sel[0]] * unit_margin(
+                    units_ref[sel[0]], val_bins_d)
+                for i in sel[1:]:
+                    P_val = P_val + scales_pre[i] * unit_margin(
+                        units_ref[i], val_bins_d)
+                vs = vs - norm * P_val
+            vs = vs + norm * unit_margin(unit, val_bins_d)
+            val_state["scores"] = vs
+            metric = float(val_metric(np.asarray(vs), val_labels_np,
+                                      val_weights))
+            best, bi = val_state["best"]
+            if metric < best - 1e-12:
+                val_state["best"] = (metric, it)
+
+        # the hook needs the unit list the loop is building
+        units_ref: List[TreeArrays] = []
+        units, trees_list, scales, scores = _dart_host_loop(
+            T, K, dart_rng, params, scores, bag_draw, fi_draw, grow_unit,
+            lambda u: unit_margin(u, bins_d), callbacks,
+            val_hook=val_hook if has_val else None, units_out=units_ref)
         if trees_list:
             trees_chunks = [jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *trees_list)]
@@ -1114,12 +1170,22 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
 
     from .budget import check_fit_budget
     f_sh = next(b.shape[1] for b in bins_shards if b is not None)
+    _bagging = params.bagging_freq > 0 and params.bagging_fraction < 1.0
+    _chunk = params.num_iterations
+    if _bagging:
+        _chunk = min(_chunk, 64)
+    if val_bins is not None:
+        _chunk = min(_chunk, 64)
+    if callbacks:
+        _chunk = min(_chunk, 8)
+    if params.fault_tolerant_retries > 0:
+        _chunk = min(_chunk, 32)
     check_fit_budget(
         n_local=max(sizes), num_features=f_sh,
         num_bins=mapper.num_total_bins, num_leaves=params.num_leaves,
-        num_class=K, chunk=min(64, params.num_iterations),
+        num_class=K, chunk=_chunk,
         bin_itemsize=np.dtype(mapper.bin_dtype).itemsize,
-        bagging=params.bagging_freq > 0 and params.bagging_fraction < 1.0,
+        bagging=_bagging,
         n_val_local=(-(-val_bins.shape[0] // int(mesh.shape["data"]))
                      if val_bins is not None else 0),
         data_shards=int(mesh.shape["data"]), verbosity=params.verbosity)
@@ -1408,9 +1474,6 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
     # decide here — val args are accepted for signature parity and ignored,
     # exactly like the serial dart path's inert metric would be.
     dart_rng = np.random.default_rng(params.drop_seed)
-    units: List[TreeArrays] = []      # per-iteration unit (tree | K-stack)
-    trees_list: List[TreeArrays] = []  # flat, iteration-major class-minor
-    scales: List[float] = []
     bag_sh = NamedSharding(mesh, P(DATA_AXIS))
 
     def upload_bag(mask_n):
@@ -1420,44 +1483,27 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
         padded[real_pos] = mask_n
         return jax.device_put(jnp.asarray(padded), bag_sh)
 
-    bagm = upload_bag(np.ones(n, np.float32))
-    for it in range(T):
+    bag_state = {"dev": upload_bag(np.ones(n, np.float32))}
+
+    def bag_draw(it):
         if use_bag and it % params.bagging_freq == 0:
-            bagm = upload_bag((bag_rng.random(n) < params.bagging_fraction
-                               ).astype(np.float32))
+            bag_state["dev"] = upload_bag(
+                (bag_rng.random(n) < params.bagging_fraction
+                 ).astype(np.float32))
+        return bag_state["dev"]
+
+    def fi_draw(_it):
         if use_ff:
-            fi = jnp.asarray(_draw_feature_fraction(
+            return jnp.asarray(_draw_feature_fraction(
                 rng, fi_base, f, params.feature_fraction))
-        else:
-            fi = jnp.asarray(fi_base)
-        sel = _dart_draw_drops(dart_rng, len(units), params)
-        k = len(sel)
-        if k:
-            Pd = scales[sel[0]] * pred(units[sel[0]], bins_d)
-            for i in sel[1:]:
-                Pd = Pd + scales[i] * pred(units[i], bins_d)
-            s_minus = scores - Pd
-        else:
-            s_minus = scores
-        unit, b_new = step(bins_d, binsT_d, s_minus, labels_d, w_d,
-                           bagm, fi)
-        norm = 1.0 / (k + 1)
-        scores = s_minus + norm * b_new
-        if k:
-            scores = scores + (k * norm) * Pd
-            for i in sel:
-                scales[i] *= k * norm
-        units.append(unit)
-        scales.append(norm)
-        if K == 1:
-            trees_list.append(unit)
-        else:
-            trees_list.extend(
-                jax.tree_util.tree_map(lambda a, kk=kk: a[kk], unit)
-                for kk in range(K))
-        if callbacks:
-            for cb in callbacks:
-                cb(it, trees_list)
+        return jnp.asarray(fi_base)
+
+    def grow_unit(s_minus, bagm, fi):
+        return step(bins_d, binsT_d, s_minus, labels_d, w_d, bagm, fi)
+
+    units, trees_list, scales, scores = _dart_host_loop(
+        T, K, dart_rng, params, scores, bag_draw, fi_draw, grow_unit,
+        lambda u: pred(u, bins_d), callbacks)
 
     trees_chunks = []
     if trees_list:
@@ -1526,8 +1572,21 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                 "sampled-tree score update reads whole feature rows); "
                 "use parallelism='data' / feature=1")
         dn_pre = int(mesh.shape[DATA_AXIS])
-        s_local = (S_sh if shard_data is not None
-                   else pad_to_multiple(n, dn_pre) // dn_pre)
+        if shard_data is not None:
+            # k1/k2 are SPMD trace constants shared by every shard; size
+            # them from the MEAN real shard rows.  Pad rows carry zero
+            # gradients, so an undersized shard degrades gracefully
+            # toward training on all its rows (the tiny-shard fallback),
+            # never toward corrupt contributions — but warn when the
+            # layout is badly skewed.
+            s_local = max(1, int(np.ceil(n / len(sizes))))
+            if max(sizes) > 2 * min(sizes) and params.verbosity >= 0:
+                log.warning(
+                    "GOSS with sharded ingestion: shard sizes %s are "
+                    "imbalanced; per-shard sample fractions will differ "
+                    "(small shards train closer to full)", sizes)
+        else:
+            s_local = pad_to_multiple(n, dn_pre) // dn_pre
         k1 = max(1, int(np.ceil(s_local * params.top_rate)))
         k2 = max(1, int(np.ceil(s_local * params.other_rate)))
         if k1 + k2 >= s_local:

@@ -120,8 +120,10 @@ def _ffi_lib():
         _FFI_LIB = False
         if not os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
             path = os.path.join(_HERE, "fasthist_ffi.bin")
-            if os.path.exists(path) or _build_ffi("fasthist_ffi.cc",
-                                                  "fasthist_ffi"):
+            src = os.path.join(_HERE, "fasthist_ffi.cc")
+            fresh = (os.path.exists(path)
+                     and os.path.getmtime(path) >= os.path.getmtime(src))
+            if fresh or _build_ffi("fasthist_ffi.cc", "fasthist_ffi"):
                 import ctypes
                 try:
                     _FFI_LIB = ctypes.cdll.LoadLibrary(path)

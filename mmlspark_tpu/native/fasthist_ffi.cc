@@ -144,12 +144,14 @@ static ffi::Error PartitionImpl(ffi::Buffer<ffi::S32> row_order,
   const uint32_t* bits = cat_bits.typed_data();
   if (off < 0) off = 0;
   if (off + cnt > m) cnt = m - off;
+  const int64_t max_bin = cat_bits.dimensions()[0] * 32;  // bitset span
   std::vector<int32_t> right;
   right.reserve(static_cast<size_t>(cnt));
   int64_t w = off;
   for (int64_t i = 0; i < cnt; ++i) {
     const int32_t row = ro[off + i];
     int64_t bin = (row >= 0 && row < n) ? c[row] : 0;
+    if (bin >= max_bin) bin = max_bin - 1;  // clamp, like the hist kernels
     const bool left = use_cat ? ((bits[bin >> 5] >> (bin & 31)) & 1u) != 0
                               : bin <= thr;
     if (left) {

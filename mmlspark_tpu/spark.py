@@ -123,15 +123,23 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
 
         frames = [pd.DataFrame(b) if not isinstance(b, pd.DataFrame)
                   else b for b in batches]
-        pdf = pd.concat(frames, ignore_index=True)
-        first = pdf[feature_col].iloc[0]
-        X = (np.stack([np.asarray(v, np.float64)
-                       for v in pdf[feature_col]])
-             if isinstance(first, (list, tuple, np.ndarray))
-             else pdf[[feature_col]].to_numpy(np.float64))
-        y_local = pdf[label_col].to_numpy(np.float64)
-        w_local = (pdf[weight_col].to_numpy(np.float64)
-                   if weight_col else np.ones(len(y_local)))
+        pdf = (pd.concat(frames, ignore_index=True) if frames else None)
+        if pdf is None or len(pdf) == 0:
+            # empty partition (skewed repartition): this task contributes
+            # a zero-row shard — it must still reach the rendezvous and
+            # allgathers below, or the other barrier tasks hang
+            X = np.zeros((0, mapper.num_features), np.float64)
+            y_local = np.zeros(0, np.float64)
+            w_local = np.zeros(0, np.float64)
+        else:
+            first = pdf[feature_col].iloc[0]
+            X = (np.stack([np.asarray(v, np.float64)
+                           for v in pdf[feature_col]])
+                 if isinstance(first, (list, tuple, np.ndarray))
+                 else pdf[[feature_col]].to_numpy(np.float64))
+            y_local = pdf[label_col].to_numpy(np.float64)
+            w_local = (pdf[weight_col].to_numpy(np.float64)
+                       if weight_col else np.ones(len(y_local)))
         bins_local = mapper.transform_packed(X)
 
         # global per-shard sizes + 1-D label/weight metadata: pad to the
