@@ -631,3 +631,28 @@ class TestMeshRankingGoss:
             build_mesh(data=8, feature=1)).fit(t)
         assert (a.getModel().save_native_model_string()
                 == b.getModel().save_native_model_string())
+
+
+class TestVotingMulticlass:
+    """Voting parallelism x multiclass: per-class trees each run the
+    PV-Tree two-phase vote over the shared data-sharded histograms."""
+
+    def test_voting_full_k_matches_data_parallel_multiclass(self):
+        from sklearn.datasets import make_classification
+        X, y = make_classification(n_samples=900, n_features=10,
+                                   n_informative=6, n_classes=3,
+                                   random_state=12)
+        t = {"features": X, "label": y.astype(float)}
+        kw = dict(numIterations=4, numLeaves=7, minDataInLeaf=5,
+                  verbosity=0)
+        dp = LightGBMClassifier(**kw, parallelism="data").setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        vt = LightGBMClassifier(**kw, parallelism="voting", topK=10
+                                ).setMesh(build_mesh(data=8, feature=1)
+                                          ).fit(t)
+        st, vtr = dp.getModel().trees, vt.getModel().trees
+        assert len(st) == len(vtr) == 12
+        for a, b in zip(st, vtr):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=2e-3, atol=1e-5)
