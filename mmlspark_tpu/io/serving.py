@@ -377,6 +377,19 @@ class MultiprocessHTTPServer:
                 if waiter is not None:
                     waiter.response = msg["delivered"]
                     waiter.event.set()
+        # worker gone (crash/kill): its parked sockets died with it.
+        # Purge its routes so replies report undelivered immediately and
+        # release any reply() calls waiting on acks from this worker —
+        # the surviving workers keep serving (the reference's executor
+        # loss story, SURVEY.md §5.3 applied to serving).
+        with self._lock:
+            dead = [r for r, i in self._route.items() if i == idx]
+            for r in dead:
+                self._route.pop(r, None)
+                waiter = self._acks.pop(r, None)
+                if waiter is not None:
+                    waiter.response = False
+                    waiter.event.set()
 
     def _send(self, idx: int, obj) -> None:
         data = (json.dumps(obj) + "\n").encode("utf-8")
@@ -406,8 +419,14 @@ class MultiprocessHTTPServer:
                 return False
             waiter = _Pending()
             self._acks[request_id] = waiter
-        self._send(idx, {"op": "reply", "rid": request_id,
-                         "response": response, "status": status})
+        try:
+            self._send(idx, {"op": "reply", "rid": request_id,
+                             "response": response, "status": status})
+        except OSError:
+            # worker process died between park and reply: undelivered
+            with self._lock:
+                self._acks.pop(request_id, None)
+            return False
         if not waiter.event.wait(self._reply_timeout + 5.0):
             with self._lock:
                 self._acks.pop(request_id, None)

@@ -184,3 +184,40 @@ class TestDistributedServing:
             assert all(p.is_alive() for p in srv._procs)
         finally:
             srv.stop()
+
+    def test_worker_death_leaves_service_up(self):
+        """Kill one worker PROCESS mid-flight: its parked request reports
+        undelivered, and the surviving worker keeps serving — the
+        executor-loss story applied to serving."""
+        import os
+        import signal
+        import time
+        srv = MultiprocessHTTPServer(num_workers=2).start()
+        try:
+            t = threading.Thread(
+                target=lambda: _post(srv.addresses[0], {"x": 1},
+                                     timeout=5))
+            t.daemon = True
+            t.start()
+            batch = srv.get_batch(max_rows=1, timeout=5.0)
+            assert len(batch) == 1
+            rid0 = batch[0][0]
+            os.kill(srv._procs[0].pid, signal.SIGKILL)
+            time.sleep(0.5)
+            # reply to the dead worker's socket: undelivered, no hang
+            t0 = time.time()
+            assert srv.reply(rid0, {"y": 1}) is False
+            assert time.time() - t0 < 5
+            # the OTHER worker still serves end to end
+            got = {}
+            t2 = threading.Thread(
+                target=lambda: got.update(_post(srv.addresses[1],
+                                                {"x": 2}, timeout=10)))
+            t2.start()
+            batch = srv.get_batch(max_rows=1, timeout=5.0)
+            assert len(batch) == 1
+            assert srv.reply(batch[0][0], {"y": 4}) is True
+            t2.join(10)
+            assert got == {"y": 4}
+        finally:
+            srv.stop()
