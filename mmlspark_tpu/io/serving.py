@@ -112,6 +112,8 @@ class HTTPServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            disable_nagle_algorithm = True   # ms-latency serving contract
+
             def log_message(self, *a):  # quiet
                 pass
 
@@ -226,6 +228,9 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     import socket as _socket
 
     conn = _socket.create_connection((driver_host, driver_port))
+    # the exchange is a request/reply line protocol: without TCP_NODELAY,
+    # Nagle + delayed-ACK quantizes every reply at ~40 ms
+    conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
     rfile = conn.makefile("r", encoding="utf-8")
     wlock = threading.Lock()
 
@@ -238,6 +243,8 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     plock = threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
+        disable_nagle_algorithm = True   # ms-latency serving contract
+
         def log_message(self, *a):  # quiet
             pass
 
@@ -336,8 +343,10 @@ class MultiprocessHTTPServer:
     def start(self) -> "MultiprocessHTTPServer":
         for p in self._procs:
             p.start()
+        import socket as _socket
         for _ in self._procs:
             conn, _ = self._listener.accept()
+            conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
             idx = len(self._conns)
             self._conns.append(conn)
             self._wlocks.append(threading.Lock())

@@ -1,0 +1,103 @@
+"""Serving latency characterization (the reference's DistributedHTTPSource
+claims millisecond-class latency; SURVEY.md §3.4).
+
+Measures end-to-end HTTP round-trip latency through the micro-batch
+serving loop for both topologies:
+
+* threads  — DistributedHTTPServer (N thread-workers, one process)
+* processes — MultiprocessHTTPServer (N worker OS processes, TCP exchange)
+
+Prints one JSON line per topology with p50/p95/p99 (ms) under sequential
+and concurrent load.  Run: ``python tools/bench_serving.py``.
+"""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+
+from mmlspark_tpu.io.serving import (DistributedHTTPServer,  # noqa: E402
+                                     MultiprocessHTTPServer,
+                                     reply_from_table, request_table)
+
+
+def _post(addr, payload, timeout=10.0):
+    req = urllib.request.Request(
+        addr, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _driver_loop(srv, stop):
+    import numpy as np
+    while not stop.is_set():
+        batch = srv.get_batch(max_rows=64, timeout=0.005)
+        if not batch:
+            continue
+        t = request_table(batch)
+        t = t.withColumn("reply", np.asarray(
+            [{"y": float(v) * 2} for v in t["x"]], dtype=object))
+        reply_from_table(srv, t, "reply")
+
+
+def _percentiles(lat):
+    import numpy as np
+    a = np.asarray(sorted(lat)) * 1000.0
+    return {"p50_ms": round(float(np.percentile(a, 50)), 2),
+            "p95_ms": round(float(np.percentile(a, 95)), 2),
+            "p99_ms": round(float(np.percentile(a, 99)), 2)}
+
+
+def bench(kind, n_seq=200, n_conc=200, conc=16):
+    cls = (DistributedHTTPServer if kind == "threads"
+           else MultiprocessHTTPServer)
+    srv = cls(num_workers=3).start()
+    stop = threading.Event()
+    drv = threading.Thread(target=_driver_loop, args=(srv, stop),
+                           daemon=True)
+    drv.start()
+    try:
+        addrs = srv.addresses
+        _post(addrs[0], {"x": 0})          # warm
+        seq = []
+        for i in range(n_seq):
+            t0 = time.perf_counter()
+            _post(addrs[i % len(addrs)], {"x": i})
+            seq.append(time.perf_counter() - t0)
+        conc_lat = []
+        lock = threading.Lock()
+
+        def client(i):
+            t0 = time.perf_counter()
+            _post(addrs[i % len(addrs)], {"x": i})
+            with lock:
+                conc_lat.append(time.perf_counter() - t0)
+
+        threads = []
+        for i in range(n_conc):
+            th = threading.Thread(target=client, args=(i,))
+            th.start()
+            threads.append(th)
+            if len(threads) >= conc:
+                for th2 in threads:
+                    th2.join(20)
+                threads = []
+        for th in threads:
+            th.join(20)
+        print(json.dumps({
+            "topology": kind,
+            "sequential": _percentiles(seq),
+            f"concurrent_{conc}": _percentiles(conc_lat),
+        }), flush=True)
+    finally:
+        stop.set()
+        srv.stop()
+
+
+if __name__ == "__main__":
+    bench("threads")
+    bench("processes")
