@@ -1,0 +1,71 @@
+"""Criteo-class shape stress run (BASELINE config 5; VERDICT r3 next #8).
+
+Trains the numLeaves=255 x maxBin=255 configuration at 10M rows x 39
+features end-to-end (few iterations — the point is the SHAPE: binning,
+budget guard, bucket machinery, (255, 39, 256, 3) leaf-histogram state),
+reporting wall-clock per phase and peak RSS.  Run on whatever backend
+jax selects; pass --rows/--iters to scale.
+"""
+
+import argparse
+import json
+import resource
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--features", type=int, default=39)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+    from mmlspark_tpu.gbdt.budget import estimate_fit_bytes
+    from mmlspark_tpu.gbdt.engine import TrainParams, train
+    from mmlspark_tpu.gbdt.objectives import get_objective
+
+    rng = np.random.default_rng(0)
+    out = {"rows": args.rows, "features": args.features,
+           "iters": args.iters, "num_leaves": 255, "max_bin": 255}
+    t0 = time.time()
+    X = rng.normal(size=(args.rows, args.features)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    out["gen_s"] = round(time.time() - t0, 1)
+
+    t0 = time.time()
+    mapper = fit_bin_mapper(X[:: max(1, args.rows // 1_000_000)],
+                            max_bin=255)   # sample-based bounds, as Criteo
+    out["mapper_s"] = round(time.time() - t0, 1)
+    t0 = time.time()
+    bins = mapper.transform_packed(X)
+    out["binning_s"] = round(time.time() - t0, 1)
+    del X
+
+    est = estimate_fit_bytes(args.rows, args.features,
+                             mapper.num_total_bins, 255)
+    out["budget_gb"] = round(est["total"] / 1e9, 2)
+
+    params = TrainParams(num_iterations=args.iters, num_leaves=255,
+                         max_bin=255, min_data_in_leaf=20, verbosity=1)
+    t0 = time.time()
+    booster = train(bins, y, None, mapper, get_objective("binary"),
+                    params)
+    out["train_s"] = round(time.time() - t0, 1)
+    out["s_per_tree"] = round(out["train_s"] / args.iters, 2)
+    out["trees"] = len(booster.trees)
+    out["peak_rss_gb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
