@@ -34,6 +34,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 EXACT_METHODS = ["segment", "dot16", "onehot", "pallas"]
 ALL_METHODS = EXACT_METHODS + ["pallas_bf16"]
+# "native" (XLA FFI custom call) is CPU-only and auto-selected there
+# without consulting the sweep table; include it explicitly with
+# --methods to measure it against the XLA formulations.
 
 
 def main():
