@@ -7,9 +7,12 @@ and the whole forward is one jitted XLA program — operator fusion comes from
 the compiler rather than onnxruntime's executor.  Supports the core
 CNN/MLP operator set (Conv, Gemm/MatMul, BatchNorm, pooling, activations,
 elementwise, Reshape/Flatten/Concat/Transpose, Softmax, LRN, Dropout-as-
-identity) plus the tensor-manipulation tier (Gather, Shape, Slice, Split,
-Reduce*/Arg*, Where, comparisons, Expand, Tile, ConstantOfShape, Range,
-Pad, LayerNormalization).  Shape-like operands (Reshape/Slice/Expand/...)
+identity) plus the tensor-manipulation tier (Gather/GatherElements, Shape, Slice,
+Split, the full Reduce* family, Arg*, TopK, CumSum, OneHot, Where,
+comparisons/logicals, Expand, Tile, ConstantOfShape, Range, Pad,
+LayerNormalization, Einsum, Trilu, Depth/SpaceToDepth) and an extended
+activation tier (Elu/Selu/Celu/Gelu/Mish/HardSigmoid/HardSwish/Shrink,
+trig/hyperbolic).  Shape-like operands (Reshape/Slice/Expand/...)
 must be constants/initializers — static shapes are the XLA contract.
 Unsupported ops (or unsupported attribute forms) raise with the op name.
 """
@@ -115,12 +118,41 @@ _UNARY = {
     "Exp": jnp.exp, "Log": jnp.log, "Neg": jnp.negative, "Sqrt": jnp.sqrt,
     "Abs": jnp.abs, "Erf": jax.lax.erf, "Floor": jnp.floor,
     "Ceil": jnp.ceil, "Identity": lambda x: x, "Softplus": jax.nn.softplus,
+    # ONNX Round is round-half-to-even, which numpy/jnp.round implements
+    "Round": jnp.round, "Sign": jnp.sign,
+    "Reciprocal": lambda x: 1.0 / x, "Softsign": jax.nn.soft_sign,
+    "Sin": jnp.sin, "Cos": jnp.cos, "Tan": jnp.tan,
+    "Asin": jnp.arcsin, "Acos": jnp.arccos, "Atan": jnp.arctan,
+    "Sinh": jnp.sinh, "Cosh": jnp.cosh,
+    "Asinh": jnp.arcsinh, "Acosh": jnp.arccosh, "Atanh": jnp.arctanh,
+    "Not": jnp.logical_not, "IsNaN": jnp.isnan,
+    "Mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "HardSwish": jax.nn.hard_swish,
 }
 
 _BINARY = {
     "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
-    "Div": jnp.divide, "Pow": jnp.power, "Max": jnp.maximum,
-    "Min": jnp.minimum,
+    "Div": jnp.divide, "Pow": jnp.power,
+    "And": jnp.logical_and, "Or": jnp.logical_or,
+    "Xor": jnp.logical_xor,
+    "GreaterOrEqual": jnp.greater_equal, "LessOrEqual": jnp.less_equal,
+    "PRelu": lambda x, s: jnp.where(x < 0, s * x, x),
+}
+
+#: reductions sharing the axes/keepdims/noop_with_empty_axes contract
+_REDUCE = {
+    "ReduceSum": jnp.sum, "ReduceMax": jnp.max, "ReduceMin": jnp.min,
+    "ReduceMean": jnp.mean, "ReduceProd": jnp.prod,
+    "ReduceL1": lambda x, axis, keepdims: jnp.sum(
+        jnp.abs(x), axis=axis, keepdims=keepdims),
+    "ReduceL2": lambda x, axis, keepdims: jnp.sqrt(jnp.sum(
+        jnp.square(x), axis=axis, keepdims=keepdims)),
+    "ReduceSumSquare": lambda x, axis, keepdims: jnp.sum(
+        jnp.square(x), axis=axis, keepdims=keepdims),
+    "ReduceLogSum": lambda x, axis, keepdims: jnp.log(jnp.sum(
+        x, axis=axis, keepdims=keepdims)),
+    "ReduceLogSumExp": lambda x, axis, keepdims: jax.nn.logsumexp(
+        x, axis=axis, keepdims=keepdims),
 }
 
 
@@ -268,9 +300,8 @@ def _eval_node(node: Dict[str, Any], env: Dict[str, Any]):
                                  axis=ax)
             for i in range(len(sizes)))
         return pieces if len(pieces) > 1 else pieces[0]
-    if op in ("ReduceSum", "ReduceMax", "ReduceMin"):
-        fn = {"ReduceSum": jnp.sum, "ReduceMax": jnp.max,
-              "ReduceMin": jnp.min}[op]
+    if op in _REDUCE:
+        fn = _REDUCE[op]
         axes = attrs.get("axes") or (
             np.asarray(env[ins[1]]).tolist() if len(ins) > 1 and ins[1]
             else None)
@@ -278,6 +309,138 @@ def _eval_node(node: Dict[str, Any], env: Dict[str, Any]):
             return env[ins[0]]       # spec: empty axes + flag = identity
         return fn(env[ins[0]], axis=tuple(axes) if axes else None,
                   keepdims=bool(attrs.get("keepdims", 1)))
+    if op in ("Sum", "Mean", "Max", "Min"):   # variadic elementwise
+        fold = {"Max": jnp.maximum, "Min": jnp.minimum}.get(op, jnp.add)
+        acc = env[ins[0]]
+        for i in ins[1:]:
+            acc = fold(acc, env[i])
+        return acc / len(ins) if op == "Mean" else acc
+    if op == "Mod":
+        x, y = env[ins[0]], env[ins[1]]
+        # fmod=1: C-style sign-of-dividend; default: python/numpy mod
+        return jnp.fmod(x, y) if attrs.get("fmod") else jnp.mod(x, y)
+    if op == "Elu":
+        a = attrs.get("alpha", 1.0)
+        x = env[ins[0]]
+        return jnp.where(x < 0, a * (jnp.exp(x) - 1.0), x)
+    if op == "Selu":
+        a = attrs.get("alpha", 1.67326319217681884765625)
+        g = attrs.get("gamma", 1.05070102214813232421875)
+        x = env[ins[0]]
+        return g * jnp.where(x <= 0, a * (jnp.exp(x) - 1.0), x)
+    if op == "Celu":
+        a = attrs.get("alpha", 1.0)
+        x = env[ins[0]]
+        return jnp.maximum(x, 0) + jnp.minimum(
+            0, a * (jnp.exp(x / a) - 1.0))
+    if op == "ThresholdedRelu":
+        a = attrs.get("alpha", 1.0)
+        x = env[ins[0]]
+        return jnp.where(x > a, x, 0.0)
+    if op == "HardSigmoid":
+        a = attrs.get("alpha", 0.2)
+        b = attrs.get("beta", 0.5)
+        return jnp.clip(a * env[ins[0]] + b, 0.0, 1.0)
+    if op == "Gelu":
+        approx = attrs.get("approximate", b"none")
+        approx = approx.decode() if isinstance(approx, bytes) else approx
+        return jax.nn.gelu(env[ins[0]], approximate=approx == "tanh")
+    if op == "Shrink":
+        lambd = attrs.get("lambd", 0.5)
+        bias = attrs.get("bias", 0.0)
+        x = env[ins[0]]
+        return jnp.where(x < -lambd, x + bias,
+                         jnp.where(x > lambd, x - bias, 0.0))
+    if op == "IsInf":
+        x = env[ins[0]]
+        pos = bool(attrs.get("detect_positive", 1))
+        neg = bool(attrs.get("detect_negative", 1))
+        out = jnp.zeros(x.shape, bool)
+        if pos:
+            out = out | (x == jnp.inf)
+        if neg:
+            out = out | (x == -jnp.inf)
+        return out
+    if op == "Hardmax":
+        x = env[ins[0]]
+        ax = attrs.get("axis", -1)
+        ax = ax + x.ndim if ax < 0 else ax
+        return jax.nn.one_hot(jnp.argmax(x, axis=ax), x.shape[ax],
+                              axis=ax, dtype=x.dtype)
+    if op == "TopK":
+        x = env[ins[0]]
+        k = int(np.asarray(env[ins[1]]).reshape(()).item())
+        ax = attrs.get("axis", -1)
+        ax = ax + x.ndim if ax < 0 else ax
+        largest = bool(attrs.get("largest", 1))
+        moved = jnp.moveaxis(x, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, k)
+        if not largest:
+            vals = -vals
+        return [jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int64)]
+    if op == "CumSum":
+        x = env[ins[0]]
+        ax = int(np.asarray(env[ins[1]]).reshape(()).item())
+        rev = bool(attrs.get("reverse", 0))
+        if rev:
+            x = jnp.flip(x, axis=ax)
+        out = jnp.cumsum(x, axis=ax)
+        if attrs.get("exclusive"):
+            pad = [(0, 0)] * x.ndim
+            pad[ax] = (1, 0)
+            out = jnp.pad(out, pad)
+            out = jax.lax.slice_in_dim(out, 0, x.shape[ax], axis=ax)
+        return jnp.flip(out, axis=ax) if rev else out
+    if op == "OneHot":
+        indices = env[ins[0]]
+        depth = int(np.asarray(env[ins[1]]).reshape(()).item())
+        values = jnp.asarray(env[ins[2]])       # [off_value, on_value]
+        ax = attrs.get("axis", -1)
+        oh = jax.nn.one_hot(jnp.mod(indices, depth), depth, axis=ax)
+        return oh * (values[1] - values[0]) + values[0]
+    if op == "GatherElements":
+        x = env[ins[0]]
+        idx = env[ins[1]].astype(jnp.int64)
+        ax = attrs.get("axis", 0)
+        idx = jnp.where(idx < 0, idx + x.shape[ax], idx)
+        return jnp.take_along_axis(x, idx, axis=ax)
+    if op == "Einsum":
+        eq = attrs.get("equation", b"")
+        eq = eq.decode() if isinstance(eq, bytes) else eq
+        return jnp.einsum(eq, *[env[i] for i in ins])
+    if op == "Trilu":
+        x = env[ins[0]]
+        k = (int(np.asarray(env[ins[1]]).reshape(()).item())
+             if len(ins) > 1 and ins[1] else 0)
+        return (jnp.triu(x, k) if attrs.get("upper", 1)
+                else jnp.tril(x, k))
+    if op == "EyeLike":
+        x = env[ins[0]]
+        return jnp.eye(x.shape[0], x.shape[1],
+                       k=attrs.get("k", 0), dtype=x.dtype)
+    if op == "Size":
+        return jnp.asarray(int(np.prod(env[ins[0]].shape)), jnp.int64)
+    if op == "DepthToSpace":
+        x = env[ins[0]]
+        b, c, h, w = x.shape
+        bs = attrs.get("blocksize")
+        mode = attrs.get("mode", b"DCR")
+        mode = mode.decode() if isinstance(mode, bytes) else mode
+        if mode == "DCR":
+            t = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+            t = t.transpose(0, 3, 4, 1, 5, 2)
+        else:                                   # CRD
+            t = x.reshape(b, c // (bs * bs), bs, bs, h, w)
+            t = t.transpose(0, 1, 4, 2, 5, 3)
+        return t.reshape(b, c // (bs * bs), h * bs, w * bs)
+    if op == "SpaceToDepth":
+        x = env[ins[0]]
+        b, c, h, w = x.shape
+        bs = attrs.get("blocksize")
+        t = x.reshape(b, c, h // bs, bs, w // bs, bs)
+        t = t.transpose(0, 3, 5, 1, 2, 4)
+        return t.reshape(b, c * bs * bs, h // bs, w // bs)
     if op in ("ArgMax", "ArgMin"):
         fn = jnp.argmax if op == "ArgMax" else jnp.argmin
         x = env[ins[0]]
@@ -380,7 +543,8 @@ class OnnxGraph:
         for node in self.graph["nodes"]:
             outs = node["outputs"]
             result = _eval_node(node, env)
-            if isinstance(result, tuple):      # multi-output op (Split)
+            if isinstance(result, (tuple, list)):  # multi-output op
+                # (Split, TopK, ...)
                 for o, r in zip(outs, result):
                     env[o] = r
             elif len(outs) == 1:

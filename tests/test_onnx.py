@@ -281,3 +281,153 @@ class TestExtendedOps:
         np.testing.assert_allclose(out[0], np.pad(x, [(0, 0), (2, 1)]))
         assert np.asarray(out[1])[0] == 2        # LAST tied index
         np.testing.assert_allclose(out[2], x)    # noop reduce = identity
+
+
+class TestRound4Ops:
+    """Round-4 op-tier expansion: activations, trig, extended reductions,
+    TopK/CumSum/OneHot/GatherElements/Einsum/Trilu, spatial reshuffles —
+    all checked against numpy/spec semantics through the wire codec."""
+
+    def _run(self, nodes, weights, inputs, outputs, feeds):
+        blob = proto.encode_model(nodes, weights, inputs=inputs,
+                                  outputs=outputs)
+        return OnnxGraph(blob)(*feeds)
+
+    def test_activations(self, rng):
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        nodes = [
+            proto.encode_node("Elu", ["x"], ["e"], alpha=0.7),
+            proto.encode_node("Selu", ["x"], ["s"]),
+            proto.encode_node("HardSigmoid", ["x"], ["h"]),
+            proto.encode_node("ThresholdedRelu", ["x"], ["t"], alpha=0.5),
+            proto.encode_node("Shrink", ["x"], ["k"], lambd=0.4, bias=0.1),
+        ]
+        e, s, h, t, k = self._run(
+            nodes, {}, [("x", [4, 5])],
+            [("e", [4, 5]), ("s", [4, 5]), ("h", [4, 5]), ("t", [4, 5]),
+             ("k", [4, 5])], [x])
+        np.testing.assert_allclose(
+            e, np.where(x < 0, 0.7 * (np.exp(x) - 1), x), rtol=1e-5)
+        a, g = 1.67326319217681884765625, 1.05070102214813232421875
+        np.testing.assert_allclose(
+            s, g * np.where(x <= 0, a * (np.exp(x) - 1), x), rtol=1e-5)
+        np.testing.assert_allclose(h, np.clip(0.2 * x + 0.5, 0, 1),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(t, np.where(x > 0.5, x, 0), rtol=1e-6)
+        np.testing.assert_allclose(
+            k, np.where(x < -0.4, x + 0.1,
+                        np.where(x > 0.4, x - 0.1, 0)), rtol=1e-5)
+
+    def test_trig_and_sign(self, rng):
+        x = (rng.uniform(-0.9, 0.9, size=(3, 4))).astype(np.float32)
+        nodes = [
+            proto.encode_node("Sin", ["x"], ["a"]),
+            proto.encode_node("Atan", ["x"], ["b"]),
+            proto.encode_node("Asinh", ["x"], ["c"]),
+            proto.encode_node("Sign", ["x"], ["d"]),
+            proto.encode_node("Round", ["x"], ["e"]),
+        ]
+        a, b, c, d, e = self._run(
+            nodes, {}, [("x", [3, 4])],
+            [(n, [3, 4]) for n in "abcde"], [x])
+        np.testing.assert_allclose(a, np.sin(x), rtol=1e-5)
+        np.testing.assert_allclose(b, np.arctan(x), rtol=1e-5)
+        np.testing.assert_allclose(c, np.arcsinh(x), rtol=1e-5)
+        np.testing.assert_array_equal(d, np.sign(x))
+        np.testing.assert_array_equal(e, np.round(x))  # half-to-even
+
+    def test_extended_reductions(self, rng):
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        nodes = [
+            proto.encode_node("ReduceL2", ["x"], ["l2"], axes=[1],
+                              keepdims=0),
+            proto.encode_node("ReduceProd", ["x"], ["p"], axes=[0],
+                              keepdims=1),
+            proto.encode_node("ReduceLogSumExp", ["x"], ["lse"], axes=[1],
+                              keepdims=0),
+        ]
+        l2, p, lse = self._run(
+            nodes, {}, [("x", [3, 6])],
+            [("l2", [3]), ("p", [1, 6]), ("lse", [3])], [x])
+        np.testing.assert_allclose(l2, np.sqrt((x ** 2).sum(1)), rtol=1e-5)
+        np.testing.assert_allclose(p, x.prod(0, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(
+            lse, np.log(np.exp(x).sum(1)), rtol=1e-5)
+
+    def test_topk_cumsum(self, rng):
+        x = rng.normal(size=(4, 7)).astype(np.float32)
+        nodes = [
+            proto.encode_node("TopK", ["x", "k"], ["v", "i"], axis=1),
+            proto.encode_node("CumSum", ["x", "ax"], ["c"], exclusive=1),
+        ]
+        v, i, c = self._run(
+            nodes, {"k": np.asarray([3], np.int64),
+                    "ax": np.asarray(1, np.int64)},
+            [("x", [4, 7])],
+            [("v", [4, 3]), ("i", [4, 3]), ("c", [4, 7])], [x])
+        ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(v, ref, rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.take_along_axis(x, np.asarray(i), axis=1), np.asarray(v))
+        ref_c = np.cumsum(x, axis=1)
+        ref_c = np.concatenate(
+            [np.zeros((4, 1), np.float32), ref_c[:, :-1]], axis=1)
+        np.testing.assert_allclose(c, ref_c, rtol=1e-5, atol=1e-6)
+
+    def test_onehot_gatherelements_einsum(self, rng):
+        idx = np.asarray([[0, 2], [1, 0]], np.int64)
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        y = rng.normal(size=(3, 4)).astype(np.float32)
+        nodes = [
+            proto.encode_node("OneHot", ["idx", "d", "vals"], ["oh"],
+                              axis=-1),
+            proto.encode_node("GatherElements", ["x", "ge_idx"], ["ge"],
+                              axis=1),
+            proto.encode_node("Einsum", ["x", "y"], ["mm"],
+                              equation="ij,jk->ik"),
+        ]
+        oh, ge, mm = self._run(
+            nodes, {"d": np.asarray(3, np.int64),
+                    "vals": np.asarray([0.0, 1.0], np.float32),
+                    "ge_idx": np.asarray([[1, 0], [2, 2]], np.int64)},
+            [("idx", [2, 2]), ("x", [2, 3]), ("y", [3, 4])],
+            [("oh", [2, 2, 3]), ("ge", [2, 2]), ("mm", [2, 4])],
+            [idx, x, y])
+        ref_oh = np.eye(3, dtype=np.float32)[idx]
+        np.testing.assert_array_equal(oh, ref_oh)
+        np.testing.assert_allclose(
+            ge, np.take_along_axis(x, np.asarray([[1, 0], [2, 2]]), 1),
+            rtol=1e-6)
+        np.testing.assert_allclose(mm, x @ y, rtol=1e-5)
+
+    def test_mod_logical_trilu(self, rng):
+        x = np.asarray([[5.0, -7.0], [9.0, 4.0]], np.float32)
+        y = np.asarray([[3.0, 3.0], [-4.0, 2.5]], np.float32)
+        sq = rng.normal(size=(4, 4)).astype(np.float32)
+        nodes = [
+            proto.encode_node("Mod", ["x", "y"], ["m"]),
+            proto.encode_node("Mod", ["x", "y"], ["fm"], fmod=1),
+            proto.encode_node("GreaterOrEqual", ["x", "y"], ["ge"]),
+            proto.encode_node("Trilu", ["sq"], ["tu"], upper=1),
+            proto.encode_node("Trilu", ["sq"], ["tl"], upper=0),
+        ]
+        m, fm, ge, tu, tl = self._run(
+            nodes, {"sq": sq}, [("x", [2, 2]), ("y", [2, 2])],
+            [("m", [2, 2]), ("fm", [2, 2]), ("ge", [2, 2]),
+             ("tu", [4, 4]), ("tl", [4, 4])], [x, y])
+        np.testing.assert_allclose(m, np.mod(x, y), rtol=1e-6)
+        np.testing.assert_allclose(fm, np.fmod(x, y), rtol=1e-6)
+        np.testing.assert_array_equal(ge, x >= y)
+        np.testing.assert_array_equal(tu, np.triu(sq))
+        np.testing.assert_array_equal(tl, np.tril(sq))
+
+    def test_depth_space_roundtrip(self, rng):
+        x = rng.normal(size=(2, 8, 4, 6)).astype(np.float32)
+        nodes = [
+            proto.encode_node("SpaceToDepth", ["x"], ["s"], blocksize=2),
+            proto.encode_node("DepthToSpace", ["s"], ["r"], blocksize=2),
+        ]
+        s, r = self._run(nodes, {}, [("x", [2, 8, 4, 6])],
+                         [("s", [2, 32, 2, 3]), ("r", [2, 8, 4, 6])], [x])
+        assert np.asarray(s).shape == (2, 32, 2, 3)
+        np.testing.assert_allclose(r, x, rtol=1e-6)  # DCR inverts S2D
