@@ -376,7 +376,8 @@ def make_tree_predict(mesh: Mesh, num_leaves: int, num_class: int = 1):
 
 def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
                       sigma: float, trunc: int, has_val: bool = False,
-                      goss=None):
+                      goss=None, bag_sharded: bool = False,
+                      rf: bool = False):
     """Mesh-sharded lambdarank boosting (SURVEY.md §3.1 distributed
     lambdarank, BASELINE config MSLR): rows arrive query-packed per data
     shard (see :func:`mmlspark_tpu.gbdt.ranking.shard_queries`), so the
@@ -395,36 +396,44 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
     top-|g·h| sample plus an amplified random remainder, exactly like
     distributed LightGBM's boosting=goss with a ranking objective.
     ``keys`` feeds the per-iteration PRNG (ignored otherwise).
+
+    ``bags``: (C, n) bagging masks scattered through the query-pack
+    permutation (constant (C, 1) when bagging is off); gradients and
+    hessians are masked, membership (``real``) is not.  ``rf``: trees
+    fit the gradients at the CONSTANT init scores, unshrunk (averaging
+    at export) — random-forest mode with the ranking objective.
     """
     from .ranking import lambda_grad_sorted
 
     cfg = _sharded_cfg(mesh, cfg)
 
     def steps(bins, scores, real, wmul, qidx, qmask, gains, labq, invmax,
-              keys, fis, val_bins, val_scores):
+              keys, bags, fis, val_bins, val_scores):
         nl = scores.shape[0]
         binsT = bins.T   # fit-invariant; hoisted out of the scan
 
         def body(carry, xs):
             scores, val_scores = carry
-            key, fi = xs
+            key, bag, fi = xs
             g, h = lambda_grad_sorted(scores, qidx, qmask, gains, labq,
                                       invmax, sigma, trunc, nl)
             h = jnp.maximum(h, 1e-9)
             # wmul = row weight * validity (LightGBM ranker weightCol
             # semantics); the count channel carries plain validity
+            wb = wmul * jnp.broadcast_to(bag, (nl,))
             if goss is None:
-                gh = jnp.stack([g * wmul, h * wmul, real], axis=1)
+                gh = jnp.stack([g * wb, h * wb, real], axis=1)
                 tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg,
                                                  binsT=binsT)
-                scores = scores + lr * tree.leaf_value[row_leaf]
+                if not rf:
+                    scores = scores + lr * tree.leaf_value[row_leaf]
             else:
                 k1, k2, amp = goss
                 if cfg.axis_name is not None:
                     key = jax.random.fold_in(
                         key, jax.lax.axis_index(cfg.axis_name))
-                gm = g * wmul
-                hm = h * wmul                     # pads carry wmul 0
+                gm = g * wb
+                hm = h * wb                       # pads carry wmul 0
                 rank = jnp.argsort(-jnp.abs(gm * hm))
                 top_idx = rank[:k1]
                 rk = jax.random.uniform(key, (nl - k1,))
@@ -440,7 +449,8 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
                                           gh, fi, cfg)
                 scores = scores + lr * predict_tree_binned(
                     tree, bins, cfg.num_leaves)
-            tree = apply_shrinkage(tree, lr)
+            if not rf:
+                tree = apply_shrinkage(tree, lr)
             if has_val:
                 val_scores = val_scores + predict_tree_binned(
                     tree, val_bins, cfg.num_leaves)
@@ -450,22 +460,23 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
             return (scores, val_scores), (tree, out_v)
 
         (scores, val_scores), (trees, val_hist) = jax.lax.scan(
-            body, (scores, val_scores), (keys, fis))
+            body, (scores, val_scores), (keys, bags, fis))
         return trees, scores, val_scores, val_hist
 
     val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
+    bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
     mapped = jax.shard_map(
         steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS, None, None),
                   P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
                   P(DATA_AXIS, None, None), P(DATA_AXIS, None),
-                  P(None, None),
+                  P(None, None), bag_spec,
                   P(None, FEATURE_AXIS, None),
                   P(DATA_AXIS, None), P(DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), val_hist_spec),
         check_vma=False)
-    return jax.jit(mapped, donate_argnums=(1, 12))
+    return jax.jit(mapped, donate_argnums=(1, 13))
 
 
 def prepare_arrays_from_shards(bins_shards, label_shards, weight_shards,

@@ -650,11 +650,11 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         data_shards=_dn, verbosity=params.verbosity)
     if use_mesh:
         if ranking_info is not None:
-            if use_dart or use_rf:
+            if use_dart:
                 raise NotImplementedError(
-                    f"boostingType={params.boosting!r} with a mesh "
-                    "lambdarank is not supported (drop setMesh for the "
-                    "serial host loop, which supports every mode)")
+                    "boostingType='dart' with a mesh lambdarank is not "
+                    "supported (drop setMesh for the serial host loop, "
+                    "which supports every mode)")
             return _train_distributed_ranking(
                 bins, labels, w, mapper, objective, params, cfg, mesh,
                 feature_names, init, rng, ranking_info,
@@ -1224,15 +1224,13 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
     from .distributed import make_ranking_scan
     from .ranking import shard_queries
 
-    if params.bagging_freq > 0 and params.bagging_fraction < 1.0:
-        raise NotImplementedError(
-            "bagging with mesh lambdarank is not yet supported; drop "
-            "setMesh(...) or unset baggingFraction/baggingFreq")
-
     n, f = bins.shape
     T = params.num_iterations
     esr = params.early_stopping_round
     use_ff = params.feature_fraction < 1.0
+    use_bag = params.bagging_freq > 0 and params.bagging_fraction < 1.0
+    use_rf_rk = params.boosting == "rf"
+    bag_rng = np.random.default_rng(params.bagging_seed)
     dn = int(mesh.shape[DATA_AXIS])
     fn_shards = int(mesh.shape[FEATURE_AXIS])
     has_val = val_bins is not None and val_metric is not None
@@ -1299,17 +1297,21 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
     step = make_ranking_scan(mesh, cfg, params.learning_rate,
                              ranking_info["sigma"],
                              ranking_info["truncation_level"], has_val,
-                             goss=goss_rk)
+                             goss=goss_rk, bag_sharded=use_bag,
+                             rf=use_rf_rk)
     goss_keys_r = jax.random.split(
         jax.random.PRNGKey(params.bagging_seed), T)
 
     chunk = T
+    if use_bag:
+        chunk = min(chunk, 64)
     if has_val:
         chunk = min(chunk, max(min(esr, 64), 8) if esr > 0 else 64)
     chunks: List[TreeArrays] = []
     best_metric, best_iter = np.inf, -1
     stop_iter = T
     it = 0
+    cur_bag = np.ones(n, np.float32)
     while it < T:
         C = min(chunk, T - it)
         if use_ff:
@@ -1320,16 +1322,35 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
         else:
             fi_stack = jnp.asarray(np.broadcast_to(fi_base,
                                                    (C,) + fi_base.shape))
+        if use_bag:
+            rows = []
+            for j in range(C):
+                if (it + j) % params.bagging_freq == 0:
+                    # same stream as a serial run with this baggingSeed,
+                    # drawn over ORIGINAL row order then scattered
+                    # through the query-pack permutation
+                    cur_bag = (bag_rng.random(n) < params.bagging_fraction
+                               ).astype(np.float32)
+                row = np.zeros(npk, np.float32)
+                row[valid] = cur_bag[perm[valid]]
+                rows.append(row)
+            bags = jax.device_put(
+                jnp.asarray(np.stack(rows)),
+                NamedSharding(mesh, P(None, DATA_AXIS)))
+        else:
+            bags = jnp.ones((C, 1), jnp.float32)
         trees_st, scores, val_scores, val_hist = step(
             bins_d, scores, real_d, wmul_d, qidx_d, qmask_d, gains_d,
-            labq_d, invmax_d, goss_keys_r[it:it + C], fi_stack,
+            labq_d, invmax_d, goss_keys_r[it:it + C], bags, fi_stack,
             val_bins_d, val_scores)
         chunks.append(trees_st)
         stop = False
         if has_val:
             vh = np.asarray(val_hist)[:, :nv]
             for j in range(C):
-                metric = float(val_metric(vh[j], val_labels_np,
+                margins = (_rf_margins(init, vh[j], it + j)
+                           if use_rf_rk else vh[j])
+                metric = float(val_metric(margins, val_labels_np,
                                           val_weights))
                 gi = it + j
                 if metric < best_metric - 1e-12:
@@ -1350,6 +1371,8 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
     trees, nls = trees[:stop_iter], nls[:stop_iter]
     trees, stop_iter = _truncate_no_growth(trees, nls, 1, stop_iter,
                                            params.verbosity)
+    if use_rf_rk:
+        _rf_average_trees(trees, 1)
     return _finalize_booster(trees, 1, init, params, objective, mapper,
                              feature_names, f, stop_iter)
 

@@ -656,3 +656,59 @@ class TestVotingMulticlass:
             np.testing.assert_array_equal(a.split_feature, b.split_feature)
             np.testing.assert_allclose(a.leaf_value, b.leaf_value,
                                        rtol=2e-3, atol=1e-5)
+
+
+class TestMeshRankingBaggingRf:
+    """Bagging and rf under mesh lambdarank (round-4 matrix completion):
+    the bagging stream draws over ORIGINAL row order and scatters through
+    the query-pack permutation, so a mesh run reproduces the serial
+    ranker's stream semantics; rf fits unshrunk trees at constant init
+    scores with per-export averaging."""
+
+    def _rank_table(self):
+        rng = np.random.default_rng(9)
+        n_q, group, f = 90, 10, 8
+        n = n_q * group
+        X = rng.normal(size=(n, f))
+        w = rng.normal(size=f)
+        util = X @ w + rng.normal(size=n) * 0.5
+        q = np.repeat(np.arange(n_q), group)
+        labels = np.zeros(n)
+        for qq in range(n_q):
+            m = q == qq
+            labels[m] = np.clip(np.digitize(
+                util[m], np.quantile(util[m], [0.5, 0.8])), 0, 2)
+        return {"features": X, "label": labels, "query": q}
+
+    def test_mesh_bagged_ranker_learns_and_is_deterministic(self):
+        from mmlspark_tpu.gbdt import LightGBMRanker, ndcg_at_k
+        t = self._rank_table()
+        kw = dict(numIterations=15, numLeaves=15, minDataInLeaf=5,
+                  baggingFraction=0.7, baggingFreq=2, groupCol="query",
+                  verbosity=0)
+        a = LightGBMRanker(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        b = LightGBMRanker(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        assert (a.getModel().save_native_model_string()
+                == b.getModel().save_native_model_string())
+        out = a.transform(t)
+        ndcg = float(np.mean(ndcg_at_k(np.asarray(out["prediction"]),
+                                       t["label"], t["query"], 5)))
+        assert ndcg > 0.75
+
+    def test_mesh_rf_ranker_trains(self):
+        from mmlspark_tpu.gbdt import LightGBMRanker, ndcg_at_k
+        t = self._rank_table()
+        m = LightGBMRanker(boostingType="rf", numIterations=8,
+                           numLeaves=15, minDataInLeaf=5,
+                           baggingFraction=0.6, baggingFreq=1,
+                           groupCol="query", verbosity=0).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        trees = m.getModel().trees
+        assert len(trees) == 8
+        assert all(abs(t_.shrinkage - 1 / 8) < 1e-12 for t_ in trees)
+        out = m.transform(t)
+        ndcg = float(np.mean(ndcg_at_k(np.asarray(out["prediction"]),
+                                       t["label"], t["query"], 5)))
+        assert ndcg > 0.6
