@@ -712,3 +712,26 @@ class TestMeshRankingBaggingRf:
         ndcg = float(np.mean(ndcg_at_k(np.asarray(out["prediction"]),
                                        t["label"], t["query"], 5)))
         assert ndcg > 0.6
+
+
+class Test2DMeshModes:
+    """data+feature 2-D mesh with multiclass + validation: both
+    collectives (histogram psum over data, split allgather over feature)
+    compose under the softmax K-tree scan."""
+
+    def test_2d_mesh_multiclass_with_validation(self):
+        from sklearn.datasets import make_classification
+        X, y = make_classification(n_samples=800, n_features=8,
+                                   n_informative=6, n_classes=3,
+                                   random_state=15)
+        t = {"features": X, "label": y.astype(float)}
+        t["isVal"] = (np.arange(len(y)) % 5 == 0).astype(np.float64)
+        m = LightGBMClassifier(numIterations=6, numLeaves=7,
+                               minDataInLeaf=5, earlyStoppingRound=3,
+                               validationIndicatorCol="isVal",
+                               verbosity=0).setMesh(
+            build_mesh(data=4, feature=2)).fit(t)
+        assert len(m.getModel().trees) % 3 == 0
+        acc = (np.asarray(m.transform(t)["prediction"])
+               == t["label"]).mean()
+        assert acc > 0.75
