@@ -344,8 +344,20 @@ class MultiprocessHTTPServer:
         for p in self._procs:
             p.start()
         import socket as _socket
+        # a worker that dies during spawn (classic cause: the calling
+        # script lacks an `if __name__ == "__main__":` guard, so spawn's
+        # re-import re-runs it) must fail FAST, not hang accept()
+        self._listener.settimeout(20.0)
         for _ in self._procs:
-            conn, _ = self._listener.accept()
+            try:
+                conn, _ = self._listener.accept()
+            except TimeoutError as e:
+                self.stop()
+                raise RuntimeError(
+                    "worker processes failed to connect; if this is a "
+                    "script, MultiprocessHTTPServer must be started "
+                    "under `if __name__ == '__main__':` (spawn "
+                    "re-imports the main module)") from e
             conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
             idx = len(self._conns)
             self._conns.append(conn)
