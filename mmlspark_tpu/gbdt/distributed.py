@@ -307,6 +307,42 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
     return jax.jit(mapped, donate_argnums=(1, 8))
 
 
+def make_ranking_dart_step(mesh: Mesh, cfg: GrowerConfig, lr: float,
+                           sigma: float, trunc: int):
+    """One dart iteration for MESH LAMBDARANK: pairwise ΔNDCG gradients
+    computed shard-local at the dropped-out scores (queries are packed
+    per shard, so no collective touches the lambda tensors), tree grown
+    data-parallel with psum histograms.  Host-side dropout bookkeeping is
+    the shared ``_dart_host_loop``.  Data-only mesh (dropped-unit scoring
+    reads whole feature rows)."""
+    from .ranking import lambda_grad_sorted
+
+    cfg = _sharded_cfg(mesh, cfg)
+
+    def step(bins, binsT, s_minus, real, wmul, qidx, qmask, gains, labq,
+             invmax, bag, fi):
+        nl = s_minus.shape[0]
+        g, h = lambda_grad_sorted(s_minus, qidx, qmask, gains, labq,
+                                  invmax, sigma, trunc, nl)
+        h = jnp.maximum(h, 1e-9)
+        wb = wmul * bag
+        gh = jnp.stack([g * wb, h * wb, real], axis=1)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
+        tree = apply_shrinkage(tree, lr)
+        return tree, tree.leaf_value[row_leaf]
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS, None, None),
+                  P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                  P(DATA_AXIS, None, None), P(DATA_AXIS, None),
+                  P(DATA_AXIS), P(None, None)),
+        out_specs=(P(), P(DATA_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
 def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
                    lr: float, num_class: int = 1):
     """One dart iteration over a data-only mesh: fit a tree to the gradient

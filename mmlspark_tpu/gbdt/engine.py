@@ -650,11 +650,6 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         data_shards=_dn, verbosity=params.verbosity)
     if use_mesh:
         if ranking_info is not None:
-            if use_dart:
-                raise NotImplementedError(
-                    "boostingType='dart' with a mesh lambdarank is not "
-                    "supported (drop setMesh for the serial host loop, "
-                    "which supports every mode)")
             return _train_distributed_ranking(
                 bins, labels, w, mapper, objective, params, cfg, mesh,
                 feature_names, init, rng, ranking_info,
@@ -1277,6 +1272,64 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
 
     fi_base = np.zeros((f_padded, 3), np.float32)
     fi_base[:f] = _feat_info_from_mapper(mapper, f)
+
+    if params.boosting == "dart":
+        from .distributed import (make_ranking_dart_step,
+                                  make_tree_predict)
+        if fn_shards > 1:
+            raise NotImplementedError(
+                "boostingType='dart' requires a data-only mesh; use "
+                "parallelism='data' / feature=1")
+        step_d = make_ranking_dart_step(
+            mesh, cfg, params.learning_rate, ranking_info["sigma"],
+            ranking_info["truncation_level"])
+        pred_d = make_tree_predict(mesh, params.num_leaves)
+        binsT_d = jnp.transpose(bins_d)
+        dart_rng = np.random.default_rng(params.drop_seed)
+        bag_rng_rk = np.random.default_rng(params.bagging_seed)
+        use_bag_rk = (params.bagging_freq > 0
+                      and params.bagging_fraction < 1.0)
+        bag_state = {"cur": np.ones(n, np.float32)}
+        bag_sh = NamedSharding(mesh, P(DATA_AXIS))
+
+        def bag_draw(it):
+            if use_bag_rk and it % params.bagging_freq == 0:
+                bag_state["cur"] = (
+                    bag_rng_rk.random(n) < params.bagging_fraction
+                ).astype(np.float32)
+            row = np.zeros(npk, np.float32)
+            row[valid] = bag_state["cur"][perm[valid]]
+            return jax.device_put(jnp.asarray(row), bag_sh)
+
+        def fi_draw(_it):
+            if use_ff:
+                return jnp.asarray(_draw_feature_fraction(
+                    rng, fi_base, f, params.feature_fraction))
+            return jnp.asarray(fi_base)
+
+        def grow_unit(s_minus, bag, fi):
+            return step_d(bins_d, binsT_d, s_minus, real_d, wmul_d,
+                          qidx_d, qmask_d, gains_d, labq_d, invmax_d,
+                          bag, fi)
+
+        units, trees_list, scales, scores = _dart_host_loop(
+            T, 1, dart_rng, params, scores, bag_draw, fi_draw,
+            grow_unit, lambda u: pred_d(u, bins_d), None)
+        chunks_d = []
+        if trees_list:
+            chunks_d = [jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *trees_list)]
+        trees, nls = _fetch_host_trees(chunks_d, params.num_leaves,
+                                       mapper)
+        trees, stop_iter = _truncate_no_growth(trees, nls, 1, T,
+                                               params.verbosity)
+        for t_, s_ in zip(trees, scales):
+            t_.leaf_value = t_.leaf_value * s_
+            t_.internal_value = t_.internal_value * s_
+            t_.shrinkage = s_
+        return _finalize_booster(trees, 1, init, params, objective,
+                                 mapper, feature_names, f, stop_iter)
+
     goss_rk = None
     if params.boosting == "goss":
         # per-shard GOSS over the packed rows (gradients stay full — the

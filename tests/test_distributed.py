@@ -735,3 +735,48 @@ class Test2DMeshModes:
         acc = (np.asarray(m.transform(t)["prediction"])
                == t["label"]).mean()
         assert acc > 0.75
+
+
+class TestMeshRankingDart:
+    """dart x mesh lambdarank — the last matrix cell: shard-local lambda
+    gradients at the dropped-out scores, shared host dropout loop."""
+
+    def _rank_table(self):
+        rng = np.random.default_rng(21)
+        n_q, group = 80, 10
+        n = n_q * group
+        X = rng.normal(size=(n, 7))
+        util = X @ rng.normal(size=7) + rng.normal(size=n) * 0.5
+        q = np.repeat(np.arange(n_q), group)
+        labels = np.zeros(n)
+        for qq in range(n_q):
+            m = q == qq
+            labels[m] = np.clip(np.digitize(
+                util[m], np.quantile(util[m], [0.5, 0.8])), 0, 2)
+        return {"features": X, "label": labels, "query": q}
+
+    def test_mesh_dart_ranker_matches_serial(self):
+        from mmlspark_tpu.gbdt import LightGBMRanker
+        t = self._rank_table()
+        kw = dict(boostingType="dart", numIterations=6, numLeaves=7,
+                  minDataInLeaf=5, dropRate=0.5, groupCol="query",
+                  verbosity=0)
+        serial = LightGBMRanker(**kw).fit(t)
+        dist = LightGBMRanker(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        st, dt = serial.getModel().trees, dist.getModel().trees
+        assert len(st) == len(dt) == 6
+        for a, b in zip(st, dt):
+            assert abs(a.shrinkage - b.shrinkage) < 1e-12
+
+    def test_mesh_dart_ranker_learns(self):
+        from mmlspark_tpu.gbdt import LightGBMRanker, ndcg_at_k
+        t = self._rank_table()
+        m = LightGBMRanker(boostingType="dart", numIterations=15,
+                           numLeaves=15, minDataInLeaf=5, dropRate=0.2,
+                           groupCol="query", verbosity=0).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        out = m.transform(t)
+        ndcg = float(np.mean(ndcg_at_k(np.asarray(out["prediction"]),
+                                       t["label"], t["query"], 5)))
+        assert ndcg > 0.75
