@@ -117,17 +117,26 @@ def run_bench(args, n, f, iters, leaves, result):
         jax.config.update("jax_platforms", "cpu")
 
     # --- baseline: sklearn HistGradientBoosting on CPU -----------------
+    # best of two runs on BOTH sides: single-run wall clock on this
+    # 1-core box is noisy (sklearn observed 7.4-20s for the same fit),
+    # and min-of-k is the standard noise-robust estimator for a
+    # deterministic workload
     from sklearn.ensemble import HistGradientBoostingClassifier
     from sklearn.metrics import roc_auc_score
-    t0 = time.perf_counter()
-    sk = HistGradientBoostingClassifier(
-        max_iter=iters, learning_rate=0.1, max_leaf_nodes=leaves,
-        max_bins=255, early_stopping=False, validation_fraction=None)
-    sk.fit(X, y)
-    sk_time = time.perf_counter() - t0
+    sk_times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        sk = HistGradientBoostingClassifier(
+            max_iter=iters, learning_rate=0.1, max_leaf_nodes=leaves,
+            max_bins=255, early_stopping=False, validation_fraction=None)
+        sk.fit(X, y)
+        sk_times.append(time.perf_counter() - t0)
+    sk_time = min(sk_times)
     sk_auc = roc_auc_score(y, sk.predict_proba(X)[:, 1])
-    log(f"sklearn: {sk_time:.2f}s  AUC={sk_auc:.4f}")
+    log(f"sklearn: {sk_time:.2f}s (runs: "
+        f"{', '.join(f'{t:.2f}' for t in sk_times)})  AUC={sk_auc:.4f}")
     result["detail"].update(sklearn_wall_s=round(sk_time, 3),
+                            sklearn_runs=[round(t, 3) for t in sk_times],
                             sklearn_train_auc=round(float(sk_auc), 5))
 
     # --- ours ----------------------------------------------------------
@@ -146,17 +155,22 @@ def run_bench(args, n, f, iters, leaves, result):
         {"features": X, "label": y})
     log(f"warm-up (incl compile): {time.perf_counter() - t0:.2f}s")
 
-    t0 = time.perf_counter()
-    model = LightGBMClassifier(numIterations=iters, **kw).fit(
-        {"features": X, "label": y})
-    our_time = time.perf_counter() - t0
+    our_times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        model = LightGBMClassifier(numIterations=iters, **kw).fit(
+            {"features": X, "label": y})
+        our_times.append(time.perf_counter() - t0)
+    our_time = min(our_times)
     out = model.transform({"features": X, "label": y})
     our_auc = roc_auc_score(y, np.asarray(out["probability"])[:, 1])
-    log(f"ours: {our_time:.2f}s  AUC={our_auc:.4f}")
+    log(f"ours: {our_time:.2f}s (runs: "
+        f"{', '.join(f'{t:.2f}' for t in our_times)})  AUC={our_auc:.4f}")
 
     result["value"] = round(n * iters / our_time, 1)
     result["vs_baseline"] = round(sk_time / our_time, 4)
     result["detail"].update(our_wall_s=round(our_time, 3),
+                            our_runs=[round(t, 3) for t in our_times],
                             our_train_auc=round(float(our_auc), 5))
 
 
