@@ -326,7 +326,7 @@ def make_ranking_dart_step(mesh: Mesh, cfg: GrowerConfig, lr: float,
                                   invmax, sigma, trunc, nl)
         h = jnp.maximum(h, 1e-9)
         wb = wmul * bag
-        gh = jnp.stack([g * wb, h * wb, real], axis=1)
+        gh = jnp.stack([g * wb, h * wb, real * bag], axis=1)
         tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
         tree = apply_shrinkage(tree, lr)
         return tree, tree.leaf_value[row_leaf]
@@ -457,8 +457,12 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
             # wmul = row weight * validity (LightGBM ranker weightCol
             # semantics); the count channel carries plain validity
             wb = wmul * jnp.broadcast_to(bag, (nl,))
+            # count channel = validity * bag, matching the serial ranking
+            # loop: with bagging the tree trains on the SAMPLE, so
+            # min_data_in_leaf counts sampled rows (LightGBM semantics)
+            cb = real * jnp.broadcast_to(bag, (nl,))
             if goss is None:
-                gh = jnp.stack([g * wb, h * wb, real], axis=1)
+                gh = jnp.stack([g * wb, h * wb, cb], axis=1)
                 tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg,
                                                  binsT=binsT)
                 if not rf:

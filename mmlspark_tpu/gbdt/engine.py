@@ -1286,20 +1286,23 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
         pred_d = make_tree_predict(mesh, params.num_leaves)
         binsT_d = jnp.transpose(bins_d)
         dart_rng = np.random.default_rng(params.drop_seed)
-        bag_rng_rk = np.random.default_rng(params.bagging_seed)
-        use_bag_rk = (params.bagging_freq > 0
-                      and params.bagging_fraction < 1.0)
-        bag_state = {"cur": np.ones(n, np.float32)}
         bag_sh = NamedSharding(mesh, P(DATA_AXIS))
 
-        def bag_draw(it):
-            if use_bag_rk and it % params.bagging_freq == 0:
-                bag_state["cur"] = (
-                    bag_rng_rk.random(n) < params.bagging_fraction
-                ).astype(np.float32)
+        def _upload(mask_n):
             row = np.zeros(npk, np.float32)
-            row[valid] = bag_state["cur"][perm[valid]]
+            row[valid] = mask_n[perm[valid]]
             return jax.device_put(jnp.asarray(row), bag_sh)
+
+        bag_state = {"dev": _upload(np.ones(n, np.float32))}
+
+        def bag_draw(it):
+            # upload only on redraw iterations (use_bag/bag_rng are the
+            # function-level stream, shared with the chunked path)
+            if use_bag and it % params.bagging_freq == 0:
+                bag_state["dev"] = _upload(
+                    (bag_rng.random(n) < params.bagging_fraction
+                     ).astype(np.float32))
+            return bag_state["dev"]
 
         def fi_draw(_it):
             if use_ff:

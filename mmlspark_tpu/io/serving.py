@@ -394,23 +394,27 @@ class MultiprocessHTTPServer:
                     self._route.pop(msg["rid"], None)
             elif op == "ack":
                 with self._lock:
-                    waiter = self._acks.pop(msg["rid"], None)
-                if waiter is not None:
+                    entry = self._acks.pop(msg["rid"], None)
+                if entry is not None:
+                    waiter = entry[0]
                     waiter.response = msg["delivered"]
                     waiter.event.set()
         # worker gone (crash/kill): its parked sockets died with it.
         # Purge its routes so replies report undelivered immediately and
-        # release any reply() calls waiting on acks from this worker —
+        # release any reply() calls waiting on acks FROM THIS WORKER
+        # (acks carry the worker index — routes and acks are disjoint
+        # because reply() pops the route before registering the ack) —
         # the surviving workers keep serving (the reference's executor
         # loss story, SURVEY.md §5.3 applied to serving).
         with self._lock:
-            dead = [r for r, i in self._route.items() if i == idx]
-            for r in dead:
+            for r in [r for r, i in self._route.items() if i == idx]:
                 self._route.pop(r, None)
-                waiter = self._acks.pop(r, None)
-                if waiter is not None:
-                    waiter.response = False
-                    waiter.event.set()
+            dead_acks = [r for r, (_, i) in self._acks.items()
+                         if i == idx]
+            for r in dead_acks:
+                waiter, _ = self._acks.pop(r)
+                waiter.response = False
+                waiter.event.set()
 
     def _send(self, idx: int, obj) -> None:
         data = (json.dumps(obj) + "\n").encode("utf-8")
@@ -439,7 +443,7 @@ class MultiprocessHTTPServer:
             if idx is None:
                 return False
             waiter = _Pending()
-            self._acks[request_id] = waiter
+            self._acks[request_id] = (waiter, idx)
         try:
             self._send(idx, {"op": "reply", "rid": request_id,
                              "response": response, "status": status})

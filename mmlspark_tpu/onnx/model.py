@@ -397,7 +397,11 @@ def _eval_node(node: Dict[str, Any], env: Dict[str, Any]):
         depth = int(np.asarray(env[ins[1]]).reshape(()).item())
         values = jnp.asarray(env[ins[2]])       # [off_value, on_value]
         ax = attrs.get("axis", -1)
-        oh = jax.nn.one_hot(jnp.mod(indices, depth), depth, axis=ax)
+        # spec: negative indices wrap once; anything outside
+        # [-depth, depth-1] yields an all-off row (one_hot of -1 is 0s)
+        norm = jnp.where(indices < 0, indices + depth, indices)
+        valid = (norm >= 0) & (norm < depth)
+        oh = jax.nn.one_hot(jnp.where(valid, norm, -1), depth, axis=ax)
         return oh * (values[1] - values[0]) + values[0]
     if op == "GatherElements":
         x = env[ins[0]]

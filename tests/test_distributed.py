@@ -697,6 +697,25 @@ class TestMeshRankingBaggingRf:
                                        t["label"], t["query"], 5)))
         assert ndcg > 0.75
 
+    def test_mesh_bagged_ranker_matches_serial_structure(self):
+        """After the count-channel fix, a bagged mesh ranker sees the
+        same per-leaf sample counts as the serial loop: same baggingSeed
+        => same split structure."""
+        from mmlspark_tpu.gbdt import LightGBMRanker
+        t = self._rank_table()
+        kw = dict(numIterations=5, numLeaves=7, minDataInLeaf=5,
+                  baggingFraction=0.6, baggingFreq=1, groupCol="query",
+                  verbosity=0)
+        serial = LightGBMRanker(**kw).fit(t)
+        dist = LightGBMRanker(**kw).setMesh(
+            build_mesh(data=8, feature=1)).fit(t)
+        st, dt = serial.getModel().trees, dist.getModel().trees
+        assert len(st) == len(dt)
+        for a, b in zip(st, dt):
+            np.testing.assert_array_equal(a.split_feature, b.split_feature)
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                       rtol=2e-3, atol=1e-5)
+
     def test_mesh_rf_ranker_trains(self):
         from mmlspark_tpu.gbdt import LightGBMRanker, ndcg_at_k
         t = self._rank_table()

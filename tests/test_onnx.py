@@ -431,3 +431,19 @@ class TestRound4Ops:
                          [("s", [2, 32, 2, 3]), ("r", [2, 8, 4, 6])], [x])
         assert np.asarray(s).shape == (2, 32, 2, 3)
         np.testing.assert_allclose(r, x, rtol=1e-6)  # DCR inverts S2D
+
+    def test_onehot_out_of_range_is_all_off(self):
+        """Spec: indices outside [-depth, depth-1] produce all-off rows
+        (negative indices wrap once)."""
+        idx = np.asarray([0, -1, 5, -5], np.int64)
+        nodes = [proto.encode_node("OneHot", ["idx", "d", "vals"], ["oh"],
+                                   axis=-1)]
+        (oh,) = [self._run(
+            nodes, {"d": np.asarray(3, np.int64),
+                    "vals": np.asarray([9.0, 1.0], np.float32)},
+            [("idx", [4])], [("oh", [4, 3])], [idx])]
+        ref = np.full((4, 3), 9.0, np.float32)
+        ref[0, 0] = 1.0   # 0
+        ref[1, 2] = 1.0   # -1 wraps to 2
+        # 5 and -5 are out of range: stay all-off
+        np.testing.assert_array_equal(np.asarray(oh), ref)
