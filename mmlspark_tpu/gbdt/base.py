@@ -282,7 +282,8 @@ class LightGBMBase(Estimator, LightGBMParams):
         obj_name = getattr(self, "_resolved_objective", None) \
             or self.getObjective() or self._default_objective
         num_class = getattr(self, "_num_class", 1)
-        if obj_name in ("multiclass", "softmax") and num_class <= 1:
+        if obj_name in ("multiclass", "softmax", "multiclassova",
+                        "ova") and num_class <= 1:
             num_class = int(np.max(y)) + 1
         objective = get_objective(obj_name, num_class=num_class,
                                   **self._objective_kwargs())

@@ -252,8 +252,15 @@ class Booster:
             return jax.nn.sigmoid(sig * m)
         if obj in ("multiclass", "softmax"):
             return jax.nn.softmax(m, axis=-1)
-        if obj == "poisson":
-            return jnp.exp(m)
+        if obj in ("poisson", "gamma", "tweedie"):
+            return jnp.exp(m)                    # log link
+        if obj in ("cross_entropy", "xentropy"):
+            return jax.nn.sigmoid(m)
+        if obj == "multiclassova":
+            sig = _param_from_str(self.objective_str, "sigmoid", 1.0)
+            p = jax.nn.sigmoid(sig * m)
+            return p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True),
+                                   1e-12)
         return m
 
     def predict_contrib(self, X) -> np.ndarray:

@@ -48,7 +48,8 @@ class LightGBMClassifier(LightGBMBase, _ClassifierParams):
     def _prepare_labels(self, y):
         y = np.asarray(y)
         self._num_class = 1
-        if self.getObjective() in ("multiclass", "softmax"):
+        if self.getObjective() in ("multiclass", "softmax",
+                                   "multiclassova", "ova"):
             self._resolved_objective = self.getObjective()
             if y.dtype.kind == "f" and np.isnan(y).any():
                 # must fail HERE: the int cast below would turn NaN into
@@ -58,6 +59,15 @@ class LightGBMClassifier(LightGBMBase, _ClassifierParams):
                     "multiclass labels contain NaN; labels must be "
                     "integer class ids in [0, num_class)")
             return y.astype(np.int64)
+        if self.getObjective() in ("cross_entropy", "xentropy"):
+            # soft probability labels: no 0/1 coercion, no multiclass
+            # auto-promotion (LightGBM xentropy accepts y in [0, 1])
+            self._resolved_objective = self.getObjective()
+            y = y.astype(np.float64)
+            if np.isnan(y).any() or y.min() < 0 or y.max() > 1:
+                raise ValueError(
+                    "cross_entropy labels must lie in [0, 1]")
+            return y
         uniq = np.unique(y[~np.isnan(y.astype(np.float64))]) \
             if y.dtype.kind == "f" else np.unique(y)
         if len(uniq) > 2:
@@ -72,7 +82,7 @@ class LightGBMClassifier(LightGBMBase, _ClassifierParams):
     def _val_metric(self):
         obj = getattr(self, "_resolved_objective", self.getObjective())
 
-        if obj in ("multiclass", "softmax"):
+        if obj in ("multiclass", "softmax", "multiclassova", "ova"):
             def logloss_mc(scores, labels, weights):
                 p = _softmax(scores)
                 n = len(labels)

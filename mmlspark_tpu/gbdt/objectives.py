@@ -228,6 +228,119 @@ class MapeObjective(Objective):
         return g, h
 
 
+class GammaObjective(Objective):
+    """Gamma deviance with log link (LightGBM objective=gamma;
+    src/objective/regression_objective.hpp RegressionGammaLoss, expected
+    path, UNVERIFIED): g = 1 - y·e^{-s}, h = y·e^{-s}."""
+
+    name = "gamma"
+    model_str = "gamma"
+
+    def init_score(self, labels, weights):
+        s = float(np.sum(weights))
+        mean = float(np.sum(weights * labels) / s) if s > 0 else 1.0
+        return float(np.log(max(mean, 1e-12)))
+
+    def grad_hess(self, scores, labels, weights):
+        ey = labels * jnp.exp(-scores)
+        g = (1.0 - ey) * weights
+        h = ey * weights
+        return g, h
+
+    def transform_prediction(self, scores):
+        return jnp.exp(scores)
+
+
+class TweedieObjective(Objective):
+    """Tweedie deviance, log link, variance power ρ ∈ (1, 2) (LightGBM
+    objective=tweedie, tweedie_variance_power; RegressionTweedieLoss,
+    expected path, UNVERIFIED):
+    g = -y·e^{(1-ρ)s} + e^{(2-ρ)s}, h the score derivative of g."""
+
+    name = "tweedie"
+
+    def __init__(self, rho: float = 1.5):
+        if not 1.0 < rho < 2.0:
+            raise ValueError("tweedie_variance_power must be in (1, 2), "
+                             f"got {rho}")
+        self.rho = float(rho)
+        self.model_str = "tweedie"
+
+    def init_score(self, labels, weights):
+        s = float(np.sum(weights))
+        mean = float(np.sum(weights * labels) / s) if s > 0 else 1.0
+        return float(np.log(max(mean, 1e-12)))
+
+    def grad_hess(self, scores, labels, weights):
+        a = jnp.exp((1.0 - self.rho) * scores)
+        b = jnp.exp((2.0 - self.rho) * scores)
+        g = (-labels * a + b) * weights
+        h = (-labels * (1.0 - self.rho) * a
+             + (2.0 - self.rho) * b) * weights
+        return g, h
+
+    def transform_prediction(self, scores):
+        return jnp.exp(scores)
+
+
+class CrossEntropyObjective(Objective):
+    """Cross-entropy on PROBABILITY labels in [0, 1] (LightGBM
+    objective=cross_entropy / xentropy): the binary gradient g = σ(s) - y
+    without requiring hard 0/1 labels."""
+
+    name = "cross_entropy"
+    model_str = "cross_entropy"
+
+    def init_score(self, labels, weights):
+        s = float(np.sum(weights))
+        p = float(np.sum(weights * labels) / s) if s > 0 else 0.5
+        p = min(max(p, 1e-12), 1.0 - 1e-12)
+        return float(np.log(p / (1.0 - p)))
+
+    def grad_hess(self, scores, labels, weights):
+        p = jax.nn.sigmoid(scores)
+        g = (p - labels) * weights
+        h = jnp.maximum(p * (1.0 - p), 1e-16) * weights
+        return g, h
+
+    def transform_prediction(self, scores):
+        return jax.nn.sigmoid(scores)
+
+
+class MulticlassOvaObjective(Objective):
+    """One-vs-all multiclass (LightGBM objective=multiclassova): K
+    INDEPENDENT sigmoid classifiers, one tree per class per iteration;
+    prediction = per-class sigmoids normalized to sum 1 (LightGBM's
+    OVA converter)."""
+
+    name = "multiclassova"
+
+    def __init__(self, num_class: int, sigmoid_coef: float = 1.0):
+        if num_class < 2:
+            raise ValueError("multiclassova requires num_class >= 2")
+        self.num_class = int(num_class)
+        self.num_model_per_iteration = self.num_class
+        self.sigma = float(sigmoid_coef)
+        self.model_str = (f"multiclassova num_class:{self.num_class} "
+                          f"sigmoid:{self.sigma:g}")
+
+    def init_score(self, labels, weights):
+        return 0.0
+
+    def grad_hess(self, scores, labels, weights):
+        y = jax.nn.one_hot(labels.astype(jnp.int32), self.num_class,
+                           dtype=scores.dtype)
+        p = jax.nn.sigmoid(self.sigma * scores)
+        w = weights[:, None]
+        g = self.sigma * (p - y) * w
+        h = self.sigma * self.sigma * p * (1.0 - p) * w
+        return g, h
+
+    def transform_prediction(self, scores):
+        p = jax.nn.sigmoid(self.sigma * scores)
+        return p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-12)
+
+
 class MulticlassObjective(Objective):
     """Softmax over K per-class score columns; K trees per iteration."""
 
@@ -294,8 +407,17 @@ def get_objective(name: str, num_class: int = 1, **kwargs) -> Objective:
             max_delta_step=kwargs.get("poisson_max_delta_step", 0.7)),
         "quantile": lambda: QuantileObjective(alpha=kwargs.get("alpha", 0.9)),
         "mape": MapeObjective,
+        "gamma": GammaObjective,
+        "tweedie": lambda: TweedieObjective(
+            rho=kwargs.get("tweedie_variance_power", 1.5)),
+        "cross_entropy": CrossEntropyObjective,
+        "xentropy": CrossEntropyObjective,
         "multiclass": lambda: MulticlassObjective(num_class),
         "softmax": lambda: MulticlassObjective(num_class),
+        "multiclassova": lambda: MulticlassOvaObjective(
+            num_class, sigmoid_coef=kwargs.get("sigmoid", 1.0)),
+        "ova": lambda: MulticlassOvaObjective(
+            num_class, sigmoid_coef=kwargs.get("sigmoid", 1.0)),
         "lambdarank": _lambdarank_stub,
     }
     if name not in aliases:

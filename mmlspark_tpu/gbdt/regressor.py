@@ -32,7 +32,8 @@ class LightGBMRegressor(LightGBMBase):
 
     def _objective_kwargs(self):
         return dict(alpha=self.getAlpha(), fair_c=self.getFairC(),
-                    poisson_max_delta_step=self.getPoissonMaxDeltaStep())
+                    poisson_max_delta_step=self.getPoissonMaxDeltaStep(),
+                    tweedie_variance_power=self.getTweedieVariancePower())
 
     def _val_metric(self):
         def l2(scores, labels, weights):
