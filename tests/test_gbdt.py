@@ -453,3 +453,32 @@ class TestRound4Objectives:
                ).mean()
         # OVA converges slower than softmax at equal iterations
         assert acc > 0.75
+
+    def test_new_objectives_roundtrip_native_format(self):
+        """multiclassova and tweedie models survive the text format with
+        their links: loaded boosters reproduce predictions exactly."""
+        from sklearn.datasets import make_classification
+
+        from mmlspark_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+        from mmlspark_tpu.gbdt.booster import Booster
+        X, y = make_classification(n_samples=400, n_features=6,
+                                   n_informative=4, n_classes=3,
+                                   random_state=0)
+        t = {"features": X, "label": y.astype(float)}
+        m = LightGBMClassifier(objective="multiclassova", numIterations=3,
+                               numLeaves=5, verbosity=0).fit(t)
+        b2 = Booster.load_native_model_string(
+            m.getModel().save_native_model_string())
+        assert b2.num_class == 3
+        np.testing.assert_allclose(np.asarray(m.getModel().predict(X)),
+                                   np.asarray(b2.predict(X)), rtol=1e-5)
+        yr = np.abs(X[:, 0]) + 0.1
+        r = LightGBMRegressor(objective="tweedie", numIterations=3,
+                              verbosity=0).fit(
+            {"features": X, "label": yr})
+        b3 = Booster.load_native_model_string(
+            r.getModel().save_native_model_string())
+        p3 = np.asarray(b3.predict(X))
+        assert (p3 > 0).all()              # log link survives the file
+        np.testing.assert_allclose(np.asarray(r.getModel().predict(X)),
+                                   p3, rtol=1e-5)
