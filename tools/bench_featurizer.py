@@ -24,9 +24,13 @@ def main():
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--batch", type=int, nargs="*", default=[64, 128, 256])
     ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (skip TPU probe)")
     args = ap.parse_args()
 
     import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
     from mmlspark_tpu.dnn.model import ResNetFeaturizerModel
     from mmlspark_tpu.dnn.resnet import build_resnet, init_params
@@ -43,9 +47,9 @@ def main():
             m = ResNetFeaturizerModel(
                 variables=variables, inputCol="image", outputCol="f",
                 modelName=args.model, miniBatchSize=bs, computeDtype=dtype)
-            m._transform({"image": imgs[: 2 * bs]})        # compile
+            m.transform({"image": imgs[: 2 * bs]})        # compile
             t0 = time.perf_counter()
-            out = m._transform({"image": imgs})
+            out = m.transform({"image": imgs})
             dt = time.perf_counter() - t0
             ips = n / dt
             best[dtype] = max(best.get(dtype, 0.0), ips)
