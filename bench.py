@@ -117,14 +117,15 @@ def run_bench(args, n, f, iters, leaves, result):
         jax.config.update("jax_platforms", "cpu")
 
     # --- baseline: sklearn HistGradientBoosting on CPU -----------------
-    # best of two runs on BOTH sides: single-run wall clock on this
-    # 1-core box is noisy (sklearn observed 7.4-20s for the same fit),
-    # and min-of-k is the standard noise-robust estimator for a
+    # best of three runs on BOTH sides: single-run wall clock on this
+    # 1-core box is noisy (sklearn observed 7.4-20s for the same fit; our
+    # tunneled-chip runs observed 10.5s vs 6.9s back to back), and
+    # min-of-k is the standard noise-robust estimator for a
     # deterministic workload
     from sklearn.ensemble import HistGradientBoostingClassifier
     from sklearn.metrics import roc_auc_score
     sk_times = []
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         sk = HistGradientBoostingClassifier(
             max_iter=iters, learning_rate=0.1, max_leaf_nodes=leaves,
@@ -141,6 +142,15 @@ def run_bench(args, n, f, iters, leaves, result):
 
     # --- ours ----------------------------------------------------------
     import jax
+    # persistent compile cache: the warm-up fit costs ~100s of XLA
+    # compilation per process without it; with it, repeat invocations
+    # (sweeps, re-benches, the driver's end-of-round run) hold the chip
+    # for seconds instead of minutes — less lease exposure
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 - older jax without the knobs
+        pass
     log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
     result["detail"]["backend"] = jax.default_backend()
     from mmlspark_tpu.gbdt import LightGBMClassifier
@@ -156,7 +166,7 @@ def run_bench(args, n, f, iters, leaves, result):
     log(f"warm-up (incl compile): {time.perf_counter() - t0:.2f}s")
 
     our_times = []
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         model = LightGBMClassifier(numIterations=iters, **kw).fit(
             {"features": X, "label": y})

@@ -157,6 +157,7 @@ class Booster:
         self.feature_infos = feature_infos or ["none"] * nf
         self.params = params or {}
         self._stacked = None
+        self._stacked_np = None
 
     # -- prediction ----------------------------------------------------------
 
@@ -210,19 +211,23 @@ class Booster:
             "depth": depth,
             "has_cat": any(t.num_cat > 0 for t in self.trees),
         }
+        # host copy retained only where the native scorer can use it —
+        # on accelerators it would just double host memory per model
+        self._stacked_np = stacked if jax.default_backend() == "cpu" \
+            else None
         self._stacked = {k: (jnp.asarray(v) if isinstance(v, np.ndarray)
                              else v) for k, v in stacked.items()}
         return self._stacked
 
     def predict_margin(self, X, num_iteration: Optional[int] = None):
         """Raw margins: (n,) for single-class, (n, K) for multiclass."""
-        X = jnp.asarray(X, jnp.float32)
-        if X.ndim != 2 or X.shape[1] <= self.max_feature_idx:
+        shape = np.shape(X)
+        if len(shape) != 2 or shape[1] <= self.max_feature_idx:
             raise ValueError(
                 f"Model uses feature index {self.max_feature_idx} but input "
-                f"has shape {X.shape}; expected (n, >= "
+                f"has shape {shape}; expected (n, >= "
                 f"{self.max_feature_idx + 1})")
-        n = X.shape[0]
+        n = shape[0]
         s = self._stack()
         K = self.num_class
         if s is None:
@@ -231,6 +236,22 @@ class Booster:
                 jnp.tile(base[:, None], (1, K))
         T = s["feat"].shape[0]
         use_t = T if num_iteration is None else min(num_iteration * K, T)
+        sn = self._stacked_np
+        if sn is not None and jax.default_backend() == "cpu":
+            from .. import native
+            if native.predict_forest_available():
+                Xnp = np.ascontiguousarray(np.asarray(X, np.float32))
+                out = np.zeros((n, K), np.float32)
+                native.predict_forest(
+                    Xnp, sn["feat"][:use_t], sn["thr"][:use_t],
+                    sn["left"][:use_t], sn["right"][:use_t],
+                    sn["leaf"][:use_t], sn["single"][:use_t],
+                    sn["is_cat"][:use_t], sn["dleft"][:use_t],
+                    sn["cat_bnd"][:use_t], sn["cat_words"][:use_t],
+                    K, sn["has_cat"], out)
+                out += np.float32(self.init_score)
+                return out[:, 0] if K == 1 else out
+        X = jnp.asarray(X, jnp.float32)
         margins = _predict_forest(X, s["feat"][:use_t], s["thr"][:use_t],
                                   s["left"][:use_t], s["right"][:use_t],
                                   s["leaf"][:use_t], s["single"][:use_t],

@@ -60,13 +60,26 @@ def _build(src_name: str, stem: str) -> bool:
     return False
 
 
+def _fresh(out_path: str, src_path: str) -> bool:
+    """A built artifact is fresh when it exists and is no older than its
+    source (a missing source can't invalidate it)."""
+    return (os.path.exists(out_path)
+            and (not os.path.exists(src_path)
+                 or os.path.getmtime(out_path) >= os.path.getmtime(src_path)))
+
+
 def _load(stem: str = "_fastio", src_name: str = "fastio.cc"):
     if stem in _mods:
         return _mods[stem]
     _mods[stem] = None
     if os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_so_path(stem)) and not _build(src_name, stem):
+    so = _so_path(stem)
+    src = os.path.join(_HERE, src_name)
+    # stale .so + failed rebuild (no compiler / read-only dir): still load
+    # the old binary rather than silently losing the native path
+    if not _fresh(so, src) and not _build(src_name, stem) \
+            and not os.path.exists(so):
         return None
     try:
         sys.path.insert(0, _HERE)
@@ -85,6 +98,26 @@ def available() -> bool:
 
 def bin_columns_available() -> bool:
     return _load("_fastbin", "fastbin.cc") is not None
+
+
+def predict_forest_available() -> bool:
+    return _load("_fastforest", "fastforest.cc") is not None
+
+
+def predict_forest(X, feat, thr, left, right, leaf, single, is_cat, dleft,
+                   cat_bnd, cat_words, num_class, has_cat, out,
+                   n_threads: int = 0) -> None:
+    """Native early-exit forest margin accumulation into ``out`` (n, K)
+    float32; see fastforest.cc for the exactness contract vs the jitted
+    walk.  Raises RuntimeError when the extension is unavailable
+    (callers gate on :func:`predict_forest_available`)."""
+    mod = _load("_fastforest", "fastforest.cc")
+    if mod is None:
+        raise RuntimeError("mmlspark_tpu.native._fastforest unavailable; "
+                           "use the jitted _predict_forest path")
+    mod.predict_forest(X, feat, thr, left, right, leaf, single, is_cat,
+                       dleft, cat_bnd, cat_words, int(num_class),
+                       int(bool(has_cat)), int(n_threads), out)
 
 
 _FFI_LIB = None
@@ -121,9 +154,8 @@ def _ffi_lib():
         if not os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
             path = os.path.join(_HERE, "fasthist_ffi.bin")
             src = os.path.join(_HERE, "fasthist_ffi.cc")
-            fresh = (os.path.exists(path)
-                     and os.path.getmtime(path) >= os.path.getmtime(src))
-            if fresh or _build_ffi("fasthist_ffi.cc", "fasthist_ffi"):
+            if _fresh(path, src) or _build_ffi("fasthist_ffi.cc",
+                                               "fasthist_ffi"):
                 import ctypes
                 try:
                     _FFI_LIB = ctypes.cdll.LoadLibrary(path)

@@ -1,0 +1,92 @@
+"""Native forest scorer (native/fastforest.cc) vs the jitted walk.
+
+The reference scores via per-row JNI ``LGBM_BoosterPredictForMat``
+(SURVEY.md §3.2); our CPU-backend equivalent is the early-exit C++ row
+walk, pinned here bitwise against the accelerator-path XLA scan — the
+same exactness discipline as the binning/histogram kernels
+(test_binary_native.py).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from mmlspark_tpu import native
+from mmlspark_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+from mmlspark_tpu.gbdt.booster import _predict_forest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MMLSPARK_TPU_NO_NATIVE")
+    or jax.default_backend() != "cpu"     # scorer dispatches on cpu only
+    or not native.predict_forest_available(),
+    reason="native forest scorer unavailable (needs cpu backend)")
+
+
+def _jitted_margins(b, X, num_iteration=None):
+    s = b._stack()
+    K = b.num_class
+    T = s["feat"].shape[0]
+    use_t = T if num_iteration is None else min(num_iteration * K, T)
+    m = _predict_forest(
+        np.asarray(X, np.float32), s["feat"][:use_t], s["thr"][:use_t],
+        s["left"][:use_t], s["right"][:use_t], s["leaf"][:use_t],
+        s["single"][:use_t], s["is_cat"][:use_t], s["dleft"][:use_t],
+        s["cat_bnd"][:use_t], s["cat_words"][:use_t], s["depth"], K,
+        s["has_cat"])
+    m = np.asarray(m + b.init_score)
+    return m[:, 0] if K == 1 else m
+
+
+def test_binary_bitwise_parity(rng):
+    X = rng.normal(size=(5000, 12)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    m = LightGBMClassifier(numIterations=15, numLeaves=31,
+                           verbosity=0).fit({"features": X, "label": y})
+    b = m.getModel()
+    got = np.asarray(b.predict_margin(X))
+    want = _jitted_margins(b, X)
+    assert np.array_equal(got, want)
+
+
+def test_multiclass_and_num_iteration(rng):
+    X = rng.normal(size=(3000, 8)).astype(np.float32)
+    y = (np.digitize(X[:, 0], [-0.5, 0.5])).astype(np.float64)
+    m = LightGBMClassifier(numIterations=10, numLeaves=15, verbosity=0,
+                           objective="multiclass").fit(
+        {"features": X, "label": y})
+    b = m.getModel()
+    for it in (None, 3, 10):
+        got = np.asarray(b.predict_margin(X, num_iteration=it))
+        want = _jitted_margins(b, X, num_iteration=it)
+        assert got.shape == want.shape == (3000, 3)
+        assert np.array_equal(got, want), f"num_iteration={it}"
+
+
+def test_categorical_and_nan_parity(rng):
+    n = 4000
+    Xc = rng.integers(0, 40, size=(n, 2)).astype(np.float32)
+    Xn = rng.normal(size=(n, 3)).astype(np.float32)
+    Xn[rng.random(n) < 0.1, 0] = np.nan      # missing numerics
+    X = np.concatenate([Xc, Xn], axis=1)
+    y = ((Xc[:, 0] % 3 == 0) ^ (Xn[:, 1] > 0)).astype(np.float64)
+    m = LightGBMRegressor(numIterations=12, numLeaves=15, verbosity=0,
+                          categoricalSlotIndexes=[0, 1]).fit(
+        {"features": X, "label": y})
+    b = m.getModel()
+    b._stack()
+    assert b._stacked_np["has_cat"]
+    got = np.asarray(b.predict_margin(X))
+    want = _jitted_margins(b, X)
+    assert np.array_equal(got, want)
+    # unseen categories (out of training range, negative) route right in
+    # both walks; fractional negatives in (-1, 0) truncate to category 0
+    # in BOTH walks (int32 truncation happens before the sign gate)
+    X2 = X.copy()
+    X2[:50, 0] = 97.0
+    X2[50:100, 1] = -3.0
+    X2[100:150, 0] = -0.5
+    X2[150:200, 1] = -0.5
+    assert np.array_equal(np.asarray(b.predict_margin(X2)),
+                          _jitted_margins(b, X2))
