@@ -237,7 +237,8 @@ class Booster:
         T = s["feat"].shape[0]
         use_t = T if num_iteration is None else min(num_iteration * K, T)
         sn = self._stacked_np
-        if sn is not None and jax.default_backend() == "cpu":
+        if sn is not None and not isinstance(X, jax.core.Tracer) \
+                and jax.default_backend() == "cpu":
             from .. import native
             if native.predict_forest_available():
                 Xnp = np.ascontiguousarray(np.asarray(X, np.float32))

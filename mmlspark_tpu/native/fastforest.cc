@@ -97,8 +97,22 @@ void PredictRows(const Forest& fr, const float* X, int64_t f, int64_t r0,
       const int32_t* tleft = fr.left + t * fr.m;
       const int32_t* tright = fr.right + t * fr.m;
       int32_t node = fr.single[t] ? -1 : 0;
+      // Corrupt-model hardening, matching the XLA walk where it has a
+      // defined behavior: index clamps mirror XLA's clamping gather
+      // semantics; the step bound (the XLA walk is a fixed-depth
+      // fori_loop) turns a cyclic left/right graph into leaf 0 instead
+      // of a hang.
+      int64_t steps = 0;
       while (node >= 0) {
-        const float x = xrow[tfeat[node]];
+        if (node >= fr.m) node = static_cast<int32_t>(fr.m) - 1;
+        if (++steps > fr.m) {
+          node = -1;
+          break;
+        }
+        int32_t fj = tfeat[node];
+        if (fj < 0) fj = 0;
+        if (fj >= f) fj = static_cast<int32_t>(f) - 1;
+        const float x = xrow[fj];
         bool go_left;
         if (fr.has_cat && fr.is_cat[t * fr.m + node]) {
           go_left = CatGoLeft(x, static_cast<int32_t>(tthr[node]),
@@ -140,6 +154,34 @@ PyObject* PredictForest(PyObject*, PyObject* args) {
       bnd.view.ndim != 2 || words.view.ndim != 2 || out.view.ndim != 2) {
     PyErr_SetString(PyExc_ValueError, "X/feat/leaf/cat_bnd/cat_words/out "
                                       "must be 2-D");
+    return nullptr;
+  }
+  // Every per-node array must be (T, m) like feat, and every per-tree
+  // array must lead with T — the walk indexes them all with feat's
+  // extents, so a mismatch is an out-of-bounds read, not a softer bug.
+  const int64_t Tn = feat.view.shape[0], mn = feat.view.shape[1];
+  const struct { const Py_buffer* v; const char* name; } node_arrs[] = {
+      {&thr.view, "thr"},       {&left.view, "left"},
+      {&right.view, "right"},   {&is_cat.view, "is_cat"},
+      {&dleft.view, "dleft"}};
+  for (const auto& a : node_arrs) {
+    if (a.v->ndim != 2 || a.v->shape[0] != Tn || a.v->shape[1] != mn) {
+      PyErr_Format(PyExc_ValueError, "%s must have feat's shape (T, m)",
+                   a.name);
+      return nullptr;
+    }
+  }
+  if (single.view.ndim != 1 || single.view.shape[0] != Tn ||
+      leaf.view.shape[0] != Tn || bnd.view.shape[0] != Tn ||
+      words.view.shape[0] != Tn) {
+    PyErr_SetString(PyExc_ValueError,
+                    "single/leaf/cat_bnd/cat_words must lead with T trees");
+    return nullptr;
+  }
+  if (leaf.view.shape[1] < 1 || bnd.view.shape[1] < 2 ||
+      words.view.shape[1] < 1 || x.view.shape[1] < 1 || K < 1) {
+    PyErr_SetString(PyExc_ValueError,
+                    "leaf/cat_bnd/cat_words/X widths and K must be >= 1");
     return nullptr;
   }
   Forest fr;

@@ -90,3 +90,49 @@ def test_categorical_and_nan_parity(rng):
     X2[150:200, 1] = -0.5
     assert np.array_equal(np.asarray(b.predict_margin(X2)),
                           _jitted_margins(b, X2))
+
+
+def test_predict_margin_still_jit_traceable(rng):
+    """The native fast path must not capture tracers — wrapping
+    predict_margin in jit worked before the native scorer and must keep
+    working (the branch detects tracers and stays on the XLA walk)."""
+    X = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    m = LightGBMClassifier(numIterations=5, numLeaves=7,
+                           verbosity=0).fit({"features": X, "label": y})
+    b = m.getModel()
+    eager = np.asarray(b.predict_margin(X))
+    traced = np.asarray(jax.jit(b.predict_margin)(X))
+    np.testing.assert_allclose(traced, eager, rtol=1e-6, atol=1e-6)
+
+
+def test_native_entry_rejects_mismatched_shapes(rng):
+    """The public native.predict_forest validates shapes instead of
+    reading out of bounds."""
+    X = rng.normal(size=(100, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    m = LightGBMClassifier(numIterations=3, numLeaves=7,
+                           verbosity=0).fit({"features": X, "label": y})
+    b = m.getModel()
+    b._stack()
+    sn = b._stacked_np
+    out = np.zeros((100, 1), np.float32)
+    with pytest.raises(ValueError, match="feat's shape"):
+        native.predict_forest(
+            X, sn["feat"], np.ascontiguousarray(sn["thr"][:, :1]),
+            sn["left"], sn["right"],
+            sn["leaf"], sn["single"], sn["is_cat"], sn["dleft"],
+            sn["cat_bnd"], sn["cat_words"], 1, sn["has_cat"], out)
+    with pytest.raises(ValueError, match="lead with T"):
+        native.predict_forest(
+            X, sn["feat"], sn["thr"], sn["left"], sn["right"],
+            sn["leaf"][:1], sn["single"], sn["is_cat"], sn["dleft"],
+            sn["cat_bnd"], sn["cat_words"], 1, sn["has_cat"], out)
+    # out must be writable
+    ro = np.zeros((100, 1), np.float32)
+    ro.setflags(write=False)
+    with pytest.raises((ValueError, TypeError, BufferError)):
+        native.predict_forest(
+            X, sn["feat"], sn["thr"], sn["left"], sn["right"],
+            sn["leaf"], sn["single"], sn["is_cat"], sn["dleft"],
+            sn["cat_bnd"], sn["cat_words"], 1, sn["has_cat"], ro)
