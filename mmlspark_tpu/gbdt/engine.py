@@ -539,7 +539,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     data shard's rows go straight to its mesh slice with no global
     materialization (SURVEY.md §7 hard part 4; requires ``mesh``;
     supports validation/early stopping, per-machine bagging, callbacks,
-    init scores, goss and rf — ranking and dart stay monolithic).
+    init scores, goss, rf, dart and lambdarank — for ranking each
+    query's rows must live on one shard).
     """
     if isinstance(bins, (list, tuple)):
         return _train_distributed_sharded(
@@ -650,6 +651,16 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         data_shards=_dn, verbosity=params.verbosity)
     if use_mesh:
         if ranking_info is not None:
+            if init_scores is not None:
+                raise NotImplementedError(
+                    "initScoreCol is not supported with a ranking "
+                    "objective (LightGBM's lambdarank boots from zero)")
+            if callbacks:
+                raise NotImplementedError(
+                    "per-iteration callbacks are not supported with "
+                    "mesh lambdarank (the ranking scan keeps trees on "
+                    "device between chunks); drop the callbacks or "
+                    "train without a mesh")
             return _train_distributed_ranking(
                 bins, labels, w, mapper, objective, params, cfg, mesh,
                 feature_names, init, rng, ranking_info,
@@ -1087,18 +1098,27 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
     Supports the full chunked mesh loop via ``_train_distributed``'s
     ``shard_data`` path: validation/early stopping (the validation set is
     assumed host-small and arrives monolithic), per-machine bagging,
-    callbacks, per-shard init scores, goss and rf.  Still gated: ranking
-    (query packing needs a global sort) and dart (host loop keeps full
-    prediction rows).  ``init_scores`` may be a per-shard LIST or one
-    array in shard-concatenation order."""
+    callbacks (non-ranking), per-shard init scores (non-ranking), goss,
+    rf, dart (data-only mesh) and lambdarank (each query pinned to the
+    shard holding its rows — ranking.shard_queries_from_shards).  Still
+    gated: dart×ranking (the dart host loop keeps full prediction rows),
+    callbacks/init-scores×ranking, and custom gradient overrides.
+    ``init_scores`` may be a per-shard LIST or one array in
+    shard-concatenation order; ``ranking_info['query_ids']`` may be a
+    per-shard list or one array in shard-concatenation order."""
     if mesh is None:
         raise ValueError("sharded input requires a mesh (setMesh or "
                          "multi-device default)")
-    if grad_fn_override is not None or ranking_info is not None:
+    if grad_fn_override is not None:
         raise NotImplementedError(
-            "sharded ingestion does not support ranking objectives yet "
-            "(query packing needs a global per-query sort); pass "
-            "monolithic arrays for lambdarank")
+            "custom gradient overrides are not supported with sharded "
+            "ingestion (the override closes over monolithic rows); "
+            "rankers pass structured ranking_info instead")
+    if ranking_info is not None and params.boosting == "dart":
+        raise NotImplementedError(
+            "boostingType='dart' with a ranking objective requires "
+            "monolithic arrays (the dart host loop keeps full "
+            "prediction rows)")
     if params.boosting == "dart" and int(mesh.shape["feature"]) > 1:
         raise NotImplementedError(
             "boostingType='dart' requires a data-only mesh (the "
@@ -1190,6 +1210,34 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
                   "sizes": sizes,
                   "shard_rows": shard_rows,
                   "init_score_shards": init_score_shards}
+    if ranking_info is not None:
+        if init_score_shards is not None:
+            raise NotImplementedError(
+                "initScoreCol is not supported with a ranking objective "
+                "(LightGBM's lambdarank boots from zero)")
+        if callbacks:
+            raise NotImplementedError(
+                "per-iteration callbacks are not supported with mesh "
+                "lambdarank (the ranking scan keeps trees on device "
+                "between chunks)")
+        qids = ranking_info["query_ids"]
+        if isinstance(qids, (list, tuple)):
+            if any(q is None for q in qids):
+                raise ValueError(
+                    "qid shards must be complete on every controller "
+                    "(1-D metadata, like labels)")
+            qid_shards = [np.asarray(q) for q in qids]
+        else:
+            offs = np.cumsum([0] + sizes)
+            qid_shards = [np.asarray(qids)[offs[d]:offs[d + 1]]
+                          for d in range(len(sizes))]
+        shard_data["qid_shards"] = qid_shards
+        return _train_distributed_ranking(
+            None, None, None, mapper, objective, params, cfg, mesh,
+            feature_names, init, rng, ranking_info,
+            val_bins=val_bins, val_labels=val_labels,
+            val_weights=val_weights, val_metric=val_metric,
+            shard_data=shard_data)
     if params.boosting == "dart":
         return _train_distributed_dart(
             None, None, None, mapper, objective, params, cfg, mesh,
@@ -1208,18 +1256,29 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
 def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
                                cfg, mesh, feature_names, init, rng,
                                ranking_info, val_bins=None, val_labels=None,
-                               val_weights=None, val_metric=None) -> Booster:
+                               val_weights=None, val_metric=None,
+                               shard_data=None) -> Booster:
     """Mesh-sharded lambdarank: whole queries are packed per data shard
     (ranking.shard_queries), pairwise gradients stay shard-local, tree
     growth is data-parallel psum — the distributed MSLR configuration
-    (SURVEY.md §3.1; BASELINE config 5)."""
+    (SURVEY.md §3.1; BASELINE config 5).
+
+    With ``shard_data`` (sharded ingestion), each query is pinned to the
+    shard whose host holds its rows (ranking.shard_queries_from_shards)
+    and the packed matrix assembles per slot via
+    ``make_array_from_callback`` — no global materialization."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..core.mesh import DATA_AXIS, FEATURE_AXIS, pad_to_multiple
     from .distributed import make_ranking_scan
     from .ranking import shard_queries
 
-    n, f = bins.shape
+    if shard_data is None:
+        n, f = bins.shape
+    else:
+        n = int(sum(shard_data["sizes"]))
+        f = next(b.shape[1] for b in shard_data["bins_shards"]
+                 if b is not None)
     T = params.num_iterations
     esr = params.early_stopping_round
     use_ff = params.feature_fraction < 1.0
@@ -1230,22 +1289,62 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
     fn_shards = int(mesh.shape[FEATURE_AXIS])
     has_val = val_bins is not None and val_metric is not None
 
-    perm, real, (qidx, qmask, gains, labq, invmax) = shard_queries(
-        np.asarray(labels), ranking_info["query_ids"], dn,
-        ranking_info["truncation_level"])
+    if shard_data is None:
+        perm, real, (qidx, qmask, gains, labq, invmax) = shard_queries(
+            np.asarray(labels), ranking_info["query_ids"], dn,
+            ranking_info["truncation_level"])
+        w_src = np.asarray(w, np.float32)
+    else:
+        from .ranking import shard_queries_from_shards
+        if len(shard_data["bins_shards"]) != dn:
+            raise ValueError(
+                f"need one shard slot per data-mesh slice: got "
+                f"{len(shard_data['bins_shards'])} slots for data={dn}")
+        perm, real, (qidx, qmask, gains, labq, invmax), sh_offs = \
+            shard_queries_from_shards(
+                shard_data["label_shards"], shard_data["qid_shards"],
+                ranking_info["truncation_level"])
+        w_src = np.concatenate([np.asarray(ws, np.float32)
+                                for ws in shard_data["weight_shards"]])
     npk = len(perm)                     # packed rows (D * S)
     valid = perm >= 0
     fp = pad_to_multiple(f, fn_shards) - f
     f_padded = f + fp
-    bins_np = np.asarray(bins, mapper.bin_dtype)
-    bins_packed = np.zeros((npk, f_padded), mapper.bin_dtype)
-    bins_packed[valid, :f] = bins_np[perm[valid]]
     wmul = np.zeros(npk, np.float32)
-    wmul[valid] = np.asarray(w, np.float32)[perm[valid]]
+    wmul[valid] = w_src[perm[valid]]
 
     shard = lambda a, spec: jax.device_put(  # noqa: E731
         jnp.asarray(a), NamedSharding(mesh, spec))
-    bins_d = shard(bins_packed, P(DATA_AXIS, FEATURE_AXIS))
+    if shard_data is None:
+        bins_np = np.asarray(bins, mapper.bin_dtype)
+        bins_packed = np.zeros((npk, f_padded), mapper.bin_dtype)
+        bins_packed[valid, :f] = bins_np[perm[valid]]
+        bins_d = shard(bins_packed, P(DATA_AXIS, FEATURE_AXIS))
+    else:
+        # slot d's packed rows come from ITS host's local binned matrix
+        # through the global perm shifted by the shard offset — the full
+        # packed matrix never exists on one host (the same discipline as
+        # prepare_arrays_from_shards; the callback never touches
+        # non-local None slots)
+        S_pk = npk // dn
+        b_shards = shard_data["bins_shards"]
+
+        def bins_cb(index):
+            r0, r1, _ = index[0].indices(npk)
+            c0, c1, _ = index[1].indices(f_padded)
+            d = r0 // S_pk
+            out = np.zeros((r1 - r0, c1 - c0), mapper.bin_dtype)
+            p = perm[r0:r1]
+            v = p >= 0
+            src = b_shards[d]
+            ce = min(c1, src.shape[1])
+            if ce > c0:
+                out[v, :ce - c0] = src[p[v] - sh_offs[d], c0:ce]
+            return out
+
+        bins_d = jax.make_array_from_callback(
+            (npk, f_padded),
+            NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS)), bins_cb)
     scores = shard(np.full(npk, init, np.float32), P(DATA_AXIS))
     real_d = shard(real, P(DATA_AXIS))
     wmul_d = shard(wmul, P(DATA_AXIS))

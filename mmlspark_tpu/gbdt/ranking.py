@@ -179,7 +179,7 @@ def make_lambdarank_grad_fn(labels: np.ndarray, query_ids: np.ndarray,
 
 def shard_queries(labels: np.ndarray, query_ids: np.ndarray, n_shards: int,
                   truncation_level: int, max_label: int = 31,
-                  query_chunk_pairs: int = 4_000_000):
+                  query_chunk_pairs: int = 4_000_000, assign=None):
     """Partition whole queries across data shards (greedy row balancing).
 
     The mesh-sharded lambdarank layout (SURVEY.md §3.1 distributed
@@ -193,6 +193,11 @@ def shard_queries(labels: np.ndarray, query_ids: np.ndarray, n_shards: int,
     (D*n_chunks, chunk, G)/(D*n_chunks, chunk) ready for a
     ``P('data', ...)`` sharding — each shard's qidx indexes its LOCAL
     packed rows.
+
+    ``assign`` (optional) overrides the greedy balancer with a fixed
+    query → shard map, one entry per unique query id in SORTED id order —
+    the sharded-ingestion path pins each query to the shard whose host
+    already holds its rows (see :func:`shard_queries_from_shards`).
     """
     q = np.asarray(query_ids)
     order = np.argsort(q, kind="stable")
@@ -201,11 +206,19 @@ def shard_queries(labels: np.ndarray, query_ids: np.ndarray, n_shards: int,
                                   return_counts=True)
     D = n_shards
     loads = np.zeros(D, np.int64)
-    assign = np.empty(len(starts), np.int32)
-    for i, c in enumerate(counts):       # greedy: least-loaded shard
-        s = int(np.argmin(loads))
-        assign[i] = s
-        loads[s] += c
+    if assign is None:
+        assign = np.empty(len(starts), np.int32)
+        for i, c in enumerate(counts):   # greedy: least-loaded shard
+            s = int(np.argmin(loads))
+            assign[i] = s
+            loads[s] += c
+    else:
+        assign = np.asarray(assign, np.int32)
+        if len(assign) != len(starts):
+            raise ValueError(
+                f"assign has {len(assign)} entries for {len(starts)} "
+                "unique queries")
+        np.add.at(loads, assign, counts)
     S = int(loads.max())
     G = int(counts.max())
     qs_per_shard = np.bincount(assign, minlength=D)
@@ -247,6 +260,53 @@ def shard_queries(labels: np.ndarray, query_ids: np.ndarray, n_shards: int,
           labq.reshape(D * (Qp // chunk), chunk, G),
           invmax.reshape(D * (Qp // chunk), chunk))
     return perm.reshape(-1), real, qt
+
+
+def shard_queries_from_shards(label_shards, qid_shards, truncation_level: int,
+                              max_label: int = 31,
+                              query_chunk_pairs: int = 4_000_000):
+    """Query packing for SHARDED ingestion: each query stays on the shard
+    whose host already holds its rows — no cross-host row movement, the
+    multi-host MSLR contract (SURVEY.md §7 hard part 4: per-host readers
+    deliver whole queries; the reference's distributed lambdarank likewise
+    requires group-contiguous partitions).
+
+    ``label_shards`` / ``qid_shards`` are the per-shard 1-D lists (complete
+    on every controller — small metadata, like the plain sharded path's
+    label lists).  A query whose id appears in two shards is an ingestion
+    error and raises.
+
+    Returns ``(perm, real, qt, offsets)``: the same global packing triple
+    as :func:`shard_queries` (``perm`` in shard-concatenation row order)
+    plus the per-shard row offsets, so callers can translate packed slots
+    to LOCAL shard rows: ``local = perm[d*S + j] - offsets[d]``.
+    """
+    D = len(qid_shards)
+    sizes = np.array([len(np.asarray(q)) for q in qid_shards], np.int64)
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    qids = np.concatenate([np.asarray(q) for q in qid_shards])
+    labels = np.concatenate([np.asarray(l, np.float32)
+                             for l in label_shards])
+    if len(labels) != len(qids):
+        raise ValueError(
+            f"labels ({len(labels)}) and query ids ({len(qids)}) differ")
+    shard_of_row = np.repeat(np.arange(D, dtype=np.int32), sizes)
+    uq, inv = np.unique(qids, return_inverse=True)
+    lo = np.full(len(uq), D, np.int32)
+    hi = np.full(len(uq), -1, np.int32)
+    np.minimum.at(lo, inv, shard_of_row)
+    np.maximum.at(hi, inv, shard_of_row)
+    spans = np.nonzero(lo != hi)[0]
+    if len(spans):
+        bad = uq[spans[0]]
+        raise ValueError(
+            f"query {bad!r} spans shards {lo[spans[0]]} and "
+            f"{hi[spans[0]]}: sharded lambdarank requires every query's "
+            "rows on ONE shard (group-contiguous ingestion)")
+    perm, real, qt = shard_queries(
+        labels, qids, D, truncation_level, max_label=max_label,
+        query_chunk_pairs=query_chunk_pairs, assign=lo)
+    return perm, real, qt, offsets
 
 
 class LightGBMRanker(LightGBMBase):

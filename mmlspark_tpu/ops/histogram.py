@@ -47,6 +47,8 @@ _SWEEP_CACHE: dict = {}
 def _load_sweep(backend: str) -> Optional[dict]:
     """Measured winner-by-rows table for this backend (see
     tools/sweep_histogram.py), or None if never swept."""
+    if backend == "axon":  # tunneled TPU: same silicon, same table
+        backend = "tpu"
     if backend not in _SWEEP_CACHE:
         import json
         import os
