@@ -66,6 +66,9 @@ class TrainParams:
     #: PV-Tree voting: features voted per shard (LightGBM top_k)
     top_k: int = 20
     histogram_method: str = "auto"
+    #: pack four uint8 bins per u32 word for the per-split segment gather
+    #: (grower.GrowerConfig.packed_gather); measured knob, default off
+    packed_gather: bool = False
     verbosity: int = 1
     #: categorical split knobs (LightGBM names)
     cat_smooth: float = 10.0
@@ -86,8 +89,41 @@ class TrainParams:
     enable_bundle: bool = False
     max_conflict_rate: float = 0.0
     #: raw passthrough params recorded into the model file (parity with the
-    #: reference's passThroughArgs; engine-known keys override these)
+    #: reference's passThroughArgs).  Keys that NAME a TrainParams field
+    #: are applied onto it (string-coerced) in ``__post_init__`` — like
+    #: the reference, where passThroughArgs reach the native learner —
+    #: while typed setters keep precedence semantics LightGBM-style
+    #: (last writer wins: pass_through applies after the constructor).
     pass_through: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for k, v in self.pass_through.items():
+            if k == "pass_through" or not hasattr(self, k):
+                continue
+            cur = getattr(self, k)
+            s = str(v).strip()
+            try:
+                if isinstance(cur, bool):
+                    low = s.lower()
+                    if low in ("1", "true", "yes", "on"):
+                        val = True
+                    elif low in ("0", "false", "no", "off"):
+                        val = False
+                    else:
+                        raise ValueError(f"not a boolean: {s!r}")
+                elif isinstance(cur, int):
+                    val = int(s)
+                elif isinstance(cur, float):
+                    val = float(s)
+                elif isinstance(cur, str):
+                    val = s
+                else:
+                    continue
+            except ValueError as e:
+                raise ValueError(
+                    f"passThroughArgs {k}={v!r} cannot be coerced to "
+                    f"{type(cur).__name__}: {e}") from None
+            setattr(self, k, val)
 
 
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"),
@@ -570,6 +606,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
         hist_method=params.histogram_method,
+        packed_gather=params.packed_gather,
         voting_k=params.top_k if use_voting else 0,
         use_categorical=mapper.has_categorical,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
@@ -1177,6 +1214,7 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
         hist_method=params.histogram_method,
+        packed_gather=params.packed_gather,
         voting_k=params.top_k if params.parallelism == "voting" else 0,
         use_categorical=mapper.has_categorical,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,

@@ -482,3 +482,40 @@ class TestRound4Objectives:
         assert (p3 > 0).all()              # log link survives the file
         np.testing.assert_allclose(np.asarray(r.getModel().predict(X)),
                                    p3, rtol=1e-5)
+
+
+class TestPassThroughArgs:
+    """passThroughArgs reach the engine like the reference's reach native
+    LightGBM: keys naming TrainParams fields apply (string-coerced), the
+    rest are recorded into the model file verbatim."""
+
+    def test_pass_through_applies_and_records(self):
+        from mmlspark_tpu.gbdt import LightGBMClassifier
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(400, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(float)
+        t = {"features": X, "label": y}
+        m = LightGBMClassifier(
+            numIterations=3, numLeaves=31, verbosity=0,
+            passThroughArgs="num_leaves=5 custom_tag=abc").fit(t)
+        s = m.getModel().save_native_model_string()
+        # num_leaves=5 overrode the typed 31: no tree has >5 leaves
+        for tr in m.getModel().trees:
+            assert tr.num_leaves <= 5
+        assert "[custom_tag: abc]" in s
+
+    def test_pass_through_packed_gather_identical_model(self):
+        from mmlspark_tpu.gbdt import LightGBMClassifier
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(2000, 8)).astype(np.float32)
+        y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(float)
+        t = {"features": X, "label": y}
+        kw = dict(numIterations=4, numLeaves=7, verbosity=0,
+                  histogramMethod="dot16")
+        a = LightGBMClassifier(**kw).fit(t)
+        b = LightGBMClassifier(**kw,
+                               passThroughArgs="packed_gather=true").fit(t)
+        for x, z in zip(a.getModel().trees, b.getModel().trees):
+            np.testing.assert_array_equal(x.split_feature, z.split_feature)
+            np.testing.assert_allclose(x.leaf_value, z.leaf_value,
+                                       rtol=1e-6, atol=1e-7)

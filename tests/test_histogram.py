@@ -155,3 +155,46 @@ class TestNativePartitionParity:
             np.testing.assert_array_equal(x.decision_type, z.decision_type)
             np.testing.assert_allclose(x.leaf_value, z.leaf_value,
                                        rtol=1e-4, atol=1e-6)
+
+
+class TestPackedGather:
+    """packed_gather (four uint8 bins per u32 word in the segment gather)
+    must be a pure layout change: identical trees, any histogram method."""
+
+    def _grow(self, packed, method="dot16"):
+        import jax.numpy as jnp
+        from mmlspark_tpu.gbdt.grower import (GrowerConfig, grow_tree,
+                                              make_feat_info)
+        rng = np.random.default_rng(4)
+        n, f, B = 3000, 10, 64
+        bins = rng.integers(0, B, size=(n, f)).astype(np.uint8)
+        y = (bins[:, 0] > 30).astype(np.float32) + rng.normal(
+            scale=0.1, size=n).astype(np.float32)
+        g = (y - y.mean()).astype(np.float32)
+        gh = np.stack([g, np.ones(n, np.float32),
+                       np.ones(n, np.float32)], axis=1)
+        cfg = GrowerConfig(num_leaves=15, num_bins=B, min_data_in_leaf=5,
+                           hist_method=method, packed_gather=packed)
+        return grow_tree(jnp.asarray(bins), jnp.asarray(gh),
+                         make_feat_info(f), cfg)
+
+    def test_packed_matches_plain(self):
+        t0, rl0 = self._grow(False)
+        t1, rl1 = self._grow(True)
+        np.testing.assert_array_equal(np.asarray(t0.node_feat),
+                                      np.asarray(t1.node_feat))
+        np.testing.assert_array_equal(np.asarray(t0.node_bin),
+                                      np.asarray(t1.node_bin))
+        np.testing.assert_allclose(np.asarray(t0.leaf_value),
+                                   np.asarray(t1.leaf_value),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(np.asarray(rl0), np.asarray(rl1))
+
+    def test_packed_matches_plain_segment_method(self):
+        t0, _ = self._grow(False, method="segment")
+        t1, _ = self._grow(True, method="segment")
+        np.testing.assert_array_equal(np.asarray(t0.node_feat),
+                                      np.asarray(t1.node_feat))
+        np.testing.assert_allclose(np.asarray(t0.leaf_value),
+                                   np.asarray(t1.leaf_value),
+                                   rtol=1e-6, atol=1e-7)
