@@ -68,6 +68,10 @@ def main():
                          "wedged lease can take several minutes to claim, "
                          "and falling back to CPU forfeits the benchmark")
     ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--pass-through", default="",
+                    help="passThroughArgs forwarded to the estimator "
+                         "(A/B knobs, e.g. 'packed_gather=true'); empty "
+                         "for the official configuration")
     args = ap.parse_args()
 
     n = args.rows or (20_000 if args.smoke else 400_000)
@@ -157,6 +161,9 @@ def run_bench(args, n, f, iters, leaves, result):
 
     kw = dict(learningRate=0.1, numLeaves=leaves, maxBin=255,
               minDataInLeaf=20, verbosity=0)
+    if args.pass_through:
+        kw["passThroughArgs"] = args.pass_through
+        result["detail"]["pass_through"] = args.pass_through
     # warm-up: identical config so the timed fit is pure steady state
     # (boost step AND forest-pack kernels compiled, caches hot)
     log("warm-up / compile...")

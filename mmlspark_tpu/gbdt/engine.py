@@ -1609,6 +1609,12 @@ def _finalize_booster(trees, K, init, params, objective, mapper,
             t.leaf_value = t.leaf_value + init
             t.internal_value = t.internal_value + init
 
+    # pass_through keys that NAME TrainParams fields were applied by
+    # __post_init__ and are already reflected in the typed values above
+    # (num_iterations especially records the early-stopped count, which a
+    # raw spread would clobber); only engine-unknown keys record verbatim
+    extra = {k: v for k, v in params.pass_through.items()
+             if not hasattr(params, k)}
     engine_params = {
         "boosting": params.boosting,
         "objective": objective.model_str,
@@ -1617,7 +1623,7 @@ def _finalize_booster(trees, K, init, params, objective, mapper,
         "num_leaves": str(params.num_leaves),
         "max_depth": str(params.max_depth),
         "max_bin": str(params.max_bin),
-        **params.pass_through,
+        **extra,
     }
     return Booster(
         trees, num_class=K, objective_str=objective.model_str,

@@ -190,12 +190,15 @@ def main():
 
 
 def write_markdown(out_path, state, backend, f, B, R):
-    import jax
+    kind = state.get("device_kind")
+    if not kind:
+        import jax
+        kind = jax.devices()[0].device_kind
     by_rows = state["times_us_by_rows"]
     lines = [
         "# Histogram-method sweep",
         "",
-        f"Backend: **{backend}** ({jax.devices()[0].device_kind}); "
+        f"Backend: **{backend}** ({kind}); "
         f"shapes: (n, {f}) uint8 bins, {B} bins, 3 gradient channels.  "
         f"Per-call microseconds via the in-program slope "
         f"(R={R} scan reps vs 1; each endpoint min over 5 timed runs) — "
