@@ -617,6 +617,25 @@ def _global_totals(g, h, c, cfg: GrowerConfig):
 def _find_split(hist, pg, ph, pc, fi, depth_ok, cfg: GrowerConfig):
     if _is_voting(cfg):
         return find_best_split_voting(hist, pg, ph, pc, fi, depth_ok, cfg)
+    if (cfg.hist_method in ("auto", "native") and not cfg.use_categorical
+            and cfg.axis_name is None and cfg.feature_axis_name is None
+            and (cfg.min_sum_hessian_in_leaf > 0 or cfg.lambda_l2 > 0)):
+        # serial CPU path: the whole FindBestThreshold scan as one FFI
+        # call; the C++ pass picks the winner, the gain is recomputed on
+        # XLA's float trajectory (see native_find_split).  Mesh/voting/
+        # categorical keep XLA; so does the degenerate min_sum_hessian=
+        # lambda_l2=0 config, whose empty-side gains go NaN and argmax
+        # semantics would differ.
+        from ..ops.histogram import native_find_split
+        res = native_find_split(
+            hist, pg, ph, pc, fi[:, 0], depth_ok,
+            cfg.min_data_in_leaf, cfg.min_sum_hessian_in_leaf,
+            cfg.lambda_l1, cfg.lambda_l2,
+            max(cfg.min_gain_to_split, EPS_GAIN), cfg.num_bins)
+        if res is not None:
+            gain, feat, b = res
+            return (gain, feat, b, jnp.asarray(0, jnp.int32),
+                    jnp.zeros(cfg.cat_words, jnp.uint32))
     return find_best_split(hist, pg, ph, pc, fi, depth_ok, cfg)
 
 

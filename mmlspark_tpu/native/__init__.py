@@ -185,6 +185,12 @@ def partition_ffi_handler():
     return getattr(lib, "MmlsparkFastPartition", None) if lib else None
 
 
+def split_ffi_handler():
+    """Numeric best-split scan FFI handler (serial-path FindBestThreshold)."""
+    lib = _ffi_lib()
+    return getattr(lib, "MmlsparkFastSplit", None) if lib else None
+
+
 def bin_columns(X, bext, nb, base, lo, scale, use_table, missing_bin,
                 out) -> None:
     """Native BinMapper transform; see fastbin.cc for the argument

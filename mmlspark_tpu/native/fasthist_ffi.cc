@@ -18,7 +18,9 @@
 // image).
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
+#include <limits>
 #include <vector>
 
 #include "xla/ffi/api/ffi.h"
@@ -174,4 +176,101 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Arg<ffi::Buffer<ffi::S32>>()
         .Arg<ffi::Buffer<ffi::U32>>()
         .Ret<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());
+
+// Numeric best-split scan over a (f, B, 3) histogram — the serial-path
+// FindBestThreshold (LightGBM src/treelearner/feature_histogram.hpp
+// analog; expected path, UNVERIFIED).  Same validity rules and
+// first-occurrence (feature-major, bin-minor) argmax order as
+// grower.find_best_split's numeric branch: left = bins <= b, last bin
+// excluded, min_data_in_leaf / min_sum_hessian gates, gain =
+// leaf_gain(l) + leaf_gain(r) - leaf_gain(parent) in the l1-threshold
+// form.  The sequential f32 prefix sums here round differently from
+// XLA's cumsum, so this kernel's contribution is the WINNING (feature,
+// bin) — the Python wrapper recomputes the recorded gain on XLA's
+// float trajectory (ops/histogram.py native_find_split).
+// parent (3,) f32 = [g, h, c]; conf (6,) f32 = [min_data_in_leaf,
+// min_sum_hessian, lambda_l1, lambda_l2, gain_floor, depth_ok];
+// outs: gain (1,) f32, fb (2,) i32 = [feature, bin].
+static inline float LeafGainL1(float g, float h, float l1, float l2) {
+  float t = std::fabs(g) - l1;
+  if (t < 0.f) t = 0.f;
+  t = std::copysign(t, g);
+  if (g == 0.f) t = 0.f;  // jnp.sign(0) == 0
+  return (t * t) / (h + l2);
+}
+
+static ffi::Error SplitImpl(ffi::Buffer<ffi::F32> hist,
+                            ffi::Buffer<ffi::F32> parent,
+                            ffi::Buffer<ffi::F32> fmask,
+                            ffi::Buffer<ffi::F32> conf,
+                            ffi::ResultBuffer<ffi::F32> gain_out,
+                            ffi::ResultBuffer<ffi::S32> fb_out) {
+  const auto hd = hist.dimensions();
+  if (hd.size() != 3 || hd[2] != 3) {
+    return ffi::Error::InvalidArgument("fastsplit: hist must be (f,B,3)");
+  }
+  const int64_t f = hd[0];
+  const int64_t B = hd[1];
+  if (parent.element_count() < 3 || conf.element_count() < 6 ||
+      fmask.element_count() < f) {
+    return ffi::Error::InvalidArgument(
+        "fastsplit: need parent (3,), conf (6,), fmask (f,)");
+  }
+  const float* h = hist.typed_data();
+  const float pg = parent.typed_data()[0];
+  const float ph = parent.typed_data()[1];
+  const float pc = parent.typed_data()[2];
+  const float* fm = fmask.typed_data();
+  const float* cf = conf.typed_data();
+  const float min_cnt = cf[0];
+  const float min_hess = cf[1];
+  const float l1 = cf[2];
+  const float l2 = cf[3];
+  const float gain_floor = cf[4];
+  const bool depth_ok = cf[5] != 0.f;
+  const float parent_gain = LeafGainL1(pg, ph, l1, l2);
+  float best = -std::numeric_limits<float>::infinity();
+  int32_t bf = 0, bb = 0;
+  if (depth_ok) {
+    for (int64_t j = 0; j < f; ++j) {
+      if (!(fm[j] > 0.f)) continue;
+      const float* hj = h + j * B * 3;
+      float gl = 0.f, hl = 0.f, cl = 0.f;
+      for (int64_t b = 0; b + 1 < B; ++b) {  // last bin excluded
+        gl += hj[3 * b];
+        hl += hj[3 * b + 1];
+        cl += hj[3 * b + 2];
+        const float gr = pg - gl;
+        const float hr = ph - hl;
+        const float cr = pc - cl;
+        if (cl >= min_cnt && cr >= min_cnt && hl >= min_hess &&
+            hr >= min_hess) {
+          const float gain = LeafGainL1(gl, hl, l1, l2) +
+                             LeafGainL1(gr, hr, l1, l2) - parent_gain;
+          if (gain > best) {  // strict: first occurrence wins, like argmax
+            best = gain;
+            bf = static_cast<int32_t>(j);
+            bb = static_cast<int32_t>(b);
+          }
+        }
+      }
+    }
+  }
+  gain_out->typed_data()[0] =
+      best > gain_floor ? best
+                        : -std::numeric_limits<float>::infinity();
+  fb_out->typed_data()[0] = bf;
+  fb_out->typed_data()[1] = bb;
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    MmlsparkFastSplit, SplitImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>()
         .Ret<ffi::Buffer<ffi::S32>>());

@@ -78,6 +78,7 @@ def _native_available() -> bool:
                 "mmlspark_fasthist": native.hist_ffi_handler(),
                 "mmlspark_fastseghist": native.seg_hist_ffi_handler(),
                 "mmlspark_fastpartition": native.partition_ffi_handler(),
+                "mmlspark_fastsplit": native.split_ffi_handler(),
             }
             if all(h is not None for h in handlers.values()):
                 for name, h in handlers.items():
@@ -133,6 +134,58 @@ def native_partition(row_order, col, off, cnt, thr, use_cat, cat_bits,
     )(row_order.astype(jnp.int32), col.astype(jnp.uint8), meta,
       cat_bits.astype(jnp.uint32))
     return ro, counts[0], counts[1]
+
+
+def native_find_split(hist, parent_g, parent_h, parent_c, feature_mask,
+                      depth_ok, min_data_in_leaf, min_sum_hessian,
+                      lambda_l1, lambda_l2, gain_floor, num_bins):
+    """Numeric FindBestThreshold as one C++ pass (serial CPU path), or
+    None when the native path doesn't apply.  Returns ``(gain, feat,
+    bin)``; the caller supplies the is_cat/cat_bits zeros.
+
+    The C++ scan picks the winning (feature, bin) with the same validity
+    rules and first-occurrence flat order as grower.find_best_split, but
+    its sequential f32 prefix sums round differently from XLA's cumsum,
+    so the WINNER is what it contributes — the recorded gain is then
+    recomputed here by the XLA float path on the winning feature row.
+    That keeps best_gain (the best-first leaf priority) and the exported
+    split_gain on XLA's float trajectory; the forests can differ from
+    the pure-XLA path only when two candidates tie within prefix-sum
+    rounding (fuzz-pinned winner-identical in tests/test_histogram.py)."""
+    if not _native_applies(num_bins):
+        return None
+    parent = jnp.stack([parent_g, parent_h, parent_c]).astype(jnp.float32)
+    conf = jnp.stack([
+        jnp.float32(min_data_in_leaf), jnp.float32(min_sum_hessian),
+        jnp.float32(lambda_l1), jnp.float32(lambda_l2),
+        jnp.float32(gain_floor),
+        jnp.asarray(depth_ok, jnp.float32)])
+    gain_n, fb = jax.ffi.ffi_call(
+        "mmlspark_fastsplit",
+        (jax.ShapeDtypeStruct((1,), jnp.float32),
+         jax.ShapeDtypeStruct((2,), jnp.int32)),
+    )(hist.astype(jnp.float32), parent,
+      feature_mask.astype(jnp.float32), conf)
+    feat, b = fb[0], fb[1]
+    l1 = jnp.float32(lambda_l1)
+    l2 = jnp.float32(lambda_l2)
+
+    def lg(g, h):
+        t = jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, jnp.float32(0))
+        return jnp.square(t) / (h + l2)
+
+    row = jax.lax.dynamic_index_in_dim(hist.astype(jnp.float32), feat,
+                                       axis=0, keepdims=False)   # (B, 3)
+    cum = jnp.cumsum(row, axis=0)
+    cell = jax.lax.dynamic_index_in_dim(cum, b, axis=0,
+                                        keepdims=False)          # (3,)
+    gl, hl = cell[0], cell[1]
+    pg = jnp.float32(parent_g)
+    ph = jnp.float32(parent_h)
+    gain_x = lg(gl, hl) + lg(pg - gl, ph - hl) - lg(pg, ph)
+    gain = jnp.where(jnp.isfinite(gain_n[0]), gain_x,
+                     jnp.float32(-jnp.inf))
+    return gain, feat, b
 
 
 def _auto_method(n_rows: Optional[int] = None) -> str:

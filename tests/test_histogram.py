@@ -198,3 +198,53 @@ class TestPackedGather:
         np.testing.assert_allclose(np.asarray(t0.leaf_value),
                                    np.asarray(t1.leaf_value),
                                    rtol=1e-6, atol=1e-7)
+
+
+class TestNativeFindSplit:
+    """The C++ FindBestThreshold must agree with the XLA scan on the
+    winning (feature, bin) across random histograms, and the wrapper's
+    recomputed gain must land on XLA's float trajectory bit-for-bit."""
+
+    def test_fuzz_winner_and_gain_match_xla(self):
+        import jax.numpy as jnp
+        from mmlspark_tpu.gbdt.grower import (GrowerConfig,
+                                              find_best_split,
+                                              make_feat_info)
+        from mmlspark_tpu.ops.histogram import native_find_split
+        cfg = GrowerConfig(num_bins=64, min_data_in_leaf=3,
+                           hist_method="segment")  # XLA reference path
+        fi = jnp.asarray(make_feat_info(6))
+        rng = np.random.default_rng(123)
+        mismatched_winner = 0
+        for trial in range(60):
+            counts = rng.integers(0, 40, size=(6, 64)).astype(np.float32)
+            g = rng.normal(size=(6, 64)).astype(np.float32) * counts
+            h = (rng.random(size=(6, 64)).astype(np.float32) + 0.1) * counts
+            hist = jnp.asarray(np.stack([g, h, counts], axis=2))
+            pg, ph, pc = (jnp.float32(g.sum() / 6), jnp.float32(h.sum() / 6),
+                          jnp.float32(counts.sum() / 6))
+            # per-feature histograms sum to the same totals in real use;
+            # use feature 0's totals so l/r complements stay meaningful
+            pg = jnp.asarray(hist[0, :, 0].sum())
+            ph = jnp.asarray(hist[0, :, 1].sum())
+            pc = jnp.asarray(hist[0, :, 2].sum())
+            xg, xf, xb, _, _ = find_best_split(
+                hist, pg, ph, pc, fi, jnp.asarray(True), cfg)
+            res = native_find_split(
+                hist, pg, ph, pc, fi[:, 0], jnp.asarray(True),
+                cfg.min_data_in_leaf, cfg.min_sum_hessian_in_leaf,
+                cfg.lambda_l1, cfg.lambda_l2, 1e-10, cfg.num_bins)
+            if res is None:
+                import pytest
+                pytest.skip("native extension unavailable")
+            ng, nf, nb = res
+            if (int(xf), int(xb)) != (int(nf), int(nb)):
+                mismatched_winner += 1
+                continue
+            if np.isfinite(float(xg)) or np.isfinite(float(ng)):
+                np.testing.assert_array_equal(
+                    np.float32(xg), np.float32(ng),
+                    err_msg=f"trial {trial}: gain bits diverged")
+        # winners may legitimately differ only on rounding ties; across
+        # this seeded fuzz none do
+        assert mismatched_winner == 0
