@@ -76,7 +76,9 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
                       objective: str = "binary",
                       feature_col: str = "features",
                       label_col: str = "label",
-                      weight_col: Optional[str] = None
+                      weight_col: Optional[str] = None,
+                      group_col: Optional[str] = None,
+                      ranking: Optional[dict] = None
                       ) -> Callable[[int, Iterable], Iterator]:
     """Executor-side TRAINING closure — the reference's deployment shape,
     where training happens INSIDE the executors (SURVEY.md §3.1), not on
@@ -106,7 +108,27 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
     Spark-free testable: the returned fn is plain Python —
     ``tests/test_spark_adapter.py`` drives it with real separate
     processes.
+
+    Ranking: pass ``objective="lambdarank"`` plus ``group_col`` (and,
+    optionally ``ranking={"sigma": ..., "truncation_level": ...}``).
+    Each partition must hold WHOLE queries (partition the DataFrame by
+    the group column — the reference likewise needs group-contiguous
+    partitions for distributed lambdarank); a query spanning partitions
+    fails fast in the engine.  Query ids ride the same 1-D metadata
+    allgather as labels and feed the sharded query-pinned packing
+    (ranking.shard_queries_from_shards).
     """
+
+    is_rank = objective == "lambdarank"
+    if bool(group_col) != is_rank:
+        raise ValueError(
+            "ranking configuration mismatch: objective='lambdarank' "
+            "requires group_col, and group_col requires "
+            "objective='lambdarank' (got objective="
+            f"{objective!r}, group_col={group_col!r})")
+    if ranking and not group_col:
+        raise ValueError("ranking={...} without group_col has no effect; "
+                         "pass the query/group column")
 
     def fn(task_index: int, batches: Iterable) -> Iterator:
         import jax
@@ -131,6 +153,7 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
             X = np.zeros((0, mapper.num_features), np.float64)
             y_local = np.zeros(0, np.float64)
             w_local = np.zeros(0, np.float64)
+            q_local = np.zeros(0, np.float64)
         else:
             first = pdf[feature_col].iloc[0]
             X = (np.stack([np.asarray(v, np.float64)
@@ -140,20 +163,33 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
             y_local = pdf[label_col].to_numpy(np.float64)
             w_local = (pdf[weight_col].to_numpy(np.float64)
                        if weight_col else np.ones(len(y_local)))
+            q_local = (pdf[group_col].to_numpy(np.float64)
+                       if group_col else np.zeros(0, np.float64))
         bins_local = mapper.transform_packed(X)
 
-        # global per-shard sizes + 1-D label/weight metadata: pad to the
-        # global max and allgather (process_allgather stacks per-process
-        # host values), then slice back per shard
+        # global per-shard sizes + 1-D label/weight(/qid) metadata: pad
+        # to the global max and allgather (process_allgather stacks
+        # per-process host values), then slice back per shard
         sizes = np.asarray(multihost_utils.process_allgather(
             np.asarray([len(y_local)]))).reshape(-1)
         S = int(sizes.max())
         pad = S - len(y_local)
-        yw = np.stack([np.pad(y_local, (0, pad)),
-                       np.pad(w_local, (0, pad))])
+        rows = [np.pad(y_local, (0, pad)), np.pad(w_local, (0, pad))]
+        if group_col:
+            rows.append(np.pad(q_local, (0, pad), constant_values=-1))
+        yw = np.stack(rows)
         yw_all = np.asarray(multihost_utils.process_allgather(yw))
         label_shards = [yw_all[d, 0, :sizes[d]] for d in range(num_tasks)]
         weight_shards = [yw_all[d, 1, :sizes[d]] for d in range(num_tasks)]
+        ranking_info = None
+        if group_col:
+            qid_shards = [yw_all[d, 2, :sizes[d]] for d in range(num_tasks)]
+            ranking_info = {
+                "query_ids": qid_shards,
+                "sigma": float((ranking or {}).get("sigma", 1.0)),
+                "truncation_level": int(
+                    (ranking or {}).get("truncation_level", 30)),
+            }
 
         devs = np.asarray(jax.devices())
         if len(devs) != num_tasks:
@@ -168,7 +204,8 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
         slots[task_index] = bins_local
         booster = train(slots, label_shards, weight_shards, mapper,
                         get_objective(objective), params, mesh=mesh,
-                        shard_rows=[int(s) for s in sizes])
+                        shard_rows=[int(s) for s in sizes],
+                        ranking_info=ranking_info)
         if task_index == 0:
             yield pd.DataFrame(
                 {"model": [booster.save_native_model_string()]})

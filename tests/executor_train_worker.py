@@ -5,9 +5,25 @@ Spark barrier task would, in a real separate OS process."""
 import sys
 
 
+def rank_table(rng, n_q=24, group=15, f=6):
+    """Deterministic ranking table; every task regenerates it."""
+    import numpy as np
+    n = n_q * group
+    X = rng.normal(size=(n, f)).astype(np.float64)
+    util = X @ rng.normal(size=f) + rng.normal(size=n) * 0.4
+    q = np.repeat(np.arange(n_q), group)
+    y = np.zeros(n)
+    for qq in range(n_q):
+        m = q == qq
+        y[m] = np.clip(np.digitize(
+            util[m], np.quantile(util[m], [0.5, 0.8])), 0, 2)
+    return X, y, q
+
+
 def main():
     port, task_index, num_tasks, outdir = (sys.argv[1], int(sys.argv[2]),
                                            int(sys.argv[3]), sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "binary"
     import os
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -22,18 +38,30 @@ def main():
 
     # deterministic table all tasks can regenerate; each keeps ITS
     # partition only (Spark would hand each barrier task its partition)
-    rng = np.random.default_rng(1)
-    X = rng.normal(size=(500, 7)).astype(np.float64)
-    y = (X[:, 0] - 0.7 * X[:, 3] > 0).astype(np.float64)
-    mapper = fit_bin_mapper(X, max_bin=31)     # driver-side, on a sample
-    cut = 230                                  # unequal partitions
-    part = slice(0, cut) if task_index == 0 else slice(cut, 500)
-    pdf = pd.DataFrame({"features": list(X[part]), "label": y[part]})
-
-    fn = executor_train_fn(
-        mapper, TrainParams(num_iterations=5, num_leaves=7,
-                            min_data_in_leaf=5, verbosity=0),
-        num_tasks, f"127.0.0.1:{port}")
+    if mode == "rank":
+        X, y, q = rank_table(np.random.default_rng(2))
+        mapper = fit_bin_mapper(X, max_bin=31)
+        # group-contiguous partitions: task d owns queries d, d+2, ...
+        mine = np.isin(q, np.arange(task_index, q.max() + 1, num_tasks))
+        pdf = pd.DataFrame({"features": list(X[mine]), "label": y[mine],
+                            "query": q[mine]})
+        fn = executor_train_fn(
+            mapper, TrainParams(num_iterations=6, num_leaves=7,
+                                min_data_in_leaf=5, verbosity=0),
+            num_tasks, f"127.0.0.1:{port}", objective="lambdarank",
+            group_col="query", ranking={"truncation_level": 30})
+    else:
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(500, 7)).astype(np.float64)
+        y = (X[:, 0] - 0.7 * X[:, 3] > 0).astype(np.float64)
+        mapper = fit_bin_mapper(X, max_bin=31)  # driver-side, on a sample
+        cut = 230                               # unequal partitions
+        part = slice(0, cut) if task_index == 0 else slice(cut, 500)
+        pdf = pd.DataFrame({"features": list(X[part]), "label": y[part]})
+        fn = executor_train_fn(
+            mapper, TrainParams(num_iterations=5, num_leaves=7,
+                                min_data_in_leaf=5, verbosity=0),
+            num_tasks, f"127.0.0.1:{port}")
     out = list(fn(task_index, iter([pdf])))
     if task_index == 0:
         with open(os.path.join(outdir, "model.txt"), "w") as fh:

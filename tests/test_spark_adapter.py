@@ -182,3 +182,63 @@ class TestExecutorSideTraining:
         np.testing.assert_allclose(
             executor_model.predict_margin(X), ref.predict_margin(X),
             rtol=2e-3, atol=1e-5)
+
+    def test_barrier_tasks_train_ranker(self, tmp_path):
+        """Executor-side lambdarank: group-contiguous partitions feed the
+        query-pinned sharded packing; the emitted model must match a
+        driver-side sharded fit of the same shards."""
+        import socket
+        import subprocess
+        import sys
+
+        import numpy as np
+
+        port_s = socket.socket()
+        port_s.bind(("127.0.0.1", 0))
+        port = port_s.getsockname()[1]
+        port_s.close()
+        worker = os.path.join(os.path.dirname(__file__),
+                              "executor_train_worker.py")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(port), str(i), "2",
+             str(tmp_path), "rank"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for i in range(2)]
+        outs = [p.communicate(timeout=540) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"rank barrier task failed:\n{err[-3000:]}"
+        assert "TASK0_OK" in outs[0][0]
+
+        from executor_train_worker import rank_table
+        from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+        from mmlspark_tpu.gbdt.booster import Booster
+        from mmlspark_tpu.gbdt.engine import TrainParams, train
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        X, y, q = rank_table(np.random.default_rng(2))
+        mapper = fit_bin_mapper(X, max_bin=31)
+        import jax
+        from jax.sharding import Mesh
+
+        from mmlspark_tpu.core.mesh import DATA_AXIS, FEATURE_AXIS
+        idx = [np.nonzero(np.isin(q, np.arange(d, q.max() + 1, 2)))[0]
+               for d in range(2)]
+        mesh2 = Mesh(np.asarray(jax.devices()[:2]).reshape(2, 1),
+                     (DATA_AXIS, FEATURE_AXIS))
+        ref = train([mapper.transform_packed(X[i]) for i in idx],
+                    [y[i] for i in idx], None, mapper,
+                    get_objective("lambdarank"),
+                    TrainParams(num_iterations=6, num_leaves=7,
+                                min_data_in_leaf=5, verbosity=0),
+                    mesh=mesh2,
+                    ranking_info={"query_ids": [q[i].astype(np.float64)
+                                                for i in idx],
+                                  "sigma": 1.0, "truncation_level": 30})
+        executor_model = Booster.load_native_model_string(
+            open(os.path.join(str(tmp_path), "model.txt")).read())
+        np.testing.assert_allclose(
+            executor_model.predict_margin(X), ref.predict_margin(X),
+            rtol=2e-3, atol=1e-5)
