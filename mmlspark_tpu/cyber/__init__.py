@@ -1,0 +1,27 @@
+"""Cyber-ML utilities: tenant-partitioned feature engineering + access
+anomaly detection.
+
+Re-creation of the reference's hand-written ``mmlspark/cyber`` python
+package (SURVEY.md §2.1 "Hand-written Python" row; expected paths
+src/main/python/mmlspark/cyber/{feature,anomaly}/*.py, UNVERIFIED):
+per-tenant id indexing and scaling, complement-set sampling, and the
+collaborative-filtering ``AccessAnomaly`` estimator.  The reference
+implements these as PySpark window/groupBy jobs over a latent-factor
+model; here the per-tenant models are padded, stacked arrays and the
+ALS solves are batched dense normal equations — ``vmap``-over-tenants
+matmul + Cholesky solve, the MXU shape of the same math.
+"""
+
+from .feature import (IdIndexer, IdIndexerModel, LinearScalarScaler,
+                      LinearScalarScalerModel, StandardScalarScaler,
+                      StandardScalarScalerModel)
+from .anomaly import (AccessAnomaly, AccessAnomalyModel,
+                      ComplementAccessTransformer)
+
+__all__ = [
+    "IdIndexer", "IdIndexerModel",
+    "StandardScalarScaler", "StandardScalarScalerModel",
+    "LinearScalarScaler", "LinearScalarScalerModel",
+    "ComplementAccessTransformer",
+    "AccessAnomaly", "AccessAnomalyModel",
+]

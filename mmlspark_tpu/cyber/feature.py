@@ -1,0 +1,181 @@
+"""Per-tenant feature engineering for access logs.
+
+Reference: src/main/python/mmlspark/cyber/feature/{indexers,scalers}.py
+(expected paths, UNVERIFIED — SURVEY.md §2.1).  The reference expresses
+these as PySpark window functions partitioned by a tenant column; here
+each fitted model is a plain per-tenant dict of numpy state, applied
+vectorized.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core import serialize
+from ..core.params import (HasInputCol, HasOutputCol, Param,
+                           Params, TypeConverters)
+from ..core.pipeline import Estimator, Model
+from ..core.schema import DataTable
+
+
+class _HasPartitionKey(Params):
+    partitionKey = Param("partitionKey",
+                         "Tenant/partition column; statistics and ids are "
+                         "computed independently per distinct value",
+                         default="tenant",
+                         typeConverter=TypeConverters.toString)
+
+    def getPartitionKey(self) -> str:
+        return self.getOrDefault("partitionKey")
+
+
+class IdIndexer(_HasPartitionKey, HasInputCol, HasOutputCol, Estimator):
+    """Maps arbitrary ids to contiguous 1-based indices PER TENANT (the
+    reference's IdIndexer: per-partition indexing feeds the per-tenant
+    latent-factor model; 0 is reserved for unseen)."""
+
+    def _fit(self, table: DataTable) -> "IdIndexerModel":
+        tenants = np.asarray(table[self.getPartitionKey()])
+        ids = np.asarray(table[self.getInputCol()])
+        mapping: Dict = {}
+        for t in np.unique(tenants):
+            vals = ids[tenants == t]
+            uniq = np.unique(vals)
+            mapping[t] = {v: i + 1 for i, v in enumerate(uniq)}
+        m = IdIndexerModel(mapping=mapping)
+        return m.setParams(**{k: v for k, v in self._iterSetParams()
+                              if m.hasParam(k)})
+
+
+class IdIndexerModel(_HasPartitionKey, HasInputCol, HasOutputCol, Model):
+    def __init__(self, mapping=None, **kwargs):
+        super().__init__(**kwargs)
+        self._mapping = mapping or {}
+
+    def vocab_size(self, tenant) -> int:
+        return len(self._mapping.get(tenant, {}))
+
+    def _transform(self, table: DataTable) -> DataTable:
+        tenants = np.asarray(table[self.getPartitionKey()])
+        ids = np.asarray(table[self.getInputCol()])
+        out = np.zeros(len(ids), np.int64)     # unseen -> 0
+        for t, m in self._mapping.items():
+            mask = tenants == t
+            out[mask] = np.asarray([m.get(v, 0) for v in ids[mask]])
+        return table.withColumns({self.getOutputCol(): out})
+
+    def _save_extra(self, path: str) -> None:
+        serialize.save_json(path, "mapping", {
+            str(t): {str(k): int(v) for k, v in m.items()}
+            for t, m in self._mapping.items()})
+        t0 = next(iter(self._mapping), None)
+        k0 = next(iter(self._mapping[t0]), None) if t0 is not None else None
+        serialize.save_json(path, "key_kinds", {
+            "tenant_is_int": bool(isinstance(t0, (int, np.integer))),
+            "id_is_int": bool(isinstance(k0, (int, np.integer)))})
+
+    def _load_extra(self, path: str) -> None:
+        raw = serialize.load_json(path, "mapping")
+        kinds = serialize.load_json(path, "key_kinds")
+        tc = int if kinds["tenant_is_int"] else str
+        ic = int if kinds["id_is_int"] else str
+        self._mapping = {tc(t): {ic(k): v for k, v in m.items()}
+                         for t, m in raw.items()}
+
+
+class _ScalerBase(_HasPartitionKey, HasInputCol, HasOutputCol, Estimator):
+    def _stats(self, table: DataTable):
+        tenants = np.asarray(table[self.getPartitionKey()])
+        x = np.asarray(table[self.getInputCol()], np.float64)
+        return tenants, x
+
+
+class StandardScalarScaler(_ScalerBase):
+    """Per-tenant z-score of a scalar column (reference
+    StandardScalarScaler)."""
+
+    useStd = Param("useStd", "Divide by the per-tenant std",
+                   default=True, typeConverter=TypeConverters.toBool)
+
+    def _fit(self, table: DataTable) -> "StandardScalarScalerModel":
+        tenants, x = self._stats(table)
+        stats = {}
+        for t in np.unique(tenants):
+            v = x[tenants == t]
+            std = float(v.std()) if self.getOrDefault("useStd") else 1.0
+            stats[t] = (float(v.mean()), std if std > 0 else 1.0)
+        m = StandardScalarScalerModel(stats=stats)
+        return m.setParams(**{k: v for k, v in self._iterSetParams()
+                              if m.hasParam(k)})
+
+
+class LinearScalarScaler(_ScalerBase):
+    """Per-tenant min-max mapping to [minRequiredValue, maxRequiredValue]
+    (reference LinearScalarScaler)."""
+
+    minRequiredValue = Param("minRequiredValue", "Target minimum",
+                             default=0.0,
+                             typeConverter=TypeConverters.toFloat)
+    maxRequiredValue = Param("maxRequiredValue", "Target maximum",
+                             default=1.0,
+                             typeConverter=TypeConverters.toFloat)
+
+    def _fit(self, table: DataTable) -> "LinearScalarScalerModel":
+        tenants, x = self._stats(table)
+        lo, hi = (self.getOrDefault("minRequiredValue"),
+                  self.getOrDefault("maxRequiredValue"))
+        stats = {}
+        for t in np.unique(tenants):
+            v = x[tenants == t]
+            vmin, vmax = float(v.min()), float(v.max())
+            span = vmax - vmin
+            # degenerate tenant (constant column) maps to the midpoint
+            scale = (hi - lo) / span if span > 0 else 0.0
+            shift = lo - vmin * scale if span > 0 else (lo + hi) / 2.0
+            stats[t] = (scale, shift)
+        m = LinearScalarScalerModel(stats=stats)
+        return m.setParams(**{k: v for k, v in self._iterSetParams()
+                              if m.hasParam(k)})
+
+
+class _ScalerModelBase(_HasPartitionKey, HasInputCol, HasOutputCol, Model):
+    def __init__(self, stats=None, **kwargs):
+        super().__init__(**kwargs)
+        self._stats = stats or {}
+
+    def _save_extra(self, path: str) -> None:
+        t0 = next(iter(self._stats), None)
+        serialize.save_json(path, "stats", {
+            str(t): list(v) for t, v in self._stats.items()})
+        serialize.save_json(path, "key_kinds", {
+            "tenant_is_int": bool(isinstance(t0, (int, np.integer)))})
+
+    def _load_extra(self, path: str) -> None:
+        raw = serialize.load_json(path, "stats")
+        tc = (int if serialize.load_json(path, "key_kinds")["tenant_is_int"]
+              else str)
+        self._stats = {tc(t): tuple(v) for t, v in raw.items()}
+
+
+class StandardScalarScalerModel(_ScalerModelBase):
+    def _transform(self, table: DataTable) -> DataTable:
+        tenants = np.asarray(table[self.getPartitionKey()])
+        x = np.asarray(table[self.getInputCol()], np.float64)
+        out = np.zeros_like(x)
+        for t, (mu, sd) in self._stats.items():
+            m = tenants == t
+            out[m] = (x[m] - mu) / sd
+        return table.withColumns({self.getOutputCol(): out})
+
+
+class LinearScalarScalerModel(_ScalerModelBase):
+    def _transform(self, table: DataTable) -> DataTable:
+        tenants = np.asarray(table[self.getPartitionKey()])
+        x = np.asarray(table[self.getInputCol()], np.float64)
+        out = np.zeros_like(x)
+        for t, (scale, shift) in self._stats.items():
+            m = tenants == t
+            out[m] = x[m] * scale + shift
+        return table.withColumns({self.getOutputCol(): out})

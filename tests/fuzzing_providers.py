@@ -867,3 +867,63 @@ def _unroll_binary_image():
     t = DataTable({"bytes": blobs})
     return [TestObject(UnrollBinaryImage(width=8, height=8),
                        transform_data=t)]
+
+
+def _cyber_access_table():
+    rng = np.random.default_rng(SEED)
+    tenants = np.repeat(np.asarray(["a", "b"]), 20)
+    users = np.asarray([f"u{rng.integers(0, 5)}" for _ in range(40)])
+    res = np.asarray([f"r{rng.integers(0, 4)}" for _ in range(40)])
+    return DataTable({"tenant": tenants, "user": users, "res": res,
+                      "v": rng.normal(size=40)})
+
+
+@fuzzing_objects("IdIndexer")
+def _cyber_id_indexer():
+    from mmlspark_tpu.cyber import IdIndexer
+    t = _cyber_access_table()
+    return [TestObject(IdIndexer(inputCol="user", outputCol="user_idx",
+                                 partitionKey="tenant"),
+                       fitting_data=t, transform_data=t,
+                       compare_cols=["user_idx"],
+                       fitted_model_cls="IdIndexerModel")]
+
+
+@fuzzing_objects("StandardScalarScaler")
+def _cyber_std_scaler():
+    from mmlspark_tpu.cyber import StandardScalarScaler
+    t = _cyber_access_table()
+    return [TestObject(StandardScalarScaler(inputCol="v", outputCol="z",
+                                            partitionKey="tenant"),
+                       fitting_data=t, transform_data=t,
+                       compare_cols=["z"],
+                       fitted_model_cls="StandardScalarScalerModel")]
+
+
+@fuzzing_objects("LinearScalarScaler")
+def _cyber_lin_scaler():
+    from mmlspark_tpu.cyber import LinearScalarScaler
+    t = _cyber_access_table()
+    return [TestObject(LinearScalarScaler(inputCol="v", outputCol="s",
+                                          partitionKey="tenant"),
+                       fitting_data=t, transform_data=t,
+                       compare_cols=["s"],
+                       fitted_model_cls="LinearScalarScalerModel")]
+
+
+@fuzzing_objects("ComplementAccessTransformer")
+def _cyber_complement():
+    from mmlspark_tpu.cyber import ComplementAccessTransformer
+    t = _cyber_access_table()
+    return [TestObject(ComplementAccessTransformer(complementsetFactor=1),
+                       transform_data=t)]
+
+
+@fuzzing_objects("AccessAnomaly")
+def _cyber_access_anomaly():
+    from mmlspark_tpu.cyber import AccessAnomaly
+    t = _cyber_access_table()
+    return [TestObject(AccessAnomaly(rankParam=4, maxIter=5),
+                       fitting_data=t, transform_data=t,
+                       compare_cols=["anomaly_score"],
+                       fitted_model_cls="AccessAnomalyModel")]

@@ -1,0 +1,58 @@
+#!/bin/bash
+# On-chip work queue for the next healthy TPU window.
+#
+# The chip lease wedges unpredictably (docs/developing.md "TPU
+# etiquette"); this script packs the round's remaining on-chip tasks
+# into one supervised sequence so even a short window is used fully.
+# Every step checkpoints its own output; if a step exceeds its budget
+# the script STOPS (a timeout on-chip means the lease is wedged again —
+# running more steps would just hang too).  Never SIGKILL mid-step by
+# hand: let timeout(1) do it and walk away.
+#
+# Usage: nohup bash tools/tpu_session.sh > /tmp/tpu_session.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+step() {
+  local budget="$1"; shift
+  echo "=== $(date -u +%H:%M:%S) [$budget s] $*" >&2
+  # -k: a process stuck in an uninterruptible device RPC ignores the
+  # first SIGTERM; without the follow-up SIGKILL, timeout itself blocks
+  # forever and every later checkpoint is lost
+  timeout -k 30 "$budget" "$@"
+  local rc=$?
+  if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+    echo "=== STEP TIMED OUT (rc=$rc) — assuming wedged lease, stopping" >&2
+    exit $rc
+  fi
+  return $rc
+}
+
+# 0. cheap health probe: if this hangs, nothing else will work
+step 180 python -c "import jax; print(jax.devices())" || exit 1
+
+# 1. official-format bench capture FIRST (VERDICT r3 #1: before anything
+#    that can wedge the lease).  ~4 min warm via the compile cache.
+step 900 bash -c 'python bench.py | tee artifacts/bench_tpu_session_1.out'
+
+# 2. re-measure the unresolved small sweep buckets with enough reps to
+#    clear the ~2-6 ms dispatch jitter (tools/sweep_histogram.py
+#    docstring arithmetic); one size per invocation so each checkpoint
+#    lands even if a later compile hangs
+step 2400 python tools/sweep_histogram.py --sizes 2048 --reps 257
+step 2400 python tools/sweep_histogram.py --sizes 4096 --reps 257
+step 2400 python tools/sweep_histogram.py --sizes 8192 --reps 129
+step 1800 python tools/sweep_histogram.py --sizes 65536 --reps 65
+step 2400 python tools/sweep_histogram.py --sizes 131072 262144 --reps 33
+
+# 3. gather-strategy micro-bench at the grower's bucket sizes: decides
+#    whether packed_gather (4 bins/u32 word) becomes the TPU default
+step 2400 python tools/bench_gather.py --sizes 2048 8192 32768 --reps 65
+
+# 4. A/B the packed gather through the real bench path
+step 900 bash -c 'python bench.py --pass-through packed_gather=true | tee artifacts/bench_tpu_session_packed.out'
+
+# 5. fresh official capture last, so the newest auto-method table and
+#    any flipped defaults are what the final number reflects
+step 900 bash -c 'python bench.py | tee artifacts/bench_tpu_session_final.out'
+echo "=== tpu_session complete $(date -u +%H:%M:%S)" >&2
