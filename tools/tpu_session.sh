@@ -52,7 +52,10 @@ step 2400 python tools/bench_gather.py --sizes 2048 8192 32768 --reps 65
 # 4. A/B the packed gather through the real bench path
 step 900 bash -c 'python bench.py --pass-through packed_gather=true | tee artifacts/bench_tpu_session_packed.out'
 
-# 5. fresh official capture last, so the newest auto-method table and
+# 5. secondary BASELINE target: ImageFeaturizer imgs/sec on-chip
+step 900 bash -c 'python tools/bench_featurizer.py | tee artifacts/bench_featurizer_tpu.out'
+
+# 6. fresh official capture last, so the newest auto-method table and
 #    any flipped defaults are what the final number reflects
 step 900 bash -c 'python bench.py | tee artifacts/bench_tpu_session_final.out'
 echo "=== tpu_session complete $(date -u +%H:%M:%S)" >&2
