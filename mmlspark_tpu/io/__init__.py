@@ -12,7 +12,9 @@ from .http import (
     JSONOutputParser,
     SimpleHTTPTransformer,
 )
-from .serving import HTTPServer, request_table, reply_from_table
+from .serving import (DistributedHTTPServer, HTTPServer,
+                      MultiprocessHTTPServer, join_exchange,
+                      request_table, reply_from_table)
 from .binary import BinaryFileReader, read_binary_files
 from .powerbi import PowerBIWriter
 
@@ -20,7 +22,8 @@ __all__ = [
     "HTTPTransformer", "PartitionConsolidator",
     "SimpleHTTPTransformer",
     "JSONInputParser", "JSONOutputParser",
-    "HTTPServer", "request_table", "reply_from_table",
+    "HTTPServer", "DistributedHTTPServer", "MultiprocessHTTPServer",
+    "join_exchange", "request_table", "reply_from_table",
     "BinaryFileReader", "read_binary_files",
     "PowerBIWriter",
 ]

@@ -19,6 +19,7 @@ instead of per-request forwards.
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import threading
 import uuid
@@ -28,6 +29,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.schema import DataTable
+
+log = logging.getLogger(__name__)
 
 
 class _Pending:
@@ -210,6 +213,21 @@ class DistributedHTTPServer:
         return self._exchange.reply(request_id, response, status)
 
 
+def join_exchange(exchange: str, worker_id: int,
+                  http_host: str = "0.0.0.0", api_path: str = "/",
+                  reply_timeout: float = 30.0) -> None:
+    """Run ONE serving worker against a remote exchange — the multi-host
+    entrypoint (each machine runs this next to its accelerator; the
+    reference's per-executor DistributedHTTPSource server,
+    SURVEY.md §3.4).  Blocks until the exchange sends ``stop`` or the
+    connection drops.  ``exchange`` is the driver's
+    ``MultiprocessHTTPServer(spawn_workers=False).exchange_address``;
+    ``worker_id`` must be the unique slot index in [0, num_workers)."""
+    host, _, port = exchange.rpartition(":")
+    _mp_worker_main(host, int(port), int(worker_id), http_host, api_path,
+                    reply_timeout)
+
+
 def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                     http_host: str, api_path: str,
                     reply_timeout: float) -> None:
@@ -283,8 +301,14 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             self.wfile.write(body)
 
     httpd = ThreadingHTTPServer((http_host, 0), Handler)
+    # a wildcard bind must not advertise 0.0.0.0: report the interface
+    # this worker reaches the exchange through — the address a client on
+    # another machine can actually dial (multi-host contract)
+    adv_host = httpd.server_address[0]
+    if adv_host in ("0.0.0.0", "", "::"):
+        adv_host = conn.getsockname()[0]
     send({"op": "hello", "worker": worker_id,
-          "host": httpd.server_address[0], "port": httpd.server_address[1]})
+          "host": adv_host, "port": httpd.server_address[1]})
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
     for line in rfile:
@@ -313,10 +337,19 @@ class MultiprocessHTTPServer:
     (SURVEY.md §3.4).  Driver-facing API is identical to
     :class:`DistributedHTTPServer` (start/stop/addresses/get_batch/
     reply), so the same micro-batch loop drives either topology.
+
+    With ``spawn_workers=False`` nothing is forked: the exchange waits
+    for ``num_workers`` REMOTE workers to dial in via
+    :func:`join_exchange` — the multi-HOST deployment, each machine
+    running one worker next to its accelerator (the reference's
+    per-executor HTTP server).  Pass ``host="0.0.0.0"`` so remote
+    workers can reach the exchange; ``exchange_address`` is the
+    ``host:port`` to hand them.
     """
 
     def __init__(self, num_workers: int = 2, host: str = "127.0.0.1",
-                 api_path: str = "/", reply_timeout: float = 30.0):
+                 api_path: str = "/", reply_timeout: float = 30.0,
+                 spawn_workers: bool = True, join_timeout: float = 20.0):
         import socket as _socket
 
         self._listener = _socket.socket()
@@ -330,15 +363,42 @@ class MultiprocessHTTPServer:
         self._wlocks: List[threading.Lock] = []
         self.addresses: List[str] = [""] * num_workers
         self._reply_timeout = reply_timeout
+        self._join_timeout = join_timeout
 
-        import multiprocessing as mp
-        ctx = mp.get_context("spawn")   # no inherited jax/thread state
-        dh, dp = self._listener.getsockname()
-        self._procs = [
-            ctx.Process(target=_mp_worker_main,
-                        args=(dh, dp, i, host, api_path, reply_timeout),
-                        daemon=True)
-            for i in range(num_workers)]
+        self._procs = []
+        if spawn_workers:
+            import multiprocessing as mp
+            ctx = mp.get_context("spawn")  # no inherited jax/thread state
+            dh, dp = self._listener.getsockname()
+            self._procs = [
+                ctx.Process(target=_mp_worker_main,
+                            args=(dh, dp, i, host, api_path,
+                                  reply_timeout),
+                            daemon=True)
+                for i in range(num_workers)]
+
+    @property
+    def exchange_address(self) -> str:
+        """``host:port`` remote workers dial via :func:`join_exchange`.
+        A wildcard bind advertises this machine's primary outbound
+        interface, not ``0.0.0.0`` — the same dial-ability rule the
+        workers follow for their own hello addresses."""
+        import socket as _socket
+        h, p = self._listener.getsockname()
+        if h in ("0.0.0.0", "", "::"):
+            probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            try:
+                # UDP connect sends nothing; it just resolves the route
+                probe.connect(("10.255.255.255", 1))
+                h = probe.getsockname()[0]
+            except OSError:
+                try:
+                    h = _socket.gethostbyname(_socket.gethostname())
+                except OSError:
+                    h = "127.0.0.1"
+            finally:
+                probe.close()
+        return f"{h}:{p}"
 
     def start(self) -> "MultiprocessHTTPServer":
         for p in self._procs:
@@ -346,32 +406,49 @@ class MultiprocessHTTPServer:
         import socket as _socket
         # a worker that dies during spawn (classic cause: the calling
         # script lacks an `if __name__ == "__main__":` guard, so spawn's
-        # re-import re-runs it) must fail FAST, not hang accept()
-        self._listener.settimeout(20.0)
-        for _ in self._procs:
+        # re-import re-runs it) must fail FAST, not hang accept();
+        # external workers get join_timeout to dial in
+        # 60 s: a loaded single-core host can take >20 s just to spawn
+        # and import N fresh worker interpreters
+        self._listener.settimeout(
+            60.0 if self._procs else self._join_timeout)
+        for _ in self.addresses:       # one connection per worker slot
             try:
                 conn, _ = self._listener.accept()
             except TimeoutError as e:
+                xaddr = self.exchange_address  # before stop() closes it
                 self.stop()
+                if self._procs:
+                    raise RuntimeError(
+                        "worker processes failed to connect; if this is "
+                        "a script, MultiprocessHTTPServer must be "
+                        "started under `if __name__ == '__main__':` "
+                        "(spawn re-imports the main module)") from e
                 raise RuntimeError(
-                    "worker processes failed to connect; if this is a "
-                    "script, MultiprocessHTTPServer must be started "
-                    "under `if __name__ == '__main__':` (spawn "
-                    "re-imports the main module)") from e
+                    f"external workers failed to join {xaddr} within "
+                    f"{self._join_timeout}s; start one "
+                    f"join_exchange(...) per worker slot") from e
             conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
             idx = len(self._conns)
             self._conns.append(conn)
             self._wlocks.append(threading.Lock())
             threading.Thread(target=self._reader, args=(idx, conn),
                              daemon=True).start()
-        # hello messages fill addresses (readers handle them)
-        deadline = 50
+        # hello messages fill addresses (readers handle them); external
+        # workers get the full join budget — a loaded host can take
+        # seconds between connect and hello
+        import time
+        deadline = (20.0 if self._procs else self._join_timeout) / 0.1
         while any(not a for a in self.addresses) and deadline:
-            import time
             time.sleep(0.1)
             deadline -= 1
         if any(not a for a in self.addresses):
-            raise RuntimeError("workers failed to report their ports")
+            missing = [i for i, a in enumerate(self.addresses) if not a]
+            self.stop()
+            raise RuntimeError(
+                f"worker slots {missing} never reported their ports "
+                f"(invalid/duplicate worker ids? each join_exchange "
+                f"needs a unique id in [0, {len(self.addresses)}))")
         return self
 
     def _reader(self, idx: int, conn) -> None:
@@ -383,8 +460,19 @@ class MultiprocessHTTPServer:
                 continue
             op = msg.get("op")
             if op == "hello":
-                self.addresses[msg["worker"]] = \
-                    f"http://{msg['host']}:{msg['port']}"
+                w = msg.get("worker")
+                if (not isinstance(w, int) or not
+                        0 <= w < len(self.addresses)):
+                    log.warning("serving: ignoring hello with invalid "
+                                "worker id %r (need 0..%d)", w,
+                                len(self.addresses) - 1)
+                    continue
+                if self.addresses[w]:
+                    log.warning("serving: duplicate hello for worker "
+                                "slot %d ignored (unique ids required)",
+                                w)
+                    continue
+                self.addresses[w] = f"http://{msg['host']}:{msg['port']}"
             elif op == "park":
                 with self._lock:
                     self._route[msg["rid"]] = idx

@@ -221,3 +221,85 @@ class TestDistributedServing:
             assert got == {"y": 4}
         finally:
             srv.stop()
+
+
+class TestExternalWorkers:
+    """Multi-host topology: the exchange spawns NOTHING; workers dial in
+    from separate processes via the public join_exchange entry — exactly
+    what a worker on another machine would run (the per-executor server
+    of the reference's DistributedHTTPSource)."""
+
+    def test_remote_join_serves_and_routes(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+
+        srv = MultiprocessHTTPServer(num_workers=2, spawn_workers=False,
+                                     join_timeout=30.0)
+        addr = srv.exchange_address
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        code = ("import sys; from mmlspark_tpu.io.serving import "
+                "join_exchange; "
+                "join_exchange(sys.argv[1], int(sys.argv[2]))")
+        procs = [subprocess.Popen([sys.executable, "-c", code, addr,
+                                   str(i)], env=env)
+                 for i in range(2)]
+        try:
+            srv.start()
+            assert all(a and "0.0.0.0" not in a for a in srv.addresses)
+
+            def pump():
+                served = 0
+                while served < 2:
+                    for rid, payload in srv.get_batch(timeout=0.2):
+                        srv.reply(rid, {"echo": payload["x"] * 10})
+                        served += 1
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            out0 = _post(srv.addresses[0], {"x": 3})
+            out1 = _post(srv.addresses[1], {"x": 5})
+            assert out0 == {"echo": 30} and out1 == {"echo": 50}
+            t.join(timeout=10)
+        finally:
+            srv.stop()
+            for p in procs:
+                p.wait(timeout=15)
+
+    def test_join_timeout_fails_fast(self):
+        srv = MultiprocessHTTPServer(num_workers=1, spawn_workers=False,
+                                     join_timeout=1.0)
+        with pytest.raises(RuntimeError, match="join"):
+            srv.start()
+
+    def test_exchange_address_never_wildcard(self):
+        srv = MultiprocessHTTPServer(num_workers=1, host="0.0.0.0",
+                                     spawn_workers=False, join_timeout=1.0)
+        try:
+            assert not srv.exchange_address.startswith("0.0.0.0")
+            assert not srv.exchange_address.startswith(":")
+        finally:
+            srv.stop()
+
+    def test_invalid_worker_id_named_in_error(self):
+        import os
+        import subprocess
+        import sys
+        srv = MultiprocessHTTPServer(num_workers=1, spawn_workers=False,
+                                     join_timeout=6.0)
+        h, _, p = srv.exchange_address.rpartition(":")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        code = ("import sys; from mmlspark_tpu.io.serving import "
+                "join_exchange; join_exchange(sys.argv[1], 7)")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code, f"127.0.0.1:{p}"], env=env)
+        try:
+            with pytest.raises(RuntimeError, match="unique id"):
+                srv.start()
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
