@@ -38,7 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.mesh import DATA_AXIS, FEATURE_AXIS
 from .grower import (GrowerConfig, TreeArrays, _grow_tree_impl,
-                     apply_shrinkage, predict_tree_binned)
+                     apply_shrinkage, predict_tree_binned,
+                     predict_tree_binned_fshard)
 from .objectives import Objective
 
 
@@ -90,6 +91,16 @@ def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
     cfg = _sharded_cfg(mesh, cfg)
     K = num_class
 
+    def tree_pred(tree, b):
+        # train-side score update: with a feature axis each shard holds a
+        # column slice, so the walk assembles compare vectors by psum;
+        # validation bins stay full-feature per shard (host-small) and
+        # keep the local walk
+        if cfg.feature_axis_name is not None:
+            return predict_tree_binned_fshard(tree, b, cfg.num_leaves,
+                                              cfg.feature_axis_name)
+        return predict_tree_binned(tree, b, cfg.num_leaves)
+
     def steps(bins, scores, labels, weights, real, keys, fis,
               val_bins, val_scores):
         def body(carry, xs):
@@ -119,8 +130,7 @@ def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
                                 jnp.take(h, idx) * amp_vec,
                                 valid], axis=1)
                 tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
-                scores = scores + lr * predict_tree_binned(
-                    tree, bins, cfg.num_leaves)
+                scores = scores + lr * tree_pred(tree, bins)
                 trees = apply_shrinkage(tree, lr)
                 if has_val:
                     val_scores = val_scores + predict_tree_binned(
@@ -133,8 +143,7 @@ def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
                                     valid], axis=1)
                     tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
                     scores = scores.at[:, k].add(
-                        lr * predict_tree_binned(tree, bins,
-                                                 cfg.num_leaves))
+                        lr * tree_pred(tree, bins))
                     tree = apply_shrinkage(tree, lr)
                     if has_val:
                         val_scores = val_scores.at[:, k].add(
@@ -345,14 +354,16 @@ def make_ranking_dart_step(mesh: Mesh, cfg: GrowerConfig, lr: float,
 
 def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
                    lr: float, num_class: int = 1):
-    """One dart iteration over a data-only mesh: fit a tree to the gradient
-    at the dropped-out score vector ``s_minus`` (histogram psums over the
-    ``data`` axis inside the grower), returning the replicated lr-shrunk
-    tree and its data-sharded base contribution.  The host applies the
-    1/(k+1) dart normalization and tracks per-tree scales, exactly like
-    the serial path — dropout bookkeeping is tiny host metadata, only the
-    fit and the scoring ride the mesh."""
+    """One dart iteration over the mesh: fit a tree to the gradient at
+    the dropped-out score vector ``s_minus`` (histogram psums over the
+    ``data`` axis — and, on a 2-D mesh, feature-parallel split search —
+    inside the grower), returning the replicated lr-shrunk tree and its
+    data-sharded base contribution.  The host applies the 1/(k+1) dart
+    normalization and tracks per-tree scales, exactly like the serial
+    path — dropout bookkeeping is tiny host metadata, only the fit and
+    the scoring ride the mesh."""
     cfg = _sharded_cfg(mesh, cfg)
+    fshard = int(mesh.shape[FEATURE_AXIS]) > 1
     K = num_class
 
     def step(bins, binsT, s_minus, labels, weights, bag, fi):
@@ -376,35 +387,52 @@ def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
         return trees, jnp.stack(bnews, axis=1)
 
     sc_spec = P(DATA_AXIS) if K == 1 else P(DATA_AXIS, None)
+    bins_spec = (P(DATA_AXIS, FEATURE_AXIS) if fshard
+                 else P(DATA_AXIS, None))
+    binsT_spec = (P(FEATURE_AXIS, DATA_AXIS) if fshard
+                  else P(None, DATA_AXIS))
+    fi_spec = P(FEATURE_AXIS, None) if fshard else P(None, None)
     mapped = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), sc_spec,
+        in_specs=(bins_spec, binsT_spec, sc_spec,
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                  P(None, None)),
+                  fi_spec),
         out_specs=(P(), sc_spec),
         check_vma=False)
     return jax.jit(mapped)
 
 
 def make_tree_predict(mesh: Mesh, num_leaves: int, num_class: int = 1):
-    """Replicated-tree scoring of data-sharded binned rows (each shard
-    holds ALL features of its rows) — dart's dropped-tree subtraction and
-    validation scoring under a data mesh.  ``num_class > 1`` scores one
-    dart iteration's K stacked trees to (n, K)."""
+    """Replicated-tree scoring of mesh-sharded binned rows — dart's
+    dropped-tree subtraction and validation scoring.  Data-only mesh:
+    each shard walks its rows with all features local.  With a feature
+    axis, the walk assembles each level's compare vector by psum
+    (grower.predict_tree_binned_fshard — the scoring analog of the
+    feature-parallel split-column broadcast).  ``num_class > 1`` scores
+    one dart iteration's K stacked trees to (n, K)."""
+    fshard = int(mesh.shape[FEATURE_AXIS]) > 1
+    if fshard:
+        def walk(tree, bins):
+            return predict_tree_binned_fshard(tree, bins, num_leaves,
+                                              FEATURE_AXIS)
+        bins_spec = P(DATA_AXIS, FEATURE_AXIS)
+    else:
+        def walk(tree, bins):
+            return predict_tree_binned(tree, bins, num_leaves)
+        bins_spec = P(DATA_AXIS, None)
+
     if num_class == 1:
         def pred(tree, bins):
-            return predict_tree_binned(tree, bins, num_leaves)
+            return walk(tree, bins)
         out_spec = P(DATA_AXIS)
     else:
         def pred(trees_st, bins):
-            return jax.vmap(
-                lambda t: predict_tree_binned(t, bins, num_leaves)
-            )(trees_st).T
+            return jax.vmap(lambda t: walk(t, bins))(trees_st).T
         out_spec = P(DATA_AXIS, None)
 
     mapped = jax.shard_map(
         pred, mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS, None)),
+        in_specs=(P(), bins_spec),
         out_specs=out_spec,
         check_vma=False)
     return jax.jit(mapped)

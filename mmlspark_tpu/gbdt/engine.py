@@ -708,12 +708,6 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 "custom gradient overrides are not supported with a "
                 "mesh (only lambdarank, which provides ranking_info)")
         if use_dart:
-            from ..core.mesh import FEATURE_AXIS as _FAX
-            if int(mesh.shape[_FAX]) > 1:
-                raise NotImplementedError(
-                    "boostingType='dart' requires a data-only mesh (the "
-                    "dropped-tree score update reads whole feature rows); "
-                    "use parallelism='data' / feature=1")
             return _train_distributed_dart(
                 bins, labels, w, mapper, objective, params, cfg, mesh,
                 feature_names, init, rng, bag_rng, init_scores,
@@ -1136,7 +1130,7 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
     ``shard_data`` path: validation/early stopping (the validation set is
     assumed host-small and arrives monolithic), per-machine bagging,
     callbacks (non-ranking), per-shard init scores (non-ranking), goss,
-    rf, dart (data-only mesh) and lambdarank (each query pinned to the
+    rf, dart (any mesh layout) and lambdarank (each query pinned to the
     shard holding its rows — ranking.shard_queries_from_shards).  Still
     gated: dart×ranking (the dart host loop keeps full prediction rows),
     callbacks/init-scores×ranking, and custom gradient overrides.
@@ -1156,11 +1150,6 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
             "boostingType='dart' with a ranking objective requires "
             "monolithic arrays (the dart host loop keeps full "
             "prediction rows)")
-    if params.boosting == "dart" and int(mesh.shape["feature"]) > 1:
-        raise NotImplementedError(
-            "boostingType='dart' requires a data-only mesh (the "
-            "dropped-tree score update reads whole feature rows); use "
-            "parallelism='data' / feature=1")
     if any(b is None for b in bins_shards):
         # multi-controller: each controller passes None for slots other
         # hosts own; shard_rows (tiny global metadata) sizes them, and
@@ -1637,7 +1626,8 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
                             init_scores, val_bins=None, val_labels=None,
                             val_weights=None, val_metric=None,
                             callbacks=None, shard_data=None) -> Booster:
-    """Dart boosting over a data-only mesh.
+    """Dart boosting over the mesh (any layout: the feature-sharded
+    score update walks trees via per-level psum).
 
     Dropout bookkeeping (which trees drop, per-tree scales) is host-side
     RNG over scalars — identical to the serial dart path, so a mesh run
@@ -1788,11 +1778,6 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     use_rf_m = params.boosting == "rf"
     has_val = val_bins is not None and val_metric is not None
     if use_goss_m:
-        if int(mesh.shape[FEATURE_AXIS]) > 1:
-            raise NotImplementedError(
-                "boostingType='goss' requires a data-only mesh (the "
-                "sampled-tree score update reads whole feature rows); "
-                "use parallelism='data' / feature=1")
         dn_pre = int(mesh.shape[DATA_AXIS])
         if shard_data is not None:
             # k1/k2 are SPMD trace constants shared by every shard; size

@@ -950,25 +950,26 @@ def apply_shrinkage(tree: TreeArrays, learning_rate: float) -> TreeArrays:
         node_value=tree.node_value * learning_rate)
 
 
-@functools.partial(jax.jit, static_argnames=("max_steps",))
-def predict_tree_binned(tree: TreeArrays, bins: jnp.ndarray,
-                        max_steps: int) -> jnp.ndarray:
-    """Score binned rows through one tree (used for validation sets).
+def _tree_walk(tree: TreeArrays, n: int, max_steps: int, get_val):
+    """Shared depth-bounded tree walk: ``get_val(safe_node)`` supplies
+    each row's current split-column bin (local gather, or a psum-
+    assembled feature-sharded gather); everything else — threshold and
+    categorical-bitset compares, next-node selection, the early-exit
+    while_loop, leaf extraction — lives HERE once, so the local and
+    feature-sharded walks cannot drift apart (their parity is
+    test-pinned).
 
-    A ``while_loop`` stops as soon as every row reached a leaf, so the
-    walk costs O(actual tree depth) iterations — typically ~log2(L) — with
-    ``max_steps`` (= num_leaves, the worst-case chain) only as the safety
-    fuel.  (VERDICT r2 weak #7: the fixed O(L) walk hurt at
+    The ``while_loop`` stops as soon as every row reached a leaf, so the
+    walk costs O(actual tree depth) iterations — typically ~log2(L) —
+    with ``max_steps`` (= num_leaves, the worst-case chain) only as the
+    safety fuel.  (VERDICT r2 weak #7: the fixed O(L) walk hurt at
     numLeaves=255-class configs.)"""
-    n = bins.shape[0]
 
     def step(node):
         is_leaf = node < 0
         safe = jnp.maximum(node, 0)
-        feat = tree.node_feat[safe]
+        val = get_val(safe)
         thr = tree.node_bin[safe]
-        val = jnp.take_along_axis(
-            bins, feat[:, None], axis=1)[:, 0]
         go_left = val <= thr
         # categorical nodes: left iff the row's bin is in the subset bitset
         words = jnp.take_along_axis(tree.node_cat_bits[safe],
@@ -994,3 +995,47 @@ def predict_tree_binned(tree: TreeArrays, bins: jnp.ndarray,
         cond, body, (start, jnp.asarray(max_steps, jnp.int32)))
     leaf = -(node + 1)
     return tree.leaf_value[leaf]
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def predict_tree_binned(tree: TreeArrays, bins: jnp.ndarray,
+                        max_steps: int) -> jnp.ndarray:
+    """Score binned rows through one tree (validation sets, dart/goss
+    score updates); all features local.  See :func:`_tree_walk`."""
+
+    def get_val(safe):
+        feat = tree.node_feat[safe]
+        return jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
+
+    return _tree_walk(tree, bins.shape[0], max_steps, get_val)
+
+
+def predict_tree_binned_fshard(tree: TreeArrays, bins_local: jnp.ndarray,
+                               max_steps: int,
+                               axis_name: str) -> jnp.ndarray:
+    """:func:`predict_tree_binned` with FEATURES sharded over
+    ``axis_name`` (every shard holds all rows of its feature slice).
+
+    Per walk step, the shard owning each row's current split column
+    contributes that row's bin and one ``psum`` assembles the compare
+    vector — the scoring-side analog of the grower's feature-parallel
+    split-column broadcast (grower.py split_step).  The loop trip count
+    is identical on every shard of the feature axis (they walk the same
+    rows through the same replicated tree), so the in-loop collective is
+    SPMD-safe; cost is one (n,) psum per tree level.
+    """
+    n, f_local = bins_local.shape
+    shard = jax.lax.axis_index(axis_name)
+
+    def get_val(safe):
+        feat = tree.node_feat[safe]                 # GLOBAL feature ids
+        owner = feat // f_local
+        lidx = jnp.minimum(feat - owner * f_local, f_local - 1)
+        val_local = jnp.where(
+            owner == shard,
+            jnp.take_along_axis(bins_local, lidx[:, None],
+                                axis=1)[:, 0].astype(jnp.int32),
+            0)
+        return jax.lax.psum(val_local, axis_name)
+
+    return _tree_walk(tree, n, max_steps, get_val)

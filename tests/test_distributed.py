@@ -434,11 +434,14 @@ class TestMeshModeMatrix:
                             np.asarray(out["probability"])[:, 1])
         assert auc > 0.9
 
-    def test_mesh_dart_requires_data_only_mesh(self, mode_table):
-        with pytest.raises(NotImplementedError, match="data-only"):
-            LightGBMClassifier(boostingType="dart", numIterations=2,
-                               numLeaves=5).setMesh(
-                build_mesh(data=4, feature=2)).fit(mode_table)
+    def test_mesh_dart_trains_on_2d_mesh(self, mode_table):
+        # the data-only restriction fell: the dropped-tree score update
+        # walks feature-sharded rows via per-level psum (see
+        # tests/test_dart_rf.py::TestFeatureMeshDartGoss for parity)
+        m = LightGBMClassifier(boostingType="dart", numIterations=2,
+                               numLeaves=5, verbosity=0).setMesh(
+            build_mesh(data=4, feature=2)).fit(mode_table)
+        assert len(m.getModel().trees) == 2
 
     def test_mesh_callbacks_replayed_per_iteration(self, mode_table):
         """Callbacks fire once per global iteration with the flat list of

@@ -16,12 +16,9 @@ from mmlspark_tpu.gbdt.objectives import get_objective
 
 BOOSTING = ["gbdt", "goss", "dart", "rf"]
 
-#: the ONLY remaining gate: sharded ingestion x ranking (query packing
-#: needs a global sort; documented in docs/lightgbm.md)
-GATED = {("lambdarank", "sharded", "gbdt"),
-         ("lambdarank", "sharded", "goss"),
-         ("lambdarank", "sharded", "dart"),
-         ("lambdarank", "sharded", "rf")}
+#: the ONLY remaining gate: dart x ranking x sharded (the dart host
+#: loop keeps full prediction rows; documented in docs/lightgbm.md)
+GATED = {("lambdarank", "sharded", "dart")}
 
 
 def _tables():
@@ -70,16 +67,24 @@ def test_matrix_cell(objective, boosting, deploy):
                              **({"bagging_fraction": 0.6,
                                  "bagging_freq": 1}
                                 if boosting == "rf" else {}))
-        obj_name = ("multiclass" if objective == "multiclass"
-                    else "binary")   # ranking is gated before objectives
+        if objective == "lambdarank":
+            # shards must hold WHOLE queries (group-contiguous
+            # ingestion); 40 queries of 8 rows -> 5 queries per shard
+            splits = [np.nonzero(np.isin(Q_ALL, np.arange(d, 40, 8)))[0]
+                      for d in range(8)]
+            rinfo = {"query_ids": [Q_ALL[i] for i in splits],
+                     "sigma": 1.0, "truncation_level": 30}
+            obj = get_objective("lambdarank")
+        else:
+            rinfo = None
+            obj = (get_objective("multiclass", num_class=3)
+                   if objective == "multiclass"
+                   else get_objective("binary"))
         run = lambda: train(  # noqa: E731
             [mapper.transform_packed(X_ALL[i]) for i in splits],
-            [y[i] for i in splits], None, mapper,
-            get_objective(obj_name, num_class=3)
-            if obj_name == "multiclass" else get_objective(obj_name),
+            [y[i] for i in splits], None, mapper, obj,
             params, mesh=build_mesh(data=8, feature=1),
-            grad_fn_override=(lambda s: (s, s))
-            if objective == "lambdarank" else None)
+            ranking_info=rinfo)
     else:
         est = _estimator(objective, boosting)
         if deploy == "mesh":
