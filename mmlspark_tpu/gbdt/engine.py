@@ -725,6 +725,10 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     # bundle's encoded width is capped at num_total_bins).  GOSS/dart
     # score through predict_tree_binned on the TRAINING matrix, whose
     # node_feat ids are original features, so they stay unbundled.
+    # (Remediation when needed: grower._tree_walk takes a pluggable
+    # value gather — an EFB-aware get_val is efb_feature_column's
+    # per-row form; wire it through the goss scan and dart step like
+    # predict_tree_binned_fshard was.)
     efb_dev = None
     bins_host_final = bins
     if params.enable_bundle and not mapper.has_categorical \
