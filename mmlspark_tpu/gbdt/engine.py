@@ -24,7 +24,9 @@ from ..core import debug as _debug
 from .binning import BinMapper, fit_bin_mapper
 from .booster import Booster, HostTree, host_tree_from_arrays
 from .grower import (EFBArrays, GrowerConfig, TreeArrays, apply_shrinkage,
-                     grow_tree, predict_tree_binned, _grow_tree_impl)
+                     grow_tree, predict_tree_binned,
+                     predict_tree_binned_any, predict_tree_binned_efb,
+                     _grow_tree_impl)
 from .objectives import Objective, MulticlassObjective
 
 log = logging.getLogger("mmlspark_tpu.gbdt")
@@ -271,7 +273,8 @@ def _dart_host_loop(T, K, dart_rng, params, scores, bag_draw, fi_draw,
 
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr", "K"))
 def _dart_step(bins, binsT, s_minus, labels, weights, bag, fi,
-               obj: Objective, cfg: GrowerConfig, lr: float, K: int = 1):
+               obj: Objective, cfg: GrowerConfig, lr: float, K: int = 1,
+               efb=None):
     """One dart iteration body: fit tree(s) to the gradient at the
     dropped-out score vector; returns the lr-shrunk tree(s) and the base
     contribution (the host applies the 1/(k+1) dart normalization).
@@ -284,13 +287,15 @@ def _dart_step(bins, binsT, s_minus, labels, weights, bag, fi,
     g, h = obj.grad_hess(s_minus, labels, weights)
     if K == 1:
         gh = jnp.stack([g * bag, h * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb,
+                                         binsT=binsT)
         tree = apply_shrinkage(tree, lr)
         return tree, tree.leaf_value[row_leaf]
     trees_k, bnews = [], []
     for k in range(K):
         gh = jnp.stack([g[:, k] * bag, h[:, k] * bag, bag], axis=1)
-        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, binsT=binsT)
+        tree, row_leaf = _grow_tree_impl(bins, gh, fi, cfg, efb,
+                                         binsT=binsT)
         tree = apply_shrinkage(tree, lr)
         trees_k.append(tree)
         bnews.append(tree.leaf_value[row_leaf])
@@ -298,10 +303,17 @@ def _dart_step(bins, binsT, s_minus, labels, weights, bag, fi,
     return trees, jnp.stack(bnews, axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("L",))
-def _dart_iter_margin(trees_st, bins, L: int):
-    """(n, K) margins of one dart iteration's K stacked trees."""
-    return jax.vmap(lambda t: predict_tree_binned(t, bins, L))(trees_st).T
+@functools.partial(jax.jit, static_argnames=("L", "num_bins"))
+def _dart_iter_margin(trees_st, bins, L: int, efb=None,
+                      num_bins: int = 256):
+    """(n, K) margins of one dart iteration's K stacked trees (``efb``:
+    bins hold bundle columns; the walk decodes per level)."""
+    if efb is None:
+        return jax.vmap(
+            lambda t: predict_tree_binned(t, bins, L))(trees_st).T
+    return jax.vmap(
+        lambda t: predict_tree_binned_efb(t, bins, L, efb, num_bins)
+    )(trees_st).T
 
 
 @functools.partial(jax.jit,
@@ -311,7 +323,7 @@ def _dart_iter_margin(trees_st, bins, L: int):
 def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
                      val_bins, val_scores, obj: Objective, cfg: GrowerConfig,
                      lr: float, k1: int, k2: int, amp: float, has_val: bool,
-                     K: int = 1):
+                     K: int = 1, efb=None):
     """GOSS chunk: each iteration grows its tree on the top-|g·h| rows plus
     an amplified random sample of the rest (Ke et al. 2017; LightGBM
     boosting=goss).  Histogram work shrinks to ``(topRate + otherRate)·n``
@@ -326,6 +338,13 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
     # iteration, and the argsort pushes NaN rows to the sample's tail —
     # so both invariants must look at the unsampled inputs here
     _debug.check_bins_in_range(bins, cfg.num_bins)
+
+    def train_pred(tree):
+        # scores update walks the TRAINING matrix; under EFB it holds
+        # bundle columns, so the walk decodes per level (validation
+        # matrices are never bundled and keep the plain walk)
+        return predict_tree_binned_any(tree, bins, cfg.num_leaves,
+                                       efb, cfg.num_bins)
 
     def body(carry, xs):
         scores, val_scores = carry
@@ -348,9 +367,8 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
             gh = jnp.stack([jnp.take(g, idx) * amp_vec,
                             jnp.take(h, idx) * amp_vec,
                             jnp.ones(k1 + k2, jnp.float32)], axis=1)
-            tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
-            scores = scores + lr * predict_tree_binned(tree, bins,
-                                                       cfg.num_leaves)
+            tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg, efb)
+            scores = scores + lr * train_pred(tree)
             trees = apply_shrinkage(tree, lr)
             if has_val:
                 val_scores = val_scores + predict_tree_binned(
@@ -361,9 +379,8 @@ def _boost_scan_goss(bins, scores, labels, weights, keys, fi_stack,
                 gh = jnp.stack([jnp.take(g[:, k], idx) * amp_vec,
                                 jnp.take(h[:, k], idx) * amp_vec,
                                 jnp.ones(k1 + k2, jnp.float32)], axis=1)
-                tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg)
-                scores = scores.at[:, k].add(
-                    lr * predict_tree_binned(tree, bins, cfg.num_leaves))
+                tree, _ = _grow_tree_impl(bins_g, gh, fi, cfg, efb)
+                scores = scores.at[:, k].add(lr * train_pred(tree))
                 tree = apply_shrinkage(tree, lr)
                 if has_val:
                     val_scores = val_scores.at[:, k].add(
@@ -722,18 +739,15 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             callbacks=callbacks)
 
     # Exclusive Feature Bundling (serial paths; uint8 bins only — a
-    # bundle's encoded width is capped at num_total_bins).  GOSS/dart
-    # score through predict_tree_binned on the TRAINING matrix, whose
-    # node_feat ids are original features, so they stay unbundled.
-    # (Remediation when needed: grower._tree_walk takes a pluggable
-    # value gather — an EFB-aware get_val is efb_feature_column's
-    # per-row form; wire it through the goss scan and dart step like
-    # predict_tree_binned_fshard was.)
+    # bundle's encoded width is capped at num_total_bins).  goss/dart
+    # score the bundled TRAINING matrix through the EFB-aware walk
+    # (predict_tree_binned_efb decodes each level's bundle column back
+    # to the node's original feature); the ranking host loop
+    # (grad_fn_override) stays unbundled.
     efb_dev = None
     bins_host_final = bins
     if params.enable_bundle and not mapper.has_categorical \
-            and mapper.num_total_bins <= 256 \
-            and not use_goss and not use_dart and grad_fn_override is None:
+            and mapper.num_total_bins <= 256 and grad_fn_override is None:
         efb_dev, efb_host, bundled = _build_efb(bins, mapper, params, f)
         if efb_dev is not None:
             bins_host_final = bundled
@@ -882,19 +896,23 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         dart_rng = np.random.default_rng(params.drop_seed)
         run_dart = _debug.checked(functools.partial(
             _dart_step, obj=objective, cfg=cfg, lr=params.learning_rate,
-            K=K))
+            K=K, efb=efb_dev))
         run_grow_dart = _debug.checked(functools.partial(grow_tree,
                                                          cfg=cfg))
         binsT_d = jnp.transpose(bins_d)   # fit-invariant, once per fit
         L_steps = params.num_leaves
 
-        def unit_margin(unit, b):
+        def unit_margin(unit, b, efb=None):
             """One dart unit's contribution: a tree (K=1) or the stacked
             K class trees of one iteration (dart drops whole iterations,
-            as LightGBM does)."""
+            as LightGBM does).  ``efb`` must match THE MATRIX ``b``: the
+            training matrix is bundled under EFB, the validation matrix
+            never is — callers pass efb_dev only with bins_d."""
             if K == 1:
-                return predict_tree_binned(unit, b, L_steps)
-            return _dart_iter_margin(unit, b, L_steps)
+                return predict_tree_binned_any(unit, b, L_steps, efb,
+                                               cfg.num_bins)
+            return _dart_iter_margin(unit, b, L_steps, efb=efb,
+                                     num_bins=cfg.num_bins)
 
         bag_state = {"cur": np.ones(n, np.float32)}
 
@@ -948,7 +966,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         units_ref: List[TreeArrays] = []
         units, trees_list, scales, scores = _dart_host_loop(
             T, K, dart_rng, params, scores, bag_draw, fi_draw, grow_unit,
-            lambda u: unit_margin(u, bins_d), callbacks,
+            lambda u: unit_margin(u, bins_d, efb_dev), callbacks,
             val_hook=val_hook if has_val else None, units_out=units_ref)
         if trees_list:
             trees_chunks = [jax.tree_util.tree_map(
@@ -965,7 +983,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             run_goss = _debug.checked(functools.partial(
                 _boost_scan_goss, obj=objective, cfg=cfg,
                 lr=params.learning_rate, k1=k1, k2=k2, amp=goss_amp,
-                has_val=has_val, K=K))
+                has_val=has_val, K=K, efb=efb_dev))
         if K > 1:
             run_multi = _debug.checked(functools.partial(
                 _boost_scan_multi, obj=objective, cfg=cfg,

@@ -1010,6 +1010,43 @@ def predict_tree_binned(tree: TreeArrays, bins: jnp.ndarray,
     return _tree_walk(tree, bins.shape[0], max_steps, get_val)
 
 
+@functools.partial(jax.jit, static_argnames=("max_steps", "num_bins"))
+def predict_tree_binned_efb(tree: TreeArrays, bins_b: jnp.ndarray,
+                            max_steps: int, efb: EFBArrays,
+                            num_bins: int) -> jnp.ndarray:
+    """:func:`predict_tree_binned` over an EFB-BUNDLED matrix: node ids
+    are ORIGINAL features, so each walk level decodes the row's bundle
+    column back to the feature's bin (the per-row form of
+    :func:`efb_feature_column`) before the compare — the piece that let
+    goss/dart score on the bundled training matrix."""
+
+    def get_val(safe):
+        feat = tree.node_feat[safe]
+        bcol = jnp.take_along_axis(
+            bins_b, efb.bundle_of[feat][:, None],
+            axis=1)[:, 0].astype(jnp.int32)
+        off = efb.off_of[feat]
+        nb = efb.nb_of[feat]
+        raw = bcol - off
+        inr = (raw >= 0) & (raw <= nb)
+        return jnp.where(inr, jnp.where(raw == nb, num_bins - 1, raw),
+                         efb.default_of[feat])
+
+    return _tree_walk(tree, bins_b.shape[0], max_steps, get_val)
+
+
+def predict_tree_binned_any(tree: TreeArrays, bins: jnp.ndarray,
+                            max_steps: int, efb=None,
+                            num_bins: int = 256) -> jnp.ndarray:
+    """One call site for 'walk this matrix': plain per-feature bins when
+    ``efb`` is None, EFB bundle decode otherwise.  Callers must pass the
+    efb that matches THE MATRIX BEING WALKED — training matrices are
+    bundled under EFB, validation matrices never are."""
+    if efb is None:
+        return predict_tree_binned(tree, bins, max_steps)
+    return predict_tree_binned_efb(tree, bins, max_steps, efb, num_bins)
+
+
 def predict_tree_binned_fshard(tree: TreeArrays, bins_local: jnp.ndarray,
                                max_steps: int,
                                axis_name: str) -> jnp.ndarray:

@@ -139,13 +139,63 @@ class TestTrainingParity:
         )[:, 1])
         assert auc_on > auc_off - 0.02, (auc_on, auc_off)
 
-    def test_goss_silently_skips_bundling(self, rng):
+    def test_goss_bundled_parity(self, rng):
+        """goss now trains ON the bundled matrix (the EFB-aware walk
+        decodes score updates per level) — parity with unbundled goss to
+        the same float contract as plain gbdt."""
         X, y = _sparse_table(rng)
-        m = LightGBMClassifier(enableBundle=True, boostingType="goss",
-                               numIterations=5, numLeaves=7, verbosity=0,
-                               parallelism="serial").fit(
-            {"features": X, "label": y})
-        assert m is not None
+        t = {"features": X, "label": y}
+        kw = dict(numIterations=10, numLeaves=15, verbosity=0,
+                  parallelism="serial", minDataInLeaf=5,
+                  boostingType="goss")
+        p_off = np.asarray(LightGBMClassifier(**kw).fit(t)
+                           .transform(t)["probability"])[:, 1]
+        p_on = np.asarray(LightGBMClassifier(enableBundle=True, **kw)
+                          .fit(t).transform(t)["probability"])[:, 1]
+        assert np.median(np.abs(p_on - p_off)) < 1e-5
+        assert np.quantile(np.abs(p_on - p_off), 0.99) < 0.05
+
+    def test_dart_bundled_parity(self, rng):
+        X, y = _sparse_table(rng)
+        t = {"features": X, "label": y}
+        kw = dict(numIterations=8, numLeaves=7, verbosity=0,
+                  parallelism="serial", minDataInLeaf=5,
+                  boostingType="dart", dropRate=0.5)
+        p_off = np.asarray(LightGBMClassifier(**kw).fit(t)
+                           .transform(t)["probability"])[:, 1]
+        p_on = np.asarray(LightGBMClassifier(enableBundle=True, **kw)
+                          .fit(t).transform(t)["probability"])[:, 1]
+        assert np.median(np.abs(p_on - p_off)) < 1e-5
+        assert np.quantile(np.abs(p_on - p_off), 0.99) < 0.05
+
+    def test_dart_bundled_validation_metrics_sane(self, rng):
+        """dart + EFB + a validation set: the val matrix is NEVER
+        bundled, so its margins must come from the plain walk — the
+        regression this pins corrupted validation margins silently
+        (efb decode applied to per-feature val columns)."""
+        X, y = _sparse_table(rng)
+        val = np.zeros(len(y), bool)
+        val[rng.choice(len(y), len(y) // 5, replace=False)] = True
+        t = {"features": X, "label": y, "is_val": val.astype(float)}
+        kw = dict(numIterations=6, numLeaves=7, verbosity=0,
+                  parallelism="serial", minDataInLeaf=5,
+                  boostingType="dart", dropRate=0.5,
+                  validationIndicatorCol="is_val")
+        m_off = LightGBMClassifier(**kw).fit(t)
+        m_on = LightGBMClassifier(enableBundle=True, **kw).fit(t)
+        p_off = np.asarray(m_off.transform(t)["probability"])[:, 1]
+        p_on = np.asarray(m_on.transform(t)["probability"])[:, 1]
+        assert np.median(np.abs(p_on - p_off)) < 1e-5
+
+    def test_dart_multiclass_bundled_trains(self, rng):
+        X, y = _sparse_table(rng)
+        y3 = (np.abs(X[:, -1]) * 2 + (X[:, 0] > 0)).astype(np.int64) % 3
+        t = {"features": X, "label": y3.astype(np.float64)}
+        m = LightGBMClassifier(enableBundle=True, boostingType="dart",
+                               objective="multiclass", numIterations=4,
+                               numLeaves=7, verbosity=0,
+                               parallelism="serial").fit(t)
+        assert len(m.getModel().trees) == 12
 
 
 class TestMeshEFB:
