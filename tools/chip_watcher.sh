@@ -1,0 +1,34 @@
+#!/bin/bash
+# Chip-recovery watcher (docs/developing.md "spontaneous wedge" protocol).
+#
+# Keeps exactly ONE untimed probe waiting on the TPU claim — a hung
+# claim resolves by itself when the stale lease expires, and killing a
+# waiter (SIGTERM via timeout(1)) is what wedges it further, so the
+# probe is simply awaited however long it takes.  A probe that *fails
+# fast* (tunnel refused, import error) retries on a 10-minute cadence.
+# The moment a probe succeeds, the queued on-chip session
+# (tools/tpu_session.sh) launches once and the watcher exits.
+#
+# Usage: nohup bash tools/chip_watcher.sh > /tmp/chip_watcher.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+while true; do
+  echo "=== $(date -u +%H:%M:%S) probing chip (untimed wait)" >&2
+  if python - <<'EOF'
+import jax
+ds = jax.devices()
+assert any(d.platform == "tpu" for d in ds), ds
+print("probe ok:", ds)
+EOF
+  then
+    echo "=== $(date -u +%H:%M:%S) chip answered — launching tpu_session in 90s" >&2
+    # Let the probe's lease release before the session claims (lazy release).
+    sleep 90
+    bash tools/tpu_session.sh
+    echo "=== $(date -u +%H:%M:%S) tpu_session finished (rc=$?)" >&2
+    exit 0
+  fi
+  echo "=== $(date -u +%H:%M:%S) probe failed fast; sleeping 10 min" >&2
+  sleep 600
+done
