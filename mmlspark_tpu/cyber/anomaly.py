@@ -78,9 +78,27 @@ class ComplementAccessTransformer(_HasAccessCols, Transformer):
             total = len(uu) * len(ur)
             want = min(factor * int(m.sum()), max(total - len(seen), 0))
             got = 0
-            # rejection-sample the sparse complement; dense grids are
-            # small at this component's scale so the loop terminates fast
+            # rejection-sample the sparse complement while acceptance is
+            # likely; once the remaining complement gets small relative
+            # to the ask (near-dense grid — acceptance probability
+            # approaches 0 and the loop would spin unboundedly,
+            # ADVICE r4), enumerate the leftover cells and draw without
+            # replacement instead
             while got < want:
+                remaining = total - len(seen)
+                if (want - got) > 0.5 * remaining:
+                    cells = [(a, b) for a in uu.tolist()
+                             for b in ur.tolist() if (a, b) not in seen]
+                    pick = rng.choice(len(cells), size=want - got,
+                                      replace=False)
+                    for j in pick:
+                        a, b = cells[j]
+                        seen.add((a, b))
+                        out_t.append(t)
+                        out_u.append(a)
+                        out_r.append(b)
+                    got = want
+                    break
                 cu = uu[rng.integers(0, len(uu), size=want - got)]
                 cr = ur[rng.integers(0, len(ur), size=want - got)]
                 for a, b in zip(cu.tolist(), cr.tolist()):
@@ -147,7 +165,6 @@ class AccessAnomaly(_HasAccessCols, Estimator):
         users = np.asarray(table[self.getUserCol()])
         res = np.asarray(table[self.getResCol()])
         k = self.getOrDefault("rankParam")
-        rng = np.random.default_rng(self.getOrDefault("seed"))
 
         uniq_t = list(np.unique(tenants))
         u_maps, r_maps, idx_cache = {}, {}, {}
@@ -166,8 +183,22 @@ class AccessAnomaly(_HasAccessCols, Estimator):
             idx_cache[t] = (ui, ri)
             Y[ti, ui, ri] = 1.0
 
-        U0 = rng.normal(scale=0.1, size=(T, M, k)).astype(np.float32)
-        V0 = rng.normal(scale=0.1, size=(T, N, k)).astype(np.float32)
+        # Per-tenant seeded init over the REAL slots only, zeros in the
+        # padded slots.  Zero padded rows stay zero through every ridge
+        # sweep (their Y rows are zero, and they contribute nothing to
+        # the Gram matrices), so each tenant's fitted factors — and its
+        # anomaly scores — are independent of which other tenants share
+        # the batch and of the batch's padded M×N shape (ADVICE r4).
+        import zlib
+        seed = self.getOrDefault("seed")
+        U0 = np.zeros((T, M, k), np.float32)
+        V0 = np.zeros((T, N, k), np.float32)
+        for ti, t in enumerate(uniq_t):
+            trng = np.random.default_rng(
+                [seed, zlib.crc32(str(t).encode("utf-8"))])
+            mu_, nu_ = len(u_maps[t]), len(r_maps[t])
+            U0[ti, :mu_] = trng.normal(scale=0.1, size=(mu_, k))
+            V0[ti, :nu_] = trng.normal(scale=0.1, size=(nu_, k))
         U, V = _als_sweeps(
             jnp.asarray(Y), jnp.float32(self.getOrDefault("regParam")),
             jnp.asarray(U0), jnp.asarray(V0),

@@ -18,6 +18,7 @@ instead of per-request forwards.
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import queue
@@ -215,22 +216,28 @@ class DistributedHTTPServer:
 
 def join_exchange(exchange: str, worker_id: int,
                   http_host: str = "0.0.0.0", api_path: str = "/",
-                  reply_timeout: float = 30.0) -> None:
+                  reply_timeout: float = 30.0, token: str = "") -> None:
     """Run ONE serving worker against a remote exchange — the multi-host
     entrypoint (each machine runs this next to its accelerator; the
     reference's per-executor DistributedHTTPSource server,
     SURVEY.md §3.4).  Blocks until the exchange sends ``stop`` or the
     connection drops.  ``exchange`` is the driver's
     ``MultiprocessHTTPServer(spawn_workers=False).exchange_address``;
-    ``worker_id`` must be the unique slot index in [0, num_workers)."""
+    ``worker_id`` must be the unique slot index in [0, num_workers);
+    ``token`` is the driver's ``MultiprocessHTTPServer.token`` shared
+    secret — the exchange drops any connection that does not present it
+    (the worker-id/duplicate checks guard mistakes; the token guards
+    adversaries).  The exchange port should additionally be firewalled
+    to cluster hosts — the token authenticates joiners, it does not
+    encrypt the line protocol."""
     host, _, port = exchange.rpartition(":")
     _mp_worker_main(host, int(port), int(worker_id), http_host, api_path,
-                    reply_timeout)
+                    reply_timeout, token)
 
 
 def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                     http_host: str, api_path: str,
-                    reply_timeout: float) -> None:
+                    reply_timeout: float, token: str = "") -> None:
     """Worker-process entrypoint (module-level for spawn-pickling).
 
     Owns REAL client sockets in its own process: parks each HTTP request
@@ -307,7 +314,7 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     adv_host = httpd.server_address[0]
     if adv_host in ("0.0.0.0", "", "::"):
         adv_host = conn.getsockname()[0]
-    send({"op": "hello", "worker": worker_id,
+    send({"op": "hello", "worker": worker_id, "token": token,
           "host": adv_host, "port": httpd.server_address[1]})
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
@@ -344,14 +351,22 @@ class MultiprocessHTTPServer:
     running one worker next to its accelerator (the reference's
     per-executor HTTP server).  Pass ``host="0.0.0.0"`` so remote
     workers can reach the exchange; ``exchange_address`` is the
-    ``host:port`` to hand them.
+    ``host:port`` to hand them, along with the ``token`` shared secret
+    each ``join_exchange`` must present (auto-generated unless given).
+    The exchange rejects any connection whose first message is not a
+    correctly-tokened hello; still firewall the exchange port to
+    cluster hosts — the token authenticates joiners, the line protocol
+    itself is plaintext.
     """
 
     def __init__(self, num_workers: int = 2, host: str = "127.0.0.1",
                  api_path: str = "/", reply_timeout: float = 30.0,
-                 spawn_workers: bool = True, join_timeout: float = 20.0):
+                 spawn_workers: bool = True, join_timeout: float = 20.0,
+                 token: Optional[str] = None):
+        import secrets
         import socket as _socket
 
+        self.token = secrets.token_hex(16) if token is None else token
         self._listener = _socket.socket()
         self._listener.bind((host, 0))
         self._listener.listen(num_workers)
@@ -373,7 +388,7 @@ class MultiprocessHTTPServer:
             self._procs = [
                 ctx.Process(target=_mp_worker_main,
                             args=(dh, dp, i, host, api_path,
-                                  reply_timeout),
+                                  reply_timeout, self.token),
                             daemon=True)
                 for i in range(num_workers)]
 
@@ -427,7 +442,9 @@ class MultiprocessHTTPServer:
                 raise RuntimeError(
                     f"external workers failed to join {xaddr} within "
                     f"{self._join_timeout}s; start one "
-                    f"join_exchange(...) per worker slot") from e
+                    f"join_exchange(...) per worker slot, passing this "
+                    f"server's .token (a worker with a missing or "
+                    f"wrong token is dropped at hello)") from e
             conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
             idx = len(self._conns)
             self._conns.append(conn)
@@ -448,17 +465,36 @@ class MultiprocessHTTPServer:
             raise RuntimeError(
                 f"worker slots {missing} never reported their ports "
                 f"(invalid/duplicate worker ids? each join_exchange "
-                f"needs a unique id in [0, {len(self.addresses)}))")
+                f"needs a unique id in [0, {len(self.addresses)}); a "
+                f"missing or wrong token= also lands here — pass this "
+                f"server's .token to every join_exchange)")
         return self
 
     def _reader(self, idx: int, conn) -> None:
         rfile = conn.makefile("r", encoding="utf-8")
+        authed = False
         for line in rfile:
             try:
                 msg = json.loads(line)
             except ValueError:
                 continue
             op = msg.get("op")
+            if not authed:
+                # first message MUST be a correctly-tokened hello: an
+                # unauthenticated peer never gets to claim a worker slot
+                # or route client traffic (ADVICE r4)
+                if op != "hello" or not hmac.compare_digest(
+                        str(msg.get("token", "")).encode("utf-8"),
+                        self.token.encode("utf-8")):
+                    log.warning("serving: dropping unauthenticated "
+                                "exchange connection (bad or missing "
+                                "token)")
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return  # nothing registered for this conn — no purge
+                authed = True
             if op == "hello":
                 w = msg.get("worker")
                 if (not isinstance(w, int) or not

@@ -183,8 +183,13 @@ def native_find_split(hist, parent_g, parent_h, parent_c, feature_mask,
     pg = jnp.float32(parent_g)
     ph = jnp.float32(parent_h)
     gain_x = lg(gl, hl) + lg(pg - gl, ph - hl) - lg(pg, ph)
-    gain = jnp.where(jnp.isfinite(gain_n[0]), gain_x,
-                     jnp.float32(-jnp.inf))
+    # The XLA-trajectory gain must ALSO clear the floor: when the C++
+    # prefix-sum rounding clears it but gain_x lands at/below it, the
+    # pure-XLA path would reject this split — return -inf, not a finite
+    # sub-floor gain (ADVICE r4).
+    gain = jnp.where(jnp.isfinite(gain_n[0])
+                     & (gain_x > jnp.float32(gain_floor)),
+                     gain_x, jnp.float32(-jnp.inf))
     return gain, feat, b
 
 

@@ -114,8 +114,11 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
     Each partition must hold WHOLE queries (partition the DataFrame by
     the group column — the reference likewise needs group-contiguous
     partitions for distributed lambdarank); a query spanning partitions
-    fails fast in the engine.  Query ids ride the same 1-D metadata
-    allgather as labels and feed the sharded query-pinned packing
+    fails fast here, via an allgathered digest cross-check of the
+    original ids.  Group columns may be strings or arbitrary int64
+    (the reference accepts StringType): ids are factorized host-side
+    to dense per-shard codes, allgathered as integers, and offset to
+    be globally unique before feeding the sharded query-pinned packing
     (ranking.shard_queries_from_shards).
     """
 
@@ -153,7 +156,7 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
             X = np.zeros((0, mapper.num_features), np.float64)
             y_local = np.zeros(0, np.float64)
             w_local = np.zeros(0, np.float64)
-            q_local = np.zeros(0, np.float64)
+            q_local = np.zeros(0, np.int32)
         else:
             first = pdf[feature_col].iloc[0]
             X = (np.stack([np.asarray(v, np.float64)
@@ -163,8 +166,29 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
             y_local = pdf[label_col].to_numpy(np.float64)
             w_local = (pdf[weight_col].to_numpy(np.float64)
                        if weight_col else np.ones(len(y_local)))
-            q_local = (pdf[group_col].to_numpy(np.float64)
-                       if group_col else np.zeros(0, np.float64))
+            if group_col:
+                # Factorize query ids to dense codes BEFORE the float
+                # allgather: string ids (the reference's LightGBMRanker
+                # accepts StringType) would raise under to_numpy(float64),
+                # and int64 ids above 2**53 would silently merge/split
+                # queries in float64 (ADVICE r4).  Queries are pinned to
+                # their shard (group-contiguous partitions), so per-shard
+                # dense codes group rows exactly.
+                codes, uniq_q = pd.factorize(pdf[group_col])
+                q_local = codes.astype(np.int32)
+                # 64-bit digests of this shard's ORIGINAL ids: per-shard
+                # dense codes can no longer collide across shards, so
+                # the engine's query-spans-shards guard would go blind —
+                # these digests are allgathered below to keep the
+                # fail-fast on non-group-contiguous ingestion
+                import hashlib
+                qdig_local = np.asarray(
+                    [int.from_bytes(hashlib.sha1(
+                        str(v).encode("utf-8")).digest()[:8], "big")
+                     for v in uniq_q], np.uint64)
+            else:
+                q_local = np.zeros(0, np.int32)
+                qdig_local = np.zeros(0, np.uint64)
         bins_local = mapper.transform_packed(X)
 
         # global per-shard sizes + 1-D label/weight(/qid) metadata: pad
@@ -174,16 +198,56 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
             np.asarray([len(y_local)]))).reshape(-1)
         S = int(sizes.max())
         pad = S - len(y_local)
-        rows = [np.pad(y_local, (0, pad)), np.pad(w_local, (0, pad))]
-        if group_col:
-            rows.append(np.pad(q_local, (0, pad), constant_values=-1))
-        yw = np.stack(rows)
+        yw = np.stack([np.pad(y_local, (0, pad)),
+                       np.pad(w_local, (0, pad))])
         yw_all = np.asarray(multihost_utils.process_allgather(yw))
         label_shards = [yw_all[d, 0, :sizes[d]] for d in range(num_tasks)]
         weight_shards = [yw_all[d, 1, :sizes[d]] for d in range(num_tasks)]
         ranking_info = None
         if group_col:
-            qid_shards = [yw_all[d, 2, :sizes[d]] for d in range(num_tasks)]
+            # qids ride their OWN int32 allgather: the float gather above
+            # goes through jax with x64 disabled, which would downcast
+            # float64 to float32 and merge distinct large ids (ADVICE
+            # r4).  Per-shard dense codes are then made globally unique
+            # with a running base computed identically on every host —
+            # the engine's query-spans-shards guard compares values
+            # across shards.
+            q_all = np.asarray(multihost_utils.process_allgather(
+                np.pad(q_local, (0, pad), constant_values=-1)))
+            qid_shards, base = [], 0
+            for d in range(num_tasks):
+                qd = q_all[d, :sizes[d]].astype(np.int64)
+                qid_shards.append((qd + base).astype(np.float64))
+                base += int(qd.max()) + 1 if len(qd) else 0
+            # Re-arm the engine's query-spans-shards guard on ORIGINAL
+            # ids: per-shard factorized codes are globally unique by
+            # construction, so without this digest cross-check a query
+            # split across partitions would silently train as two
+            # queries instead of failing fast.
+            nq = np.asarray(multihost_utils.process_allgather(
+                np.asarray([len(qdig_local)], np.int32))).reshape(-1)
+            dig = np.stack([(qdig_local >> np.uint64(32)).astype(np.uint32),
+                            qdig_local.astype(np.uint32)])
+            dig = np.pad(dig, ((0, 0), (0, int(nq.max()) - len(qdig_local))))
+            dig_all = np.asarray(multihost_utils.process_allgather(dig))
+            owner: dict = {}
+            for d in range(num_tasks):
+                for hi, lo in zip(dig_all[d, 0, :nq[d]],
+                                  dig_all[d, 1, :nq[d]]):
+                    key = (int(hi), int(lo))
+                    if key in owner and owner[key] != d:
+                        h64 = (int(hi) << 32) | int(lo)
+                        local = [str(v) for v in uniq_q
+                                 if int.from_bytes(hashlib.sha1(
+                                     str(v).encode("utf-8")).digest()[:8],
+                                     "big") == h64] if len(q_local) else []
+                        name = local[0] if local else f"digest {h64:#x}"
+                        raise ValueError(
+                            f"query {name} spans shards {owner[key]} and "
+                            f"{d}: sharded lambdarank requires every "
+                            f"query's rows on ONE shard (group-contiguous "
+                            f"ingestion)")
+                    owner[key] = d
             ranking_info = {
                 "query_ids": qid_shards,
                 "sigma": float((ranking or {}).get("sigma", 1.0)),

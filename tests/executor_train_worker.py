@@ -38,13 +38,23 @@ def main():
 
     # deterministic table all tasks can regenerate; each keeps ITS
     # partition only (Spark would hand each barrier task its partition)
-    if mode == "rank":
+    if mode in ("rank", "rank_bad"):
         X, y, q = rank_table(np.random.default_rng(2))
         mapper = fit_bin_mapper(X, max_bin=31)
         # group-contiguous partitions: task d owns queries d, d+2, ...
         mine = np.isin(q, np.arange(task_index, q.max() + 1, num_tasks))
+        if mode == "rank_bad":
+            # break contiguity on purpose: move one row of query 0 to
+            # task 1 — the adapter's digest cross-check must fail fast
+            first_q0 = int(np.nonzero(q == 0)[0][0])
+            mine[first_q0] = task_index == 1
+        # string query ids, deliberately: the reference's LightGBMRanker
+        # accepts StringType group columns, and executor_train_fn must
+        # factorize them host-side (ADVICE r4) — grouping, not values,
+        # is what lambdarank consumes, so parity vs the driver-side
+        # integer-qid fit still holds
         pdf = pd.DataFrame({"features": list(X[mine]), "label": y[mine],
-                            "query": q[mine]})
+                            "query": [f"q{int(v)}" for v in q[mine]]})
         fn = executor_train_fn(
             mapper, TrainParams(num_iterations=6, num_leaves=7,
                                 min_data_in_leaf=5, verbosity=0),

@@ -78,6 +78,21 @@ class TestComplement:
             assert (tt, uu, rr) not in seen
             assert uu.startswith(tt) and rr.startswith(tt)  # tenant-local
 
+    def test_near_dense_grid_terminates_and_exhausts(self):
+        """ADVICE r4: a tenant whose access grid is nearly complete must
+        not spin in rejection sampling — the transformer enumerates the
+        leftover complement and returns exactly the cells that exist."""
+        users = np.repeat([f"u{i}" for i in range(6)], 6)
+        ress = np.tile([f"r{j}" for j in range(6)], 6)
+        keep = np.ones(36, bool)
+        keep[[5, 17, 30]] = False          # exactly 3 unseen cells
+        t = {"tenant": np.asarray(["t"] * int(keep.sum())),
+             "user": users[keep], "res": ress[keep]}
+        comp = ComplementAccessTransformer(
+            complementsetFactor=2, seed=0).transform(t)
+        got = set(zip(comp["user"].tolist(), comp["res"].tolist()))
+        assert got == {("u0", "r5"), ("u2", "r5"), ("u5", "r0")}
+
 
 class TestAccessAnomaly:
     def test_cross_department_access_scores_higher(self):
@@ -113,6 +128,26 @@ class TestAccessAnomaly:
         np.testing.assert_allclose(loaded.transform(t)["anomaly_score"],
                                    model.transform(t)["anomaly_score"],
                                    rtol=1e-6)
+
+    def test_scores_independent_of_batch_composition(self):
+        """ADVICE r4: padded factor slots are zero and init is seeded
+        per tenant, so a tenant fitted alone and fitted alongside a much
+        LARGER tenant produces identical scores."""
+        t = access_table()
+        t0_mask = t["tenant"] == "t0"
+        alone = {k: v[t0_mask] for k, v in t.items()}
+        # a much larger tenant forces the joint batch to pad t0's slots
+        rng = np.random.default_rng(7)
+        big_u = rng.integers(0, 60, 500)
+        big = {"tenant": np.asarray(["big"] * 500),
+               "user": np.asarray([f"big_u{i}" for i in big_u]),
+               "res": np.asarray([f"big_r{i}" for i in
+                                  rng.integers(0, 40, 500)])}
+        joint = {k: np.concatenate([alone[k], big[k]]) for k in alone}
+        est = AccessAnomaly(rankParam=6, maxIter=10, seed=1)
+        s_alone = est.fit(alone).transform(alone)["anomaly_score"]
+        s_joint = est.fit(joint).transform(alone)["anomaly_score"]
+        np.testing.assert_allclose(s_alone, s_joint, rtol=1e-4, atol=1e-5)
 
     def test_unknown_tenant_not_whitelisted(self):
         t = access_table()

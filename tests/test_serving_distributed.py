@@ -242,9 +242,10 @@ class TestExternalWorkers:
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         code = ("import sys; from mmlspark_tpu.io.serving import "
                 "join_exchange; "
-                "join_exchange(sys.argv[1], int(sys.argv[2]))")
+                "join_exchange(sys.argv[1], int(sys.argv[2]), "
+                "token=sys.argv[3])")
         procs = [subprocess.Popen([sys.executable, "-c", code, addr,
-                                   str(i)], env=env)
+                                   str(i), srv.token], env=env)
                  for i in range(2)]
         try:
             srv.start()
@@ -294,12 +295,41 @@ class TestExternalWorkers:
         env = dict(os.environ)
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         code = ("import sys; from mmlspark_tpu.io.serving import "
-                "join_exchange; join_exchange(sys.argv[1], 7)")
+                "join_exchange; join_exchange(sys.argv[1], 7, "
+                "token=sys.argv[2])")
         proc = subprocess.Popen(
-            [sys.executable, "-c", code, f"127.0.0.1:{p}"], env=env)
+            [sys.executable, "-c", code, f"127.0.0.1:{p}", srv.token],
+            env=env)
         try:
             with pytest.raises(RuntimeError, match="unique id"):
                 srv.start()
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def test_unauthenticated_join_rejected(self):
+        """ADVICE r4 (medium): a peer speaking the line protocol but
+        lacking the shared secret must NOT claim a worker slot — its
+        connection is dropped at the first message."""
+        import os
+        import subprocess
+        import sys
+        srv = MultiprocessHTTPServer(num_workers=1, spawn_workers=False,
+                                     join_timeout=6.0)
+        assert srv.token  # auto-generated secret exists
+        h, _, p = srv.exchange_address.rpartition(":")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        code = ("import sys; from mmlspark_tpu.io.serving import "
+                "join_exchange; join_exchange(sys.argv[1], 0, "
+                "token='wrong-secret')")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code, f"127.0.0.1:{p}"], env=env)
+        try:
+            with pytest.raises(RuntimeError):
+                srv.start()  # slot never filled: the hello was rejected
+            assert srv.addresses[0] == ""
         finally:
             proc.kill()
             proc.wait(timeout=10)

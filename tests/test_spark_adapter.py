@@ -242,3 +242,31 @@ class TestExecutorSideTraining:
         np.testing.assert_allclose(
             executor_model.predict_margin(X), ref.predict_margin(X),
             rtol=2e-3, atol=1e-5)
+
+    def test_query_spanning_partitions_fails_fast(self, tmp_path):
+        """Factorized per-shard qid codes cannot collide across shards,
+        so the engine's spans-shards guard is blind — the adapter's
+        digest cross-check of ORIGINAL ids must catch the ingestion
+        error instead (code-review r5)."""
+        import socket
+        import subprocess
+        import sys
+
+        port_s = socket.socket()
+        port_s.bind(("127.0.0.1", 0))
+        port = port_s.getsockname()[1]
+        port_s.close()
+        worker = os.path.join(os.path.dirname(__file__),
+                              "executor_train_worker.py")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(port), str(i), "2",
+             str(tmp_path), "rank_bad"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for i in range(2)]
+        outs = [p.communicate(timeout=540) for p in procs]
+        assert all(p.returncode != 0 for p in procs)
+        assert any("spans shards" in err for _, err in outs)
