@@ -1153,9 +1153,11 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
     assumed host-small and arrives monolithic), per-machine bagging,
     callbacks (non-ranking), per-shard init scores (non-ranking), goss,
     rf, dart (any mesh layout) and lambdarank (each query pinned to the
-    shard holding its rows — ranking.shard_queries_from_shards).  Still
-    gated: dart×ranking (the dart host loop keeps full prediction rows),
-    callbacks/init-scores×ranking, and custom gradient overrides.
+    shard holding its rows — ranking.shard_queries_from_shards),
+    including dart×ranking (the dart host loop runs on the packed
+    per-shard layout; bag masks scatter through the query-pack
+    permutation).  Still gated: callbacks/init-scores×ranking and
+    custom gradient overrides.
     ``init_scores`` may be a per-shard LIST or one array in
     shard-concatenation order; ``ranking_info['query_ids']`` may be a
     per-shard list or one array in shard-concatenation order."""
@@ -1167,11 +1169,6 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
             "custom gradient overrides are not supported with sharded "
             "ingestion (the override closes over monolithic rows); "
             "rankers pass structured ranking_info instead")
-    if ranking_info is not None and params.boosting == "dart":
-        raise NotImplementedError(
-            "boostingType='dart' with a ranking objective requires "
-            "monolithic arrays (the dart host loop keeps full "
-            "prediction rows)")
     if any(b is None for b in bins_shards):
         # multi-controller: each controller passes None for slots other
         # hosts own; shard_rows (tiny global metadata) sizes them, and

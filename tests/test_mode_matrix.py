@@ -16,9 +16,9 @@ from mmlspark_tpu.gbdt.objectives import get_objective
 
 BOOSTING = ["gbdt", "goss", "dart", "rf"]
 
-#: the ONLY remaining gate: dart x ranking x sharded (the dart host
-#: loop keeps full prediction rows; documented in docs/lightgbm.md)
-GATED = {("lambdarank", "sharded", "dart")}
+#: round 5: the last gate (dart x ranking x sharded) closed — every
+#: boosting x objective x deployment cell trains
+GATED = set()
 
 
 def _tables():
