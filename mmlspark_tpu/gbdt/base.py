@@ -95,6 +95,19 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                        typeConverter=TypeConverters.toInt)
     initScoreCol = Param("initScoreCol", "Column with per-row initial scores",
                          default=None, typeConverter=TypeConverters.toString)
+    initModelPath = Param(
+        "initModelPath",
+        "Path to a saved native (LightGBM-text) model to CONTINUE "
+        "training from: its margins seed the boosting scores and its "
+        "trees prepend the fitted forest (LightGBM's init_model / "
+        "keep_training_booster)", default="",
+        typeConverter=TypeConverters.toString)
+    checkpointDir = Param(
+        "checkpointDir",
+        "Directory for chunk-boundary training checkpoints: a killed "
+        "fit re-run with the same settings resumes from the last "
+        "completed chunk, bit-identically (empty disables)", default="",
+        typeConverter=TypeConverters.toString)
     featuresShapCol = Param("featuresShapCol",
                             "Output column for SHAP values (empty disables)",
                             default="", typeConverter=TypeConverters.toString)
@@ -216,6 +229,7 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
             parallelism=self.getParallelism(),
             top_k=self.getTopK(),
             fault_tolerant_retries=self.getFaultTolerantRetries(),
+            checkpoint_dir=self.getOrDefault("checkpointDir"),
             enable_bundle=self.getEnableBundle(),
             max_conflict_rate=self.getMaxConflictRate(),
             cat_smooth=self.getCatSmooth(),
@@ -310,10 +324,46 @@ class LightGBMBase(Estimator, LightGBMParams):
         iscol = self.getInitScoreCol()
         init_scores = (np.asarray(table[iscol], np.float64)[train_idx]
                        if iscol else None)
-
         has_val = val_mask is not None and val_mask.any()
 
         params = self._train_params()
+        init_booster = None
+        val_init_scores = None
+        imp = self.getOrDefault("initModelPath")
+        if imp:
+            # Continued training (LightGBM init_model): boost from the
+            # saved model's margins; its trees prepend the new forest.
+            # Guard on the RESOLVED boosting type — passThroughArgs keys
+            # naming TrainParams fields apply in __post_init__ and must
+            # not bypass this check.
+            if params.boosting in ("dart", "rf"):
+                raise ValueError(
+                    "initModelPath requires boostingType gbdt or goss: "
+                    "dart re-weights (and rf averages) the WHOLE "
+                    "ensemble, which is not additive over a frozen "
+                    "prefix")
+            init_booster = Booster.load_native_model(imp)
+            if init_booster.num_class != \
+                    objective.num_model_per_iteration:
+                raise ValueError(
+                    f"initModelPath model has num_class="
+                    f"{init_booster.num_class}, this fit trains "
+                    f"{objective.num_model_per_iteration}")
+            if init_booster.max_feature_idx != X.shape[1] - 1:
+                raise ValueError(
+                    f"initModelPath model was trained on "
+                    f"{init_booster.max_feature_idx + 1} features, "
+                    f"this table has {X.shape[1]}")
+            margins = np.asarray(init_booster.predict_margin(X_train),
+                                 np.float64)
+            init_scores = (margins if init_scores is None
+                           else init_scores + margins)
+            if has_val:
+                # validation margins seed the val scores too (LightGBM's
+                # init_model seeds valid sets): early stopping decides on
+                # the MERGED model's trajectory, not the residual's
+                val_init_scores = np.asarray(
+                    init_booster.predict_margin(X[val_mask]), np.float64)
         ranking_info = self._ranking_info(table, train_idx)
         mesh = getattr(self, "_mesh", None)
         mesh_multi = mesh is not None and int(np.prod(
@@ -348,6 +398,8 @@ class LightGBMBase(Estimator, LightGBMParams):
                 val_weights=w[val_mask] if w is not None else None,
                 val_metric=self._val_metric_fn(table, val_mask),
             )
+            if val_init_scores is not None:
+                val_kwargs["val_init_scores"] = val_init_scores
         from ..core.profiling import maybe_trace
         with maybe_trace(self.getProfileTraceDir()):
             booster = train(
@@ -358,6 +410,8 @@ class LightGBMBase(Estimator, LightGBMParams):
                 init_scores=init_scores,
                 ranking_info=ranking_info,
                 **val_kwargs)
+        if init_booster is not None:
+            booster = init_booster.extended(booster)
         model = self._make_model(booster)
         model.setParams(**{k: v for k, v in self._iterSetParams()
                            if model.hasParam(k)})

@@ -159,6 +159,37 @@ class Booster:
         self._stacked = None
         self._stacked_np = None
 
+    def extended(self, continuation: "Booster") -> "Booster":
+        """The merged model of continued training (LightGBM's
+        ``init_model``): this booster's trees followed by the
+        ``continuation`` forest that was trained with this booster's
+        margins as init scores.  Predictions of the merged model equal
+        base margins + continuation margins by additivity.  Reference:
+        LightGBMBooster model round-trip + LightGBM's
+        init_model/keep_training_booster capability (SURVEY.md §5.4)."""
+        if continuation.num_class != self.num_class:
+            raise ValueError(
+                f"cannot extend a {self.num_class}-class model with a "
+                f"{continuation.num_class}-class continuation")
+        if continuation.max_feature_idx != self.max_feature_idx:
+            raise ValueError(
+                f"feature count mismatch: base model uses "
+                f"{self.max_feature_idx + 1} features, continuation "
+                f"{continuation.max_feature_idx + 1}")
+        params = dict(continuation.params)
+        old_it = len(self.trees) // max(self.num_class, 1)
+        new_it = len(continuation.trees) // max(self.num_class, 1)
+        params["num_iterations"] = str(old_it + new_it)
+        return Booster(
+            list(self.trees) + list(continuation.trees),
+            num_class=self.num_class,
+            objective_str=continuation.objective_str,
+            init_score=self.init_score,
+            feature_names=continuation.feature_names,
+            feature_infos=continuation.feature_infos,
+            max_feature_idx=self.max_feature_idx,
+            params=params)
+
     # -- prediction ----------------------------------------------------------
 
     def _stack(self):

@@ -90,6 +90,16 @@ class TrainParams:
     #: exported model always reference original features.
     enable_bundle: bool = False
     max_conflict_rate: float = 0.0
+    #: cross-process mid-fit checkpointing (SURVEY.md §5.3 elasticity):
+    #: non-empty = a directory where the serial scan loop persists
+    #: (trees, scores, RNG streams, early-stopping state) at every chunk
+    #: boundary; a killed fit re-run with the SAME inputs and params
+    #: resumes from the last completed chunk bit-identically.  The
+    #: snapshot is fingerprinted against (shape, params) and ignored
+    #: with a warning on mismatch; it is deleted on successful
+    #: completion.  Serial gbdt/goss/rf/multiclass scan paths; inert
+    #: (with a warning) for dart/ranking host loops and mesh paths.
+    checkpoint_dir: str = ""
     #: raw passthrough params recorded into the model file (parity with the
     #: reference's passThroughArgs).  Keys that NAME a TrainParams field
     #: are applied onto it (string-coerced) in ``__post_init__`` — like
@@ -156,6 +166,123 @@ def _draw_feature_fraction(rng, fi_base: np.ndarray, f: int,
 
 def _dummy_val(K: int):
     return jnp.zeros((0,) if K == 1 else (0, K), jnp.float32)
+
+
+# -- cross-process mid-fit checkpointing (TrainParams.checkpoint_dir) -------
+
+_CKPT_FILE = "boost_checkpoint.npz"       # meta + loop state, atomic
+_CKPT_CHUNK = "boost_chunk_{:04d}.npz"    # one per tree chunk, write-once
+
+
+def _ckpt_fingerprint(n, f, K, params, labels, bins) -> str:
+    """Identity of a fit for resume safety: shapes, every param that
+    shapes the boosting trajectory (checkpoint_dir itself excluded so
+    moving the directory doesn't orphan the snapshot), AND a digest of
+    the data — full labels plus a strided sample of the binned matrix —
+    so a same-shape fit on DIFFERENT data starts fresh instead of
+    silently blending two datasets."""
+    import hashlib
+    d = {k: v for k, v in params.__dict__.items() if k != "checkpoint_dir"}
+    h = hashlib.sha256(
+        f"{n}|{f}|{K}|{sorted(d.items())!r}".encode("utf-8"))
+    h.update(np.ascontiguousarray(np.asarray(labels)).tobytes())
+    bins_np = np.asarray(bins)
+    h.update(np.ascontiguousarray(
+        bins_np[:: max(1, len(bins_np) // 4096)]).tobytes())
+    return h.hexdigest()
+
+
+def _ckpt_save(ckpt_dir, fp, it, trees_chunks, scores, val_scores,
+               cur_bag, rng, bag_rng, best_metric, best_iter) -> None:
+    """Persist the chunk-boundary state.
+
+    Tree chunks are immutable once grown, so each is written to its own
+    file exactly ONCE (O(1) device→host transfer and disk I/O per
+    boundary, not O(chunks)); the small meta/state file — host copies of
+    the device score vectors (float32 round-trips exactly), the two host
+    RNG streams (bit-generator state as JSON), the carried bag mask and
+    the early-stopping bests — is replaced atomically (tmp + fsync +
+    rename) last, so a torn save leaves the PREVIOUS boundary loadable.
+    A resumed fit replays the remaining chunks on bit-identical inputs."""
+    import json as _json
+    import os
+    os.makedirs(ckpt_dir, exist_ok=True)
+    for i, ch in enumerate(trees_chunks):
+        cpath = os.path.join(ckpt_dir, _CKPT_CHUNK.format(i))
+        if os.path.exists(cpath):
+            continue
+        tmp = cpath + ".tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **{name: np.asarray(arr) for name, arr
+                            in zip(TreeArrays._fields, ch)})
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, cpath)
+    meta = {
+        "fingerprint": fp, "it": int(it),
+        "n_chunks": len(trees_chunks),
+        "rng_state": rng.bit_generator.state,
+        "bag_rng_state": bag_rng.bit_generator.state,
+        "best_metric": float(best_metric), "best_iter": int(best_iter),
+    }
+    tmp = os.path.join(ckpt_dir, _CKPT_FILE + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez(fh,
+                 __meta__=np.frombuffer(
+                     _json.dumps(meta).encode("utf-8"), np.uint8),
+                 scores=np.asarray(scores),
+                 val_scores=np.asarray(val_scores),
+                 cur_bag=np.asarray(cur_bag))
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, os.path.join(ckpt_dir, _CKPT_FILE))
+
+
+def _ckpt_load(ckpt_dir, fp):
+    """Load and validate a snapshot; None when absent/torn/mismatched —
+    a bad snapshot must degrade to a fresh fit, never kill the re-run."""
+    import json as _json
+    import os
+    path = os.path.join(ckpt_dir, _CKPT_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        z = np.load(path)
+        meta = _json.loads(bytes(z["__meta__"]).decode("utf-8"))
+        if meta["fingerprint"] != fp:
+            log.warning("checkpoint at %s belongs to a different fit "
+                        "(data or params changed); starting fresh", path)
+            return None
+        chunks = []
+        for i in range(meta["n_chunks"]):
+            cz = np.load(os.path.join(ckpt_dir, _CKPT_CHUNK.format(i)))
+            chunks.append(TreeArrays(*[cz[name]
+                                       for name in TreeArrays._fields]))
+        return {
+            "it": meta["it"], "trees_chunks": chunks,
+            "scores": z["scores"], "val_scores": z["val_scores"],
+            "cur_bag": z["cur_bag"],
+            "rng_state": meta["rng_state"],
+            "bag_rng_state": meta["bag_rng_state"],
+            "best_metric": meta["best_metric"],
+            "best_iter": meta["best_iter"],
+        }
+    except Exception:  # noqa: BLE001 - torn/partial snapshot
+        log.warning("checkpoint at %s is unreadable; starting fresh",
+                    path)
+        return None
+
+
+def _ckpt_clear(ckpt_dir) -> None:
+    import glob
+    import os
+    for p in ([os.path.join(ckpt_dir, _CKPT_FILE)]
+              + glob.glob(os.path.join(
+                  ckpt_dir, _CKPT_CHUNK.format(0).replace("0000", "*")))):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
 
 
 @functools.partial(jax.jit,
@@ -570,9 +697,14 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
           callbacks: Optional[List[Callable]] = None,
           mesh=None,
           init_scores: Optional[np.ndarray] = None,
+          val_init_scores: Optional[np.ndarray] = None,
           ranking_info: Optional[Dict] = None,
           shard_rows: Optional[List[int]] = None) -> Booster:
     """Train a forest.  ``bins``: (n, f) int32 pre-binned features.
+
+    ``val_init_scores``: per-row margin offsets for the validation set —
+    the continued-training (init_model) companion of ``init_scores``, so
+    early stopping evaluates the merged model's trajectory.
 
     ``grad_fn_override``: optional ``(scores) -> (g, h)`` replacing the
     objective's grad/hess (used by the ranking objective which closes over
@@ -694,6 +826,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         _chunk = min(_chunk, 8)
     if params.fault_tolerant_retries > 0:
         _chunk = min(_chunk, 32)
+    if params.checkpoint_dir:
+        _chunk = min(_chunk, 32)
     check_fit_budget(
         n_local=-(-n // _dn), num_features=f,
         num_bins=mapper.num_total_bins, num_leaves=params.num_leaves,
@@ -704,11 +838,20 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                      if val_bins is not None else 0),
         data_shards=_dn, verbosity=params.verbosity)
     if use_mesh:
+        if params.checkpoint_dir:
+            log.warning("checkpoint_dir is inert for mesh training "
+                        "(use faultTolerantRetries for in-process chunk "
+                        "replay; cross-process mesh elasticity restarts "
+                        "from a saved model via initModelPath)")
         if ranking_info is not None:
             if init_scores is not None:
                 raise NotImplementedError(
-                    "initScoreCol is not supported with a ranking "
-                    "objective (LightGBM's lambdarank boots from zero)")
+                    "per-row init scores (initScoreCol, or the margins "
+                    "of an initModelPath continuation) are not "
+                    "supported with a MESH ranking objective — the "
+                    "packed-query scan boots from zero like LightGBM's "
+                    "lambdarank; continue a ranker serially, or train "
+                    "fresh under the mesh")
             if callbacks:
                 raise NotImplementedError(
                     "per-iteration callbacks are not supported with "
@@ -736,7 +879,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             feature_names, init, rng, bag_rng, init_scores,
             val_bins=val_bins, val_labels=val_labels,
             val_weights=val_weights, val_metric=val_metric,
-            callbacks=callbacks)
+            callbacks=callbacks, val_init_scores=val_init_scores)
 
     # Exclusive Feature Bundling (serial paths; uint8 bins only — a
     # bundle's encoded width is capped at num_total_bins).  goss/dart
@@ -765,9 +908,13 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     has_val = val_bins is not None and val_metric is not None
     if has_val:
         val_bins_d = jnp.asarray(val_bins, mapper.bin_dtype)
-        val_scores = jnp.full(
+        vs0 = np.full(
             (val_bins.shape[0], K) if K > 1 else (val_bins.shape[0],),
-            init, jnp.float32)
+            init, np.float32)
+        if val_init_scores is not None:
+            vsc = np.asarray(val_init_scores, np.float32)
+            vs0 = vs0 + (vsc if vs0.ndim == vsc.ndim else vsc[:, None])
+        val_scores = jnp.asarray(vs0)
         val_labels_np = np.asarray(val_labels)
     else:
         val_bins_d = jnp.zeros((1, f), mapper.bin_dtype)
@@ -815,6 +962,16 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             "w": np.asarray(w),
             "val_bins": np.asarray(val_bins_d),
         }
+    ckpt = params.checkpoint_dir
+    if ckpt and (use_dart or grad_fn_override is not None):
+        log.warning("checkpoint_dir is inert for dart/custom-gradient "
+                    "host loops (per-iteration host bookkeeping; no "
+                    "chunk boundaries to snapshot)")
+        ckpt = ""
+    if ckpt:
+        # bounded chunks = bounded lost work after a process death
+        chunk = min(chunk, 32)
+        ckpt_fp = _ckpt_fingerprint(n, f, K, params, labels, bins)
 
     trees_chunks: List[TreeArrays] = []
     stop_iter = T
@@ -991,6 +1148,30 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 efb=efb_dev, rf=use_rf))
         cb_list: List[TreeArrays] = []
         it = 0
+        if ckpt:
+            snap = _ckpt_load(ckpt, ckpt_fp)
+            if snap is None:
+                # purge any stale snapshot files: the write-once chunk
+                # files of an abandoned fit must not be skipped-over by
+                # this run's saves and then stitched into ITS meta
+                _ckpt_clear(ckpt)
+            else:
+                it = snap["it"]
+                trees_chunks = list(snap["trees_chunks"])
+                scores = jnp.asarray(snap["scores"])
+                val_scores = jnp.asarray(snap["val_scores"])
+                cur_bag = np.asarray(snap["cur_bag"], np.float32)
+                rng.bit_generator.state = snap["rng_state"]
+                bag_rng.bit_generator.state = snap["bag_rng_state"]
+                best_metric = snap["best_metric"]
+                best_iter = snap["best_iter"]
+                if callbacks:
+                    log.warning("resuming from checkpoint at iteration "
+                                "%d: callbacks replay only for the "
+                                "remaining iterations", it)
+                elif params.verbosity > 0:
+                    log.info("resuming from checkpoint at iteration %d",
+                             it)
         while it < T:
             C = min(chunk, T - it)
             if use_bag:
@@ -1118,6 +1299,12 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             if stop:
                 break
             it += C
+            if ckpt:
+                _ckpt_save(ckpt, ckpt_fp, it, trees_chunks, scores,
+                           val_scores, cur_bag, rng, bag_rng,
+                           best_metric, best_iter)
+        if ckpt:
+            _ckpt_clear(ckpt)
 
     trees, nls = _fetch_host_trees(trees_chunks, params.num_leaves, mapper)
     trees, nls = trees[:stop_iter * K], nls[:stop_iter * K]
@@ -1259,8 +1446,10 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
     if ranking_info is not None:
         if init_score_shards is not None:
             raise NotImplementedError(
-                "initScoreCol is not supported with a ranking objective "
-                "(LightGBM's lambdarank boots from zero)")
+                "per-row init scores (initScoreCol, or the margins of "
+                "an initModelPath continuation) are not supported with "
+                "a mesh ranking objective (the packed-query scan boots "
+                "from zero, as LightGBM's lambdarank does)")
         if callbacks:
             raise NotImplementedError(
                 "per-iteration callbacks are not supported with mesh "
@@ -1755,7 +1944,8 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                        feature_names, init, rng, bag_rng,
                        init_scores=None, val_bins=None, val_labels=None,
                        val_weights=None, val_metric=None,
-                       callbacks=None, shard_data=None) -> Booster:
+                       callbacks=None, shard_data=None,
+                       val_init_scores=None) -> Booster:
     """Distributed boosting: the whole iteration loop is ONE shard_mapped
     ``lax.scan`` launch (no per-iteration host round-trips); with a
     validation set the loop chunks and the host replays per-iteration
@@ -1900,8 +2090,13 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
             jnp.asarray(vb), NamedSharding(mesh, P(DATA_AXIS, None)))
         vshape = (nv + vrp, K) if K > 1 else (nv + vrp,)
         vspec = P(DATA_AXIS, None) if K > 1 else P(DATA_AXIS)
+        vs0 = np.full(vshape, init, np.float32)
+        if val_init_scores is not None:
+            vsc = np.asarray(val_init_scores, np.float32)
+            vsc = vsc if vs0.ndim == vsc.ndim else vsc[:, None]
+            vs0[:nv] = vs0[:nv] + vsc
         val_scores = jax.device_put(
-            jnp.full(vshape, init, jnp.float32), NamedSharding(mesh, vspec))
+            jnp.asarray(vs0), NamedSharding(mesh, vspec))
         val_labels_np = np.asarray(val_labels)
     else:
         val_bins_d = jax.device_put(
