@@ -1,0 +1,360 @@
+"""CNTK-v2 model format: protobuf Dictionary reader/writer + jax evaluator.
+
+The reference's CNTKModel evaluates serialized CNTK-v2 ``.model`` files
+(cntk/CNTKModel.scala, expected path, UNVERIFIED — SURVEY.md §2.2 row 2),
+including *layer surgery* (cutting the graph at a named node).  This module
+implements that capability natively:
+
+* the **wire format** — CNTK v2 serializes a ``Dictionary`` protobuf
+  (public schema ``Source/CNTKv2LibraryDll/proto/CNTK.proto``): nested
+  ``DictionaryValue`` oneofs over bool/int/size_t/float/double/string/
+  NDShape/Axis/Vector/Dictionary/NDArrayView.  Field numbers below follow
+  that public schema; like the LightGBM text golden
+  (tests/golden/), the writer and reader are hand-built from the spec and
+  round-trip-verified against each other — a stock-CNTK cross-check
+  requires a network-enabled session and stays on the queue.
+* the **graph layer** — a serialized ``CompositeFunction`` dictionary
+  (``root`` uid, ``functions`` vector of primitive functions, ``inputs``
+  vector of variables with parameter/constant NDArrayView payloads);
+* a **jax evaluator** for the primitive-op subset that covers MLP and
+  CNN inference graphs (Times, Plus, Minus, ElementTimes, ReLU, Sigmoid,
+  Tanh, Softmax, Reshape, Convolution, Pooling, BatchNormalization,
+  Combine), with ``output_node`` selecting any intermediate function —
+  the reference's layer-surgery contract.
+
+Tensor conventions in this build's evaluator: batch axis leading; image
+tensors ``(C, H, W)`` per sample; convolution kernels ``(C_out, C_in,
+KH, KW)``; ``Times(a, b)`` contracts ``a``'s last axis with ``b``'s
+first (CNTK's static-shape semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..onnx.proto import (_field, _len_field, _varint, packed_floats,
+                          packed_varints, parse)
+
+# ---- DictionaryValue oneof field numbers (public CNTK.proto) --------------
+
+_DV_BOOL, _DV_INT, _DV_SIZET, _DV_FLOAT, _DV_DOUBLE = 2, 3, 4, 5, 6
+_DV_STRING, _DV_NDSHAPE, _DV_AXIS, _DV_VECTOR = 7, 8, 9, 10
+_DV_DICT, _DV_NDARRAY = 11, 12
+
+#: PrimitiveOpType values used by this build (public PrimitiveOpType.h
+#: declaration order).  Only the subset the evaluator implements.
+OPS = {
+    "Sigmoid": 1, "Tanh": 2, "ReLU": 3, "Softmax": 10, "Reshape": 16,
+    "Pooling": 17, "Plus": 19, "Minus": 20, "ElementTimes": 21,
+    "Times": 31, "Convolution": 33, "BatchNormalization": 40,
+    "Splice": 43, "Combine": 44,
+}
+_OP_NAME = {v: k for k, v in OPS.items()}
+
+# Variable kinds (CNTK VariableKind)
+KIND_INPUT, KIND_OUTPUT, KIND_PARAMETER, KIND_CONSTANT = 0, 1, 2, 3
+
+
+# ---- writer ---------------------------------------------------------------
+
+def _enc_ndshape(dims) -> bytes:
+    payload = b"".join(_varint(int(d)) for d in dims)
+    return _len_field(1, payload)        # packed repeated uint64
+
+
+def _enc_ndarrayview(arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr, np.float32)
+    out = _field(1, 0, _varint(1))       # data_type = Float
+    out += _field(2, 0, _varint(0))      # storage_format = Dense
+    out += _len_field(3, _enc_ndshape(arr.shape))
+    vals = _len_field(1, np.ascontiguousarray(
+        arr.reshape(-1)).astype("<f4").tobytes())
+    out += _len_field(4, vals)           # FloatValues{ packed value=1 }
+    return out
+
+
+def _enc_value(v) -> bytes:
+    out = _field(1, 0, _varint(1))       # version
+    if isinstance(v, bool):
+        out += _field(_DV_BOOL, 0, _varint(1 if v else 0))
+    elif isinstance(v, (int, np.integer)):
+        if v >= 0:
+            out += _field(_DV_SIZET, 0, _varint(int(v)))
+        else:
+            # negative ints ride the signed int32 field as the standard
+            # 64-bit two's-complement varint (an unmasked negative would
+            # never terminate _varint)
+            out += _field(_DV_INT, 0,
+                          _varint(int(v) & ((1 << 64) - 1)))
+    elif isinstance(v, float):
+        import struct
+        out += _field(_DV_DOUBLE, 1, struct.pack("<d", v))
+    elif isinstance(v, str):
+        out += _len_field(_DV_STRING, v.encode("utf-8"))
+    elif isinstance(v, tuple):           # NDShape spelled as a tuple
+        out += _len_field(_DV_NDSHAPE, _enc_ndshape(v))
+    elif isinstance(v, list):            # Vector
+        payload = b"".join(_len_field(1, _enc_value(x)) for x in v)
+        out += _len_field(_DV_VECTOR, payload)
+    elif isinstance(v, dict):
+        out += _len_field(_DV_DICT, _enc_dict(v))
+    elif isinstance(v, np.ndarray):
+        out += _len_field(_DV_NDARRAY, _enc_ndarrayview(v))
+    else:
+        raise TypeError(f"cannot serialize {type(v)} into a CNTK "
+                        "DictionaryValue")
+    return out
+
+
+def _enc_dict(d: Dict[str, Any]) -> bytes:
+    out = _field(1, 0, _varint(1))       # version
+    for k, v in d.items():
+        entry = _len_field(1, k.encode("utf-8")) \
+            + _len_field(2, _enc_value(v))
+        out += _len_field(2, entry)      # map<string, DictionaryValue>
+    return out
+
+
+def save_model_dict(path: str, model: Dict[str, Any]) -> None:
+    with open(path, "wb") as fh:
+        fh.write(_enc_dict(model))
+
+
+# ---- reader ---------------------------------------------------------------
+
+def _dec_ndshape(raw) -> Tuple[int, ...]:
+    return tuple(int(d) for d in packed_varints(parse(raw).get(1, [])))
+
+
+def _dec_ndarrayview(raw) -> np.ndarray:
+    f = parse(raw)
+    shape = _dec_ndshape(f[3][0]) if 3 in f else ()
+    if 4 in f:       # FloatValues
+        vals = packed_floats(parse(f[4][0]).get(1, []))
+        return np.asarray(vals, np.float32).reshape(shape)
+    if 5 in f:       # DoubleValues
+        inner = parse(f[5][0]).get(1, [])
+        out = np.concatenate([
+            np.frombuffer(bytes(v), "<f8") for v in inner]) \
+            if inner else np.zeros(0)
+        return out.astype(np.float64).reshape(shape)
+    return np.zeros(shape, np.float32)
+
+
+def _dec_value(raw):
+    import struct
+    f = parse(raw)
+    if _DV_BOOL in f:
+        return bool(f[_DV_BOOL][0])
+    if _DV_INT in f:
+        x = int(f[_DV_INT][0])
+        return x - (1 << 64) if x >= (1 << 63) else x
+    if _DV_SIZET in f:
+        return int(f[_DV_SIZET][0])
+    if _DV_FLOAT in f:
+        return struct.unpack("<f", bytes(f[_DV_FLOAT][0]))[0]
+    if _DV_DOUBLE in f:
+        return struct.unpack("<d", bytes(f[_DV_DOUBLE][0]))[0]
+    if _DV_STRING in f:
+        return bytes(f[_DV_STRING][0]).decode("utf-8")
+    if _DV_NDSHAPE in f:
+        return _dec_ndshape(f[_DV_NDSHAPE][0])
+    if _DV_VECTOR in f:
+        return [_dec_value(x)
+                for x in parse(f[_DV_VECTOR][0]).get(1, [])]
+    if _DV_DICT in f:
+        return _dec_dict(f[_DV_DICT][0])
+    if _DV_NDARRAY in f:
+        return _dec_ndarrayview(f[_DV_NDARRAY][0])
+    return None
+
+
+def _dec_dict(raw) -> Dict[str, Any]:
+    f = parse(raw)
+    out: Dict[str, Any] = {}
+    for entry in f.get(2, []):
+        ef = parse(entry)
+        key = bytes(ef[1][0]).decode("utf-8")
+        out[key] = _dec_value(ef[2][0])
+    return out
+
+
+def load_model_dict(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as fh:
+        return _dec_dict(fh.read())
+
+
+def looks_like_cntk_model(path: str) -> bool:
+    """Sniff: a CNTK v2 .model parses as a Dictionary whose map contains
+    the CompositeFunction keys."""
+    try:
+        d = load_model_dict(path)
+        return d.get("type") == "CompositeFunction" and "functions" in d
+    except Exception:  # noqa: BLE001 - any parse failure = not CNTK
+        return False
+
+
+# ---- graph builder (fixture authoring + CNTK-format export) ---------------
+
+class GraphBuilder:
+    """Author a CompositeFunction dictionary programmatically."""
+
+    def __init__(self):
+        self._vars: List[Dict[str, Any]] = []
+        self._funcs: List[Dict[str, Any]] = []
+        self._n = 0
+
+    def _uid(self, prefix: str) -> str:
+        self._n += 1
+        return f"{prefix}{self._n}"
+
+    def input(self, shape, name="features") -> str:
+        uid = self._uid("Input")
+        self._vars.append({"type": "Variable", "uid": uid, "name": name,
+                           "kind": KIND_INPUT, "data_type": 1,
+                           "shape": tuple(shape)})
+        return uid
+
+    def parameter(self, array: np.ndarray, name: str = "") -> str:
+        uid = self._uid("Parameter")
+        self._vars.append({"type": "Variable", "uid": uid, "name": name,
+                           "kind": KIND_PARAMETER, "data_type": 1,
+                           "shape": tuple(np.shape(array)),
+                           "value": np.asarray(array, np.float32)})
+        return uid
+
+    def op(self, op_name: str, inputs: List[str], name: str = "",
+           **attrs) -> str:
+        uid = self._uid(op_name)
+        self._funcs.append({
+            "type": "PrimitiveFunction", "uid": uid, "name": name,
+            "op": OPS[op_name], "inputs": list(inputs),
+            "attributes": dict(attrs)})
+        return uid
+
+    def model(self, root: str) -> Dict[str, Any]:
+        return {"version": 1, "type": "CompositeFunction", "root": root,
+                "functions": list(self._funcs), "inputs": list(self._vars)}
+
+    def save(self, path: str, root: str) -> None:
+        save_model_dict(path, self.model(root))
+
+
+# ---- jax evaluator --------------------------------------------------------
+
+def build_eval(model: Dict[str, Any],
+               output_node: Optional[str] = None):
+    """Compile the CompositeFunction into ``(apply_fn, params)``.
+
+    ``apply_fn(params, batch)`` evaluates the graph with the batch axis
+    leading; ``params`` maps parameter uid → array (a pytree, so the
+    generic DNNModel minibatch/bf16 machinery applies).  ``output_node``
+    cuts the graph at the function whose *name* or *uid* matches — the
+    reference CNTKModel's layer surgery."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    var_by_uid = {v["uid"]: v for v in model["inputs"]}
+    funcs = model["functions"]
+    fn_by_uid = {f["uid"]: f for f in funcs}
+    params = {v["uid"]: np.asarray(v["value"], np.float32)
+              for v in model["inputs"]
+              if v["kind"] in (KIND_PARAMETER, KIND_CONSTANT)}
+
+    root = model["root"]
+    if output_node:
+        matches = [f["uid"] for f in funcs
+                   if f["uid"] == output_node or f["name"] == output_node]
+        if not matches:
+            names = sorted({f["name"] or f["uid"] for f in funcs})
+            raise ValueError(
+                f"output node {output_node!r} not found; graph nodes: "
+                f"{names}")
+        root = matches[0]
+
+    input_uids = [v["uid"] for v in model["inputs"]
+                  if v["kind"] == KIND_INPUT]
+    if len(input_uids) != 1:
+        raise ValueError(
+            f"expected exactly one input variable, found {len(input_uids)}")
+    input_uid = input_uids[0]
+
+    def apply_fn(params, batch):
+        cache: Dict[str, Any] = {input_uid: batch}
+
+        def ev(uid):
+            if uid in cache:
+                return cache[uid]
+            if uid in params:
+                return jnp.asarray(params[uid])
+            if uid in var_by_uid:      # parameter stripped? shouldn't happen
+                raise KeyError(f"variable {uid} has no value")
+            f = fn_by_uid[uid]
+            ins = [ev(i) for i in f["inputs"]]
+            a = f.get("attributes", {})
+            op = _OP_NAME.get(f["op"])
+            if op == "Times":
+                out = jnp.tensordot(ins[0], ins[1], axes=([-1], [0]))
+            elif op == "Plus":
+                out = ins[0] + ins[1]
+            elif op == "Minus":
+                out = ins[0] - ins[1]
+            elif op == "ElementTimes":
+                out = ins[0] * ins[1]
+            elif op == "ReLU":
+                out = jnp.maximum(ins[0], 0)
+            elif op == "Sigmoid":
+                out = 1.0 / (1.0 + jnp.exp(-ins[0]))
+            elif op == "Tanh":
+                out = jnp.tanh(ins[0])
+            elif op == "Softmax":
+                out = jnp.exp(ins[0] - jnp.max(ins[0], -1, keepdims=True))
+                out = out / jnp.sum(out, -1, keepdims=True)
+            elif op == "Reshape":
+                shape = tuple(int(d) for d in a["newShape"])
+                out = ins[0].reshape((ins[0].shape[0],) + shape)
+            elif op == "Convolution":
+                # kernel (C_out, C_in, KH, KW); data (N, C, H, W)
+                strides = tuple(int(s) for s in a.get("strides", (1, 1)))
+                pad = "SAME" if a.get("autoPadding", True) else "VALID"
+                out = lax.conv_general_dilated(
+                    ins[1], ins[0], window_strides=strides, padding=pad,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            elif op == "Pooling":
+                w = tuple(int(s) for s in a["poolingWindowShape"])
+                strides = tuple(int(s) for s in a.get("strides", w))
+                kind = int(a.get("poolingType", 0))
+                window = (1, 1) + w
+                st = (1, 1) + strides
+                if kind == 0:
+                    out = lax.reduce_window(
+                        ins[0], -jnp.inf, lax.max, window, st, "VALID")
+                else:
+                    out = lax.reduce_window(
+                        ins[0], 0.0, lax.add, window, st, "VALID") \
+                        / float(np.prod(w))
+            elif op == "BatchNormalization":
+                # inputs: x, scale, bias, run_mean, run_variance
+                x, scale, bias, mean, var = ins[:5]
+                eps = float(a.get("epsilon", 1e-5))
+                shp = (1, -1) + (1,) * (x.ndim - 2)
+                out = (x - mean.reshape(shp)) \
+                    * (scale.reshape(shp)
+                       / jnp.sqrt(var.reshape(shp) + eps)) \
+                    + bias.reshape(shp)
+            elif op == "Splice":
+                out = jnp.concatenate(ins, axis=int(a.get("axis", -1)))
+            elif op == "Combine":
+                out = ins[0] if len(ins) == 1 else tuple(ins)
+            else:
+                raise NotImplementedError(
+                    f"CNTK op {f['op']} ({op or 'unknown'}) is not in "
+                    f"this build's evaluator subset: {sorted(OPS)}")
+            cache[uid] = out
+            return out
+
+        return ev(root)
+
+    return apply_fn, params
