@@ -136,7 +136,8 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                       typeConverter=TypeConverters.toFloat)
     histogramMethod = Param("histogramMethod",
                             "TPU histogram backend: auto, dot16, onehot, "
-                            "segment, pallas, pallas_bf16", default="auto",
+                            "segment, pallas, pallas_bf16, pallas_fused (segment "
+                            "gather fused in-kernel)", default="auto",
                             typeConverter=TypeConverters.toString)
     categoricalSlotIndexes = Param(
         "categoricalSlotIndexes",

@@ -538,20 +538,47 @@ def pack_bins_u32(bins: jnp.ndarray) -> jnp.ndarray:
 
 
 def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
-                  cfg: GrowerConfig, bins_pk=None):
+                  cfg: GrowerConfig, bins_pk=None, binsT=None):
     """Histogram the contiguous ``row_order[off:off+cnt]`` segment via the
     smallest power-of-two bucket gather.  Local (no psum) — the caller
     reduces over the data axis, keeping collectives out of switch
     branches.  On the CPU backend the gather fuses into the native FFI
     kernel (no (size, f) materialization).  With ``bins_pk`` (see
     :func:`pack_bins_u32`) the row gather reads the packed words and the
-    shift/mask unpack fuses into the histogram prologue."""
+    shift/mask unpack fuses into the histogram prologue.  With
+    ``hist_method='pallas_fused'`` (and ``binsT`` provided) the row
+    gather happens INSIDE the Pallas kernel against a VMEM-resident
+    binsT block — no (size, f) sub-matrix ever touches HBM (PERF.md
+    headroom item: the bucket-gather rivals the histogram itself)."""
     from ..ops.histogram import native_segment_hist
     if cfg.hist_method in ("auto", "native"):
         fused = native_segment_hist(bins, gh, row_order, off, cnt,
                                     cfg.num_bins)
         if fused is not None:
             return fused
+    if (cfg.hist_method == "pallas_fused" and binsT is not None
+            and cfg.num_bins <= 256):
+        from ..ops.pallas_histogram import (FUSED_MAX_ROWS,
+                                            histogram_pallas_fused)
+        if n <= FUSED_MAX_ROWS:
+            import jax as _jax
+            interp = _jax.default_backend() not in ("tpu", "axon")
+
+            def make_f(size):
+                def fn(_):
+                    seg = jax.lax.dynamic_slice(row_order, (off,), (size,))
+                    valid = jnp.arange(size, dtype=jnp.int32) < cnt
+                    rows = jnp.minimum(seg, n - 1)
+                    gh_sub = jnp.take(gh, rows, axis=0) * \
+                        valid.astype(jnp.float32)[:, None]
+                    return histogram_pallas_fused(
+                        binsT, gh_sub, rows, cfg.num_bins, size,
+                        interpret=interp)
+                return fn
+
+            branch = jnp.searchsorted(jnp.asarray(sizes, jnp.int32), cnt,
+                                      side="left")
+            return jax.lax.switch(branch, [make_f(s) for s in sizes], 0)
     f_cols = bins.shape[1]
 
     def make(size):
@@ -813,7 +840,7 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
                 child_cnt = jnp.where(use_right, cnt_r_p, cnt_l_p)
                 hist_small = _segment_hist(bins, gh, row_order, child_off,
                                            child_cnt, n, sizes, cfg,
-                                           bins_pk=bins_pk)
+                                           bins_pk=bins_pk, binsT=binsT)
                 if efb is not None:
                     hist_small = _efb_expand(hist_small, efb)
                 if cfg.axis_name is not None and not _is_voting(cfg):

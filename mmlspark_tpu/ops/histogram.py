@@ -241,7 +241,10 @@ def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         return _hist_dot16(bins, gh, num_bins, row_chunk)
     if method == "onehot":
         return _hist_onehot(bins, gh, num_bins, row_chunk)
-    if method in ("pallas", "pallas_bf16"):
+    if method in ("pallas", "pallas_bf16", "pallas_fused"):
+        # 'pallas_fused' fuses the SEGMENT gather (grower._segment_hist);
+        # direct full-matrix calls like the root histogram have nothing
+        # to gather and run the plain kernel
         from .pallas_histogram import BMAX, histogram_pallas
         if num_bins > BMAX:   # kernel folds 16x16 nibbles; fall back
             return _hist_dot16(bins, gh, num_bins, row_chunk)

@@ -77,6 +77,119 @@ def _hist_kernel(binsT_ref, gh_ref, out_ref, lo_scr, hi_scr, *, accum_dtype):
             preferred_element_type=jnp.float32)           # (128, 128)
 
 
+def _fused_kernel(binsT_ref, idx_ref, gh_ref, out_ref, lo_scr, hi_scr, *,
+                  accum_dtype):
+    """One (feature_block, idx_chunk) grid step of the FUSED
+    gather+histogram: the full (FB, n) binsT block is VMEM-resident
+    across the idx-chunk axis, so the per-segment row gather happens
+    in-register instead of materializing a (size, f) sub-matrix in HBM
+    (PERF.md headroom: the bucket-gather costs as much as the dot16
+    histogram itself, ~26 ns/row)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    idx = idx_ref[...]                          # (C,) i32, pre-clamped
+    g = gh_ref[...].astype(jnp.float32)         # (C, 3), pre-masked
+    c = idx.shape[0]
+
+    iota16 = jax.lax.broadcasted_iota(jnp.int32, (c, LO), 1)
+    for f in range(FB):
+        col = jnp.take(binsT_ref[f, :], idx, axis=0).astype(
+            jnp.int32)[:, None]                 # VMEM gather
+        lo_scr[:, f * LO:(f + 1) * LO] = (col % LO == iota16).astype(
+            accum_dtype)
+        hi_scr[:, f * LO:(f + 1) * LO] = (col // LO == iota16).astype(
+            jnp.float32)
+
+    lo_oh = lo_scr[...]
+    hi_oh = hi_scr[...]
+    for ch in range(3):
+        rhs = (hi_oh * g[:, ch][:, None]).astype(accum_dtype)
+        out_ref[0, ch] += jax.lax.dot_general(
+            lo_oh, rhs, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+#: VMEM budget gate for the fused kernel: the (FB, n) uint8 binsT block
+#: must stay resident (plus ~1 MB of one-hot scratch and the (3,128,128)
+#: accumulator), so n is capped well under VMEM/FB bytes.
+FUSED_MAX_ROWS = 4_000_000
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "size", "row_chunk",
+                                    "accum", "interpret"))
+def histogram_pallas_fused(binsT, gh_sub, idx, num_bins: int, size: int,
+                           row_chunk: int = 1024, accum: str = "float32",
+                           interpret: bool = False) -> jnp.ndarray:
+    """Segment histogram with the row gather fused into the kernel.
+
+    Args:
+      binsT: ``(f, n)`` uint8/int32 TRANSPOSED binned matrix (the boost
+        scan already keeps ``binsT`` hoisted per fit).
+      gh_sub: ``(size, 3)`` float32 — the segment's gradient rows,
+        gathered by the caller (12 B/row, cheap) and ZERO for padding.
+      idx: ``(size,)`` int32 — the segment row ids (``row_order`` slice),
+        clamped into ``[0, n)``; padded entries may repeat a valid row
+        (their gh is zero).
+      size: static bucket size (the grower's power-of-two ladder).
+
+    Returns ``(f, num_bins, 3)`` float32, bit-comparable to gathering
+    then calling :func:`histogram_pallas`.
+    """
+    if num_bins > BMAX:
+        raise ValueError(f"pallas fused histogram supports ≤{BMAX} bins, "
+                         f"got {num_bins}")
+    f, n = binsT.shape
+    if n > FUSED_MAX_ROWS:
+        raise ValueError(
+            f"fused kernel needs the (8, n) binsT block VMEM-resident; "
+            f"n={n} exceeds {FUSED_MAX_ROWS}")
+    accum_dtype = jnp.bfloat16 if accum == "bfloat16" else jnp.float32
+
+    c = min(row_chunk, size)
+    f_pad = (-f) % FB
+    binsT = jnp.pad(binsT, ((0, f_pad), (0, 0)))
+    fp = f + f_pad
+    nfb = fp // FB
+    s_pad = (-size) % c
+    if s_pad:
+        idx = jnp.pad(idx, (0, s_pad))
+        gh_sub = jnp.pad(gh_sub, ((0, s_pad), (0, 0)))
+
+    grid = (nfb, (size + s_pad) // c)
+    out = pl.pallas_call(
+        functools.partial(_fused_kernel, accum_dtype=accum_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((FB, n), lambda i, j: (i, 0)),   # VMEM-resident
+            pl.BlockSpec((c,), lambda i, j: (j,)),
+            pl.BlockSpec((c, 3), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 3, FB * LO, FB * LO),
+                               lambda i, j: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nfb, 3, FB * LO, FB * LO),
+                                       jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((c, FB * LO), accum_dtype),
+            pltpu.VMEM((c, FB * LO), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * (size + s_pad) * nfb * 128 * 128,
+            bytes_accessed=fp * n + (size + s_pad) * 16,
+            transcendentals=0),
+    )(binsT.astype(jnp.int32) if interpret else binsT,
+      idx.astype(jnp.int32), gh_sub)
+    out = out.reshape(nfb, 3, FB, LO, FB, LO)
+    diag = out[:, :, jnp.arange(FB), :, jnp.arange(FB), :]
+    hist = diag.transpose(1, 0, 4, 3, 2).reshape(fp, BMAX, 3)
+    return hist[:f, :num_bins, :]
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_chunk", "accum",
                                     "interpret"))

@@ -52,6 +52,11 @@ step 2400 python tools/bench_gather.py --sizes 2048 8192 32768 --reps 65
 # 4. A/B the packed gather through the real bench path
 step 900 bash -c 'python bench.py --pass-through packed_gather=true | tee artifacts/bench_tpu_session_packed.out'
 
+# 4b. A/B the FUSED Pallas gather+histogram (r5: the PERF.md headroom
+#     item — in-kernel VMEM row gather, no (size, f) HBM sub-matrix).
+#     First Mosaic compile of the fused kernel may be slow; budget wide.
+step 1800 bash -c 'python bench.py --pass-through histogram_method=pallas_fused | tee artifacts/bench_tpu_session_fused.out'
+
 # 5. secondary BASELINE target: ImageFeaturizer imgs/sec on-chip
 step 900 bash -c 'python tools/bench_featurizer.py | tee artifacts/bench_featurizer_tpu.out'
 
