@@ -11,7 +11,7 @@ mmlspark's stage names and params so existing pipelines port directly.
 See SURVEY.md at the repo root for the reference layer map this build tracks.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from . import core
 from .core import (DataTable, Pipeline, PipelineModel, Estimator, Transformer,
