@@ -59,8 +59,10 @@ def main():
                  | (pk_np[..., 3].astype(np.uint32) << 24)).astype(np.int32)
 
     bins_d = jnp.asarray(bins_np)
+    binsT_d = jnp.asarray(bins_np.T)     # fit-invariant, like the scan's
     packed_d = jnp.asarray(packed_np)
     gh_d = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+    interp = jax.default_backend() == "cpu"
 
     def unpack(pk):                      # (s, f4) int32 -> (s, f) int32
         u = pk.astype(jnp.uint32)
@@ -97,9 +99,21 @@ def main():
             gh = jnp.take(gh_d, r, axis=0)
             return compute_histogram(sub, gh, B, method="dot16").sum()
 
-        return idx0, {"gather_u8": gather_u8, "gather_pk": gather_pk,
-                      "hist_dot16": hist_only, "fused_u8": fused_u8,
-                      "fused_pk": fused_pk}
+        def pallas_fused(r):
+            # r5: the in-kernel VMEM gather (ops/pallas_histogram.py
+            # histogram_pallas_fused) — gather + histogram in ONE kernel
+            from mmlspark_tpu.ops.pallas_histogram import (
+                histogram_pallas_fused)
+            gh = jnp.take(gh_d, r, axis=0)
+            return histogram_pallas_fused(binsT_d, gh, r, B, size,
+                                          interpret=interp).sum()
+
+        variants = {"gather_u8": gather_u8, "gather_pk": gather_pk,
+                    "hist_dot16": hist_only, "fused_u8": fused_u8,
+                    "fused_pk": fused_pk}
+        if B <= 256:
+            variants["pallas_fused"] = pallas_fused
+        return idx0, variants
 
     def slope(fn, idx0, reps):
         def make(reps):
