@@ -25,13 +25,20 @@ def main():
     ap.add_argument("--features", type=int, default=50)
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend via the live-config path "
+                         "(the env-var route hangs init in this image)")
     args = ap.parse_args()
 
     import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import functools
+
     import jax.numpy as jnp
     import numpy as np
-    from mmlspark_tpu.gbdt.grower import GrowerConfig, make_feat_info
-    from mmlspark_tpu.gbdt.engine import _boost_step
+    from mmlspark_tpu.gbdt.grower import (GrowerConfig, grow_tree,
+                                          make_feat_info)
     from mmlspark_tpu.gbdt.objectives import BinaryObjective
 
     backend = jax.default_backend()
@@ -43,9 +50,13 @@ def main():
     X = rng.normal(size=(n, f)).astype(np.float32)
     logits = X[:, 0] * 1.5 + X[:, 1] * X[:, 2] + np.sin(X[:, 3] * 2)
     y = (logits > 0).astype(np.float32)
+    # uint8 bins + hoisted binsT: the PRODUCTION scan path's layout
+    # (a per-step int32 transpose would dominate the trace and hide the
+    # actual glue)
     bins = jnp.asarray(
         np.clip((X - X.min(0)) / (np.ptp(X, 0) + 1e-9) * 255, 0, 255),
-        jnp.int32)
+        jnp.uint8)
+    binsT = jnp.transpose(bins)
     labels = jnp.asarray(y)
     weights = jnp.ones(n, jnp.float32)
     bag = jnp.ones(n, jnp.float32)
@@ -55,22 +66,26 @@ def main():
     cfg = GrowerConfig(num_leaves=31, num_bins=256)
     scores = jnp.zeros(n, jnp.float32)
 
+    @jax.jit
+    def boost_step(binsA, binsTA, scoresA):
+        g, h = obj.grad_hess(scoresA, labels, weights)
+        gh = jnp.stack([g * bag, h * bag, bag], axis=1)
+        tree, row_leaf = grow_tree(binsA, gh, fi, cfg, binsT=binsTA)
+        return tree, scoresA + 0.1 * tree.leaf_value[row_leaf]
+
     # warm-up/compile
-    tree, scores = _boost_step(bins, scores, labels, weights, bag, fi,
-                               obj, cfg, 0.1)
+    tree, scores = boost_step(bins, binsT, scores)
     jax.block_until_ready((tree, scores))
     t0 = time.perf_counter()
     for _ in range(3):
-        tree, scores = _boost_step(bins, scores, labels, weights, bag, fi,
-                                   obj, cfg, 0.1)
+        tree, scores = boost_step(bins, binsT, scores)
     jax.block_until_ready((tree, scores))
     per_step = (time.perf_counter() - t0) / 3
     print(f"steady-state boost step: {per_step*1e3:.1f} ms")
 
     with jax.profiler.trace(out_dir):
         for _ in range(args.steps):
-            tree, scores = _boost_step(bins, scores, labels, weights, bag,
-                                       fi, obj, cfg, 0.1)
+            tree, scores = boost_step(bins, binsT, scores)
         jax.block_until_ready((tree, scores))
     print(f"trace written to {out_dir}")
     summarize(out_dir, args.steps)
