@@ -298,3 +298,29 @@ class TestPallasFused:
             np.testing.assert_array_equal(s.split_feature, t.split_feature)
             np.testing.assert_allclose(s.leaf_value, t.leaf_value,
                                        rtol=1e-5, atol=1e-7)
+
+    def test_fused_fit_matches_dot16_under_data_mesh(self):
+        """pallas_fused inside the shard_mapped grower: the in-kernel
+        gather runs on each shard's local binsT block; psum composes the
+        partial histograms as usual — forest equality vs dot16."""
+        from mmlspark_tpu.core.mesh import build_mesh
+        from mmlspark_tpu.gbdt import fit_bin_mapper
+        from mmlspark_tpu.gbdt.engine import TrainParams, train
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(640, 8))
+        y = (X[:, 0] - X[:, 2] > 0).astype(np.float64)
+        mapper = fit_bin_mapper(X, max_bin=63)
+        bins = mapper.transform_packed(X)
+
+        def fit(method):
+            return train(bins, y, None, mapper, get_objective("binary"),
+                         TrainParams(num_iterations=2, num_leaves=7,
+                                     min_data_in_leaf=5, max_bin=63,
+                                     histogram_method=method, verbosity=0),
+                         mesh=build_mesh(data=8, feature=1))
+        a, b = fit("pallas_fused"), fit("dot16")
+        for s, t in zip(a.trees, b.trees):
+            np.testing.assert_array_equal(s.split_feature, t.split_feature)
+            np.testing.assert_allclose(s.leaf_value, t.leaf_value,
+                                       rtol=1e-5, atol=1e-7)
