@@ -46,6 +46,16 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                         "Input images are BGR (OpenCV order) and will be "
                         "converted to RGB", default=False,
                         typeConverter=TypeConverters.toBool)
+    cntkModelLocation = Param(
+        "cntkModelLocation",
+        "Featurize through a native CNTK-v2 .model graph instead of the "
+        "flax ResNet — the reference's own ImageFeaturizer architecture "
+        "(ImageTransformer -> UnrollImage -> headless CNTKModel)",
+        default="", typeConverter=TypeConverters.toString)
+    cntkOutputNodeName = Param(
+        "cntkOutputNodeName",
+        "Layer-surgery cut point in the CNTK graph (empty = root)",
+        default="", typeConverter=TypeConverters.toString)
 
     def __init__(self, variables: Any = None, **kwargs):
         kwargs.setdefault("inputCol", "image")
@@ -99,6 +109,31 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             prep.colorFormat("rgb")
         prep.normalize(_IMAGENET_MEAN, _IMAGENET_STD)
         prepped = prep._transform(table)
+
+        cntk_loc = self.getOrDefault("cntkModelLocation")
+        if cntk_loc:
+            # the reference's pipeline shape: headless CNTK graph eval.
+            # Our CNTK conv convention is (C, H, W); ImageTransformer
+            # emits (H, W, C) — transpose per row, flatten the surgery
+            # output to the flat feature vector UnrollImage would emit.
+            from ..dnn.model import CNTKModel
+            col = prepped["__prepped__"]
+            chw = np.stack([np.asarray(v, np.float32).transpose(2, 0, 1)
+                            for v in col])
+            dnn = CNTKModel(inputCol="__chw__",
+                            outputCol=self.getOutputCol(),
+                            miniBatchSize=self.getMiniBatchSize())
+            node = self.getOrDefault("cntkOutputNodeName")
+            if node:
+                dnn.setParams(outputNodeName=node)
+            dnn.setModelLocation(cntk_loc)
+            out = dnn._transform(
+                prepped.withColumn("__chw__", chw))
+            feats = np.asarray(out[self.getOutputCol()])
+            if feats.ndim > 2:
+                out = out.withColumn(self.getOutputCol(),
+                                     feats.reshape(len(feats), -1))
+            return out.drop("__prepped__", "__chw__")
 
         dnn = ResNetFeaturizerModel(
             variables=self._ensure_variables(),

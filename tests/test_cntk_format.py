@@ -178,3 +178,25 @@ class TestCNTKModelTransformer:
         loaded = CNTKModel.load(sd)
         got = np.stack(list(loaded.transform({"f": list(X)})["s"]))
         np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+class TestImageFeaturizerCNTKRoute:
+    def test_featurizer_through_cntk_graph_with_surgery(self):
+        """The reference's own ImageFeaturizer shape (ImageTransformer ->
+        headless CNTKModel): features come from the golden CNTK graph cut
+        at pool1, flattened to the UnrollImage-style vector."""
+        from mmlspark_tpu.image import ImageFeaturizer
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, size=(6, 8, 8, 3)).astype(np.uint8)
+        f = ImageFeaturizer(
+            imageHeight=8, imageWidth=8, miniBatchSize=4,
+            cntkModelLocation=os.path.join(GOLDEN, "cntk_convnet.model"),
+            cntkOutputNodeName="pool1")
+        feats = np.asarray(f.transform({"image": list(imgs)})["features"])
+        assert feats.shape == (6, 64)
+        # full-graph route gives the 2-logit head instead
+        f2 = ImageFeaturizer(
+            imageHeight=8, imageWidth=8, miniBatchSize=4,
+            cntkModelLocation=os.path.join(GOLDEN, "cntk_convnet.model"))
+        logits = np.asarray(f2.transform({"image": list(imgs)})["features"])
+        assert logits.shape == (6, 2)
