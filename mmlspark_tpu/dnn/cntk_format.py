@@ -318,7 +318,12 @@ def build_eval(model: Dict[str, Any],
             elif op == "Convolution":
                 # kernel (C_out, C_in, KH, KW); data (N, C, H, W)
                 strides = tuple(int(s) for s in a.get("strides", (1, 1)))
-                pad = "SAME" if a.get("autoPadding", True) else "VALID"
+                ap = a.get("autoPadding", True)
+                if isinstance(ap, (list, tuple)):
+                    # CNTK spells autoPadding per dimension; [False,
+                    # False] must select VALID, not truthy-SAME
+                    ap = any(bool(x) for x in ap)
+                pad = "SAME" if ap else "VALID"
                 out = lax.conv_general_dilated(
                     ins[1], ins[0], window_strides=strides, padding=pad,
                     dimension_numbers=("NCHW", "OIHW", "NCHW"))

@@ -174,18 +174,25 @@ _CKPT_FILE = "boost_checkpoint.npz"       # meta + loop state, atomic
 _CKPT_CHUNK = "boost_chunk_{:04d}.npz"    # one per tree chunk, write-once
 
 
-def _ckpt_fingerprint(n, f, K, params, labels, bins) -> str:
+def _ckpt_fingerprint(n, f, K, params, labels, bins, weights,
+                      init_scores) -> str:
     """Identity of a fit for resume safety: shapes, every param that
     shapes the boosting trajectory (checkpoint_dir itself excluded so
     moving the directory doesn't orphan the snapshot), AND a digest of
-    the data — full labels plus a strided sample of the binned matrix —
-    so a same-shape fit on DIFFERENT data starts fresh instead of
-    silently blending two datasets."""
+    the data — full labels, weights, init scores (the continued-training
+    margins: a re-run with a different initModelPath must NOT resume the
+    old trajectory) plus a strided sample of the binned matrix — so a
+    same-shape fit on DIFFERENT inputs starts fresh instead of silently
+    blending two fits."""
     import hashlib
     d = {k: v for k, v in params.__dict__.items() if k != "checkpoint_dir"}
     h = hashlib.sha256(
         f"{n}|{f}|{K}|{sorted(d.items())!r}".encode("utf-8"))
     h.update(np.ascontiguousarray(np.asarray(labels)).tobytes())
+    h.update(b"w" if weights is None else
+             np.ascontiguousarray(np.asarray(weights)).tobytes())
+    h.update(b"i" if init_scores is None else
+             np.ascontiguousarray(np.asarray(init_scores)).tobytes())
     bins_np = np.asarray(bins)
     h.update(np.ascontiguousarray(
         bins_np[:: max(1, len(bins_np) // 4096)]).tobytes())
@@ -971,7 +978,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     if ckpt:
         # bounded chunks = bounded lost work after a process death
         chunk = min(chunk, 32)
-        ckpt_fp = _ckpt_fingerprint(n, f, K, params, labels, bins)
+        ckpt_fp = _ckpt_fingerprint(n, f, K, params, labels, bins, w,
+                                    init_scores)
 
     trees_chunks: List[TreeArrays] = []
     stop_iter = T

@@ -564,6 +564,8 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
             import jax as _jax
             interp = _jax.default_backend() not in ("tpu", "axon")
 
+            f_out = bins.shape[1]
+
             def make_f(size):
                 def fn(_):
                     seg = jax.lax.dynamic_slice(row_order, (off,), (size,))
@@ -571,9 +573,11 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
                     rows = jnp.minimum(seg, n - 1)
                     gh_sub = jnp.take(gh, rows, axis=0) * \
                         valid.astype(jnp.float32)[:, None]
+                    # binsT arrives pre-padded to the 8-feature fold
+                    # (see _grow_tree_impl); slice back to real columns
                     return histogram_pallas_fused(
                         binsT, gh_sub, rows, cfg.num_bins, size,
-                        interpret=interp)
+                        interpret=interp)[:f_out]
                 return fn
 
             branch = jnp.searchsorted(jnp.asarray(sizes, jnp.int32), cnt,
@@ -718,6 +722,14 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
     # precompute it once and pass it in; the default covers direct calls.
     if binsT is None:
         binsT = bins.T
+    binsT_hist = binsT
+    if cfg.hist_method == "pallas_fused":
+        # pad the feature axis to the kernel's 8-feature fold ONCE per
+        # grow — a per-call jnp.pad inside the split loop would copy the
+        # whole (f, n) matrix at every segment histogram
+        fp8 = (-binsT.shape[0]) % 8
+        if fp8:
+            binsT_hist = jnp.pad(binsT, ((0, fp8), (0, 0)))
     bins_pk = None
     if (cfg.packed_gather and cfg.compact_rows
             and bins.dtype == jnp.uint8):
@@ -840,7 +852,8 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
                 child_cnt = jnp.where(use_right, cnt_r_p, cnt_l_p)
                 hist_small = _segment_hist(bins, gh, row_order, child_off,
                                            child_cnt, n, sizes, cfg,
-                                           bins_pk=bins_pk, binsT=binsT)
+                                           bins_pk=bins_pk,
+                                           binsT=binsT_hist)
                 if efb is not None:
                     hist_small = _efb_expand(hist_small, efb)
                 if cfg.axis_name is not None and not _is_voting(cfg):

@@ -419,55 +419,47 @@ class MultiprocessHTTPServer:
         for p in self._procs:
             p.start()
         import socket as _socket
-        # a worker that dies during spawn (classic cause: the calling
-        # script lacks an `if __name__ == "__main__":` guard, so spawn's
-        # re-import re-runs it) must fail FAST, not hang accept();
-        # external workers get join_timeout to dial in
-        # 60 s: a loaded single-core host can take >20 s just to spawn
-        # and import N fresh worker interpreters
-        self._listener.settimeout(
-            60.0 if self._procs else self._join_timeout)
-        for _ in self.addresses:       # one connection per worker slot
+        import time
+        # Accept until every worker slot has said a (tokened) hello or
+        # the budget runs out — NOT exactly num_workers connections: a
+        # rejected or garbage peer must not consume a slot's accept and
+        # lock the legit worker out (a single adversarial connect would
+        # otherwise be a join DoS).  Budgets: 60 s for spawned workers
+        # (a loaded single-core host can take >20 s just to spawn and
+        # import N interpreters), join_timeout for external ones.
+        budget = 60.0 if self._procs else self._join_timeout
+        deadline = time.monotonic() + budget
+        self._listener.settimeout(0.2)
+        got_conn = False
+        while (any(not a for a in self.addresses)
+               and time.monotonic() < deadline):
             try:
                 conn, _ = self._listener.accept()
-            except TimeoutError as e:
-                xaddr = self.exchange_address  # before stop() closes it
-                self.stop()
-                if self._procs:
-                    raise RuntimeError(
-                        "worker processes failed to connect; if this is "
-                        "a script, MultiprocessHTTPServer must be "
-                        "started under `if __name__ == '__main__':` "
-                        "(spawn re-imports the main module)") from e
-                raise RuntimeError(
-                    f"external workers failed to join {xaddr} within "
-                    f"{self._join_timeout}s; start one "
-                    f"join_exchange(...) per worker slot, passing this "
-                    f"server's .token (a worker with a missing or "
-                    f"wrong token is dropped at hello)") from e
+            except (TimeoutError, OSError):
+                continue
+            got_conn = True
             conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
             idx = len(self._conns)
             self._conns.append(conn)
             self._wlocks.append(threading.Lock())
             threading.Thread(target=self._reader, args=(idx, conn),
                              daemon=True).start()
-        # hello messages fill addresses (readers handle them); external
-        # workers get the full join budget — a loaded host can take
-        # seconds between connect and hello
-        import time
-        deadline = (20.0 if self._procs else self._join_timeout) / 0.1
-        while any(not a for a in self.addresses) and deadline:
-            time.sleep(0.1)
-            deadline -= 1
         if any(not a for a in self.addresses):
             missing = [i for i, a in enumerate(self.addresses) if not a]
+            xaddr = self.exchange_address  # before stop() closes it
             self.stop()
+            if self._procs and not got_conn:
+                raise RuntimeError(
+                    "worker processes failed to connect; if this is "
+                    "a script, MultiprocessHTTPServer must be "
+                    "started under `if __name__ == '__main__':` "
+                    "(spawn re-imports the main module)")
             raise RuntimeError(
-                f"worker slots {missing} never reported their ports "
-                f"(invalid/duplicate worker ids? each join_exchange "
-                f"needs a unique id in [0, {len(self.addresses)}); a "
-                f"missing or wrong token= also lands here — pass this "
-                f"server's .token to every join_exchange)")
+                f"worker slots {missing} never joined {xaddr} within "
+                f"{budget}s: start one join_exchange(...) per slot with "
+                f"a unique id in [0, {len(self.addresses)}) and this "
+                f"server's .token (invalid/duplicate ids and missing or "
+                f"wrong tokens are dropped and land here)")
         return self
 
     def _reader(self, idx: int, conn) -> None:
@@ -477,6 +469,14 @@ class MultiprocessHTTPServer:
             try:
                 msg = json.loads(line)
             except ValueError:
+                if not authed:
+                    # garbage before auth: a non-protocol peer must not
+                    # stay parked on the exchange
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
                 continue
             op = msg.get("op")
             if not authed:

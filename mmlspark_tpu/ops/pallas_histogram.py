@@ -115,8 +115,9 @@ def _fused_kernel(binsT_ref, idx_ref, gh_ref, out_ref, lo_scr, hi_scr, *,
 
 #: VMEM budget gate for the fused kernel: the (FB, n) uint8 binsT block
 #: must stay resident (plus ~1 MB of one-hot scratch and the (3,128,128)
-#: accumulator), so n is capped well under VMEM/FB bytes.
-FUSED_MAX_ROWS = 4_000_000
+#: accumulator), so n is capped under VMEM/FB bytes with headroom —
+#: 1.5M rows = 12 MB block on a ~16 MB-VMEM core.
+FUSED_MAX_ROWS = 1_500_000
 
 
 @functools.partial(jax.jit,
@@ -152,7 +153,10 @@ def histogram_pallas_fused(binsT, gh_sub, idx, num_bins: int, size: int,
 
     c = min(row_chunk, size)
     f_pad = (-f) % FB
-    binsT = jnp.pad(binsT, ((0, f_pad), (0, 0)))
+    if f_pad:
+        # direct callers only — the grower pre-pads binsT once per tree
+        # so this whole-matrix copy never runs in the split loop
+        binsT = jnp.pad(binsT, ((0, f_pad), (0, 0)))
     fp = f + f_pad
     nfb = fp // FB
     s_pad = (-size) % c

@@ -31,6 +31,14 @@ from typing import Callable, Iterable, Iterator, List, Optional
 import numpy as np
 
 
+def _qid_digest(v) -> int:
+    """64-bit stable digest of an original query id (the spans-shards
+    cross-check compares these across hosts)."""
+    import hashlib
+    return int.from_bytes(
+        hashlib.sha1(str(v).encode("utf-8")).digest()[:8], "big")
+
+
 def spark_available() -> bool:
     try:
         import pyspark  # noqa: F401
@@ -157,6 +165,7 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
             y_local = np.zeros(0, np.float64)
             w_local = np.zeros(0, np.float64)
             q_local = np.zeros(0, np.int32)
+            qdig_local = np.zeros(0, np.uint64)
         else:
             first = pdf[feature_col].iloc[0]
             X = (np.stack([np.asarray(v, np.float64)
@@ -181,11 +190,8 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
                 # the engine's query-spans-shards guard would go blind —
                 # these digests are allgathered below to keep the
                 # fail-fast on non-group-contiguous ingestion
-                import hashlib
                 qdig_local = np.asarray(
-                    [int.from_bytes(hashlib.sha1(
-                        str(v).encode("utf-8")).digest()[:8], "big")
-                     for v in uniq_q], np.uint64)
+                    [_qid_digest(v) for v in uniq_q], np.uint64)
             else:
                 q_local = np.zeros(0, np.int32)
                 qdig_local = np.zeros(0, np.uint64)
@@ -237,10 +243,9 @@ def executor_train_fn(mapper, params, num_tasks: int, coordinator: str,
                     key = (int(hi), int(lo))
                     if key in owner and owner[key] != d:
                         h64 = (int(hi) << 32) | int(lo)
-                        local = [str(v) for v in uniq_q
-                                 if int.from_bytes(hashlib.sha1(
-                                     str(v).encode("utf-8")).digest()[:8],
-                                     "big") == h64] if len(q_local) else []
+                        local = ([str(v) for v in uniq_q
+                                  if _qid_digest(v) == h64]
+                                 if len(q_local) else [])
                         name = local[0] if local else f"digest {h64:#x}"
                         raise ValueError(
                             f"query {name} spans shards {owner[key]} and "

@@ -200,3 +200,21 @@ class TestImageFeaturizerCNTKRoute:
             cntkModelLocation=os.path.join(GOLDEN, "cntk_convnet.model"))
         logits = np.asarray(f2.transform({"image": list(imgs)})["features"])
         assert logits.shape == (6, 2)
+
+    def test_conv_valid_padding_list_attr(self, tmp_path):
+        """autoPadding=[False, False] (CNTK's per-dimension spelling)
+        must select VALID — a truthy non-empty list previously picked
+        SAME (code-review r5)."""
+        rng = np.random.default_rng(6)
+        g = GraphBuilder()
+        x = g.input((1, 5, 5))
+        K = g.parameter(rng.normal(size=(2, 1, 3, 3)).astype(np.float32),
+                        "K")
+        c = g.op("Convolution", [K, x], strides=(1, 1),
+                 autoPadding=[False, False], name="conv")
+        p = str(tmp_path / "v.model")
+        g.save(p, c)
+        apply_fn, params = build_eval(load_model_dict(p))
+        out = np.asarray(apply_fn(params,
+                                  np.ones((1, 1, 5, 5), np.float32)))
+        assert out.shape == (1, 2, 3, 3)   # VALID: 5-3+1

@@ -398,3 +398,29 @@ class TestShardedRanking:
                   mesh=build_mesh(data=8, feature=1),
                   ranking_info=self._rinfo(qs))
         self._assert_same_forest(a, b)
+
+
+class TestEmptyShardRanking:
+    def test_empty_shard_contributes_zero_rows(self):
+        """Skewed ingestion: a shard with NO queries still participates
+        (the executor adapter's empty-partition contract — every barrier
+        task must reach the collectives)."""
+        rng = np.random.default_rng(3)
+        n_q, G, F = 12, 10, 5
+        n = n_q * G
+        X = rng.normal(size=(n, F))
+        q = np.repeat(np.arange(n_q), G)
+        y = np.clip(np.digitize(X[:, 0], [-0.3, 0.4]), 0, 2).astype(float)
+        mapper = fit_bin_mapper(X, max_bin=31)
+        import jax
+        bs = [mapper.transform_packed(X), mapper.transform_packed(X[:0])]
+        m = train(bs, [y, y[:0]], [np.ones(n), np.ones(0)], mapper,
+                  get_objective("lambdarank"),
+                  TrainParams(num_iterations=3, num_leaves=7,
+                              min_data_in_leaf=5, max_bin=31, verbosity=0),
+                  mesh=build_mesh(data=2, feature=1,
+                                  devices=jax.devices()[:2]),
+                  ranking_info={"query_ids": [q.astype(np.float64),
+                                              np.zeros(0)],
+                                "sigma": 1.0, "truncation_level": 30})
+        assert len(m.trees) == 3
