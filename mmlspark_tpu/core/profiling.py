@@ -16,6 +16,13 @@ in-package capability rather than a side tool:
 
 The committed evidence chain in PERF.md (129 → 87 ms/tree) was produced
 with exactly these aggregations.
+
+Serving adds a second, host-side need: per-stage wall-clock counters for
+the scoring hot path (queue wait / decode / score / reply), cheap enough
+to stay on in production.  :class:`LatencyStats` is a thread-safe
+streaming accumulator with ring-buffer percentiles; :class:`StageStats`
+groups named stages plus a rows counter so ``ScoringEngine.stats()`` can
+report rows/s and p50/p99 without a profiler attached.
 """
 
 from __future__ import annotations
@@ -24,9 +31,131 @@ import glob
 import gzip
 import json
 import os
+import threading
+import time
 from collections import defaultdict
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
+
+
+class LatencyStats:
+    """Thread-safe streaming latency accumulator.
+
+    Keeps exact count/total plus a ring buffer of the most recent
+    ``capacity`` samples for percentile estimates — O(1) per record, no
+    unbounded growth, good enough for serving dashboards (percentiles
+    reflect the recent window, which is what a latency SLO watches).
+    """
+
+    __slots__ = ("_lock", "_count", "_total", "_ring", "_cap", "_pos")
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total = 0.0
+        self._cap = capacity
+        self._ring: List[float] = []
+        self._pos = 0
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += seconds
+            if len(self._ring) < self._cap:
+                self._ring.append(seconds)
+            else:
+                self._ring[self._pos] = seconds
+                self._pos = (self._pos + 1) % self._cap
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @staticmethod
+    def _pct(window: List[float], q: float) -> float:
+        """Nearest-rank percentile of a pre-sorted window, in seconds."""
+        if not window:
+            return 0.0
+        i = min(len(window) - 1,
+                max(0, round(q / 100.0 * (len(window) - 1))))
+        return window[i]
+
+    def percentile(self, q: float) -> float:
+        """q-th percentile (0-100) over the recent window, in seconds."""
+        with self._lock:
+            window = sorted(self._ring)
+        return self._pct(window, q)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            count, total = self._count, self._total
+            window = sorted(self._ring)
+        return {
+            "count": count,
+            "total_s": round(total, 6),
+            "mean_ms": round(total / count * 1e3, 4) if count else 0.0,
+            "p50_ms": round(self._pct(window, 50) * 1e3, 4),
+            "p99_ms": round(self._pct(window, 99) * 1e3, 4),
+        }
+
+
+class StageStats:
+    """Named :class:`LatencyStats` per pipeline stage + a rows counter.
+
+    The scoring engine instruments every hop (queue wait, decode, score,
+    reply, end-to-end) through one of these; ``snapshot()`` is the
+    JSON-able stats surface ``ScoringEngine.stats()`` exposes and
+    ``tools/bench_serving.py`` records into its artifact.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stages: Dict[str, LatencyStats] = {}
+        self._rows = 0
+        self._t_first: Optional[float] = None
+        self._t_last = 0.0
+
+    def timer(self, stage: str) -> LatencyStats:
+        with self._lock:
+            stats = self._stages.get(stage)
+            if stats is None:
+                stats = self._stages[stage] = LatencyStats()
+            return stats
+
+    @contextmanager
+    def time(self, stage: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timer(stage).record(time.perf_counter() - t0)
+
+    def add_rows(self, n: int) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            if self._t_first is None:
+                self._t_first = now
+            self._t_last = now
+            self._rows += n
+
+    @property
+    def rows(self) -> int:
+        return self._rows
+
+    def rows_per_s(self) -> float:
+        with self._lock:
+            if self._t_first is None or self._t_last <= self._t_first:
+                return 0.0
+            return self._rows / (self._t_last - self._t_first)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            stages = dict(self._stages)
+        return {
+            "rows": self._rows,
+            "rows_per_s": round(self.rows_per_s(), 2),
+            "stages": {name: s.snapshot() for name, s in stages.items()},
+        }
 
 
 @contextmanager

@@ -158,6 +158,10 @@ class Booster:
         self.params = params or {}
         self._stacked = None
         self._stacked_np = None
+        # bumped whenever the stacked prediction cache is dropped; a
+        # CompiledPredictor captures the token at build time and refuses
+        # to score a forest that changed under it
+        self._cache_token = 0
 
     def extended(self, continuation: "Booster") -> "Booster":
         """The merged model of continued training (LightGBM's
@@ -191,6 +195,25 @@ class Booster:
             params=params)
 
     # -- prediction ----------------------------------------------------------
+
+    def invalidate_cache(self) -> None:
+        """Drop the stacked prediction arrays.  Call after mutating
+        ``trees`` in place; any outstanding :class:`CompiledPredictor`
+        raises on its next call instead of silently scoring the old
+        forest."""
+        self._stacked = None
+        self._stacked_np = None
+        self._cache_token += 1
+
+    def predictor(self, num_iteration: Optional[int] = None,
+                  backend: str = "auto") -> "CompiledPredictor":
+        """Serving-hot-path margin scorer with all per-call dispatch
+        (shape checks, ``_stack()`` dict indexing, ``use_t`` slicing,
+        native-vs-jit backend probe) resolved ONCE at construction.
+        ``backend``: "auto" (native when available on cpu, else jit),
+        "native", or "jit" (force the XLA walk — the accelerator path,
+        also what benchmarks pin for apples-to-apples comparisons)."""
+        return CompiledPredictor(self, num_iteration, backend)
 
     def _stack(self):
         """Pad trees to uniform arrays for a jitted scan."""
@@ -490,6 +513,120 @@ class Booster:
     def load_native_model(cls, path: str) -> "Booster":
         with open(path) as f:
             return cls.load_native_model_string(f.read())
+
+
+class CompiledPredictor:
+    """Margin scorer with the prediction path resolved once.
+
+    ``Booster.predict_margin`` re-does shape checks, ``_stack()`` dict
+    indexing, ``use_t`` slicing, and the native-vs-jit backend probe on
+    EVERY call — pure overhead at serving batch sizes where the walk
+    itself is microseconds.  This captures the resolved dispatch at
+    construction: pre-sliced stacked arrays, the chosen backend, and the
+    class/init-score constants.  Margins are bit-exact with
+    ``predict_margin`` (the native path and the jitted walk are pinned
+    against each other in tests/test_native_forest.py; this class only
+    removes per-call resolution, not arithmetic).
+
+    Staleness contract: the predictor is bound to the forest it was
+    built from.  ``Booster.invalidate_cache()`` (required after mutating
+    ``trees`` in place) bumps a token; a stale predictor raises
+    ``RuntimeError`` on its next call instead of silently scoring the
+    old forest.  ``Booster.extended()`` and model loads return NEW
+    boosters (with a fresh, empty cache), so predictors of the base
+    model stay valid for the base forest.
+    """
+
+    def __init__(self, booster: Booster,
+                 num_iteration: Optional[int] = None,
+                 backend: str = "auto"):
+        if backend not in ("auto", "native", "jit"):
+            raise ValueError(f"backend must be auto|native|jit, "
+                             f"got {backend!r}")
+        self._booster = booster
+        self._token = booster._cache_token
+        self._num_trees = len(booster.trees)
+        self._K = booster.num_class
+        self._init_score = booster.init_score
+        self.num_features = booster.max_feature_idx + 1
+        self.num_iteration = num_iteration
+        s = booster._stack()
+        if s is None:
+            self._mode = "empty"
+            return
+        T = s["feat"].shape[0]
+        use_t = T if num_iteration is None \
+            else min(num_iteration * self._K, T)
+        sn = booster._stacked_np
+        from .. import native
+        native_ok = sn is not None and jax.default_backend() == "cpu" \
+            and native.predict_forest_available()
+        if backend == "native" and not native_ok:
+            raise RuntimeError(
+                "backend='native' requested but the native forest "
+                "scorer is unavailable on this backend")
+        if backend != "jit" and native_ok:
+            self._mode = "native"
+            self._nargs = (sn["feat"][:use_t], sn["thr"][:use_t],
+                           sn["left"][:use_t], sn["right"][:use_t],
+                           sn["leaf"][:use_t], sn["single"][:use_t],
+                           sn["is_cat"][:use_t], sn["dleft"][:use_t],
+                           sn["cat_bnd"][:use_t], sn["cat_words"][:use_t])
+            self._has_cat = sn["has_cat"]
+        else:
+            self._mode = "jit"
+            self._jargs = (s["feat"][:use_t], s["thr"][:use_t],
+                           s["left"][:use_t], s["right"][:use_t],
+                           s["leaf"][:use_t], s["single"][:use_t],
+                           s["is_cat"][:use_t], s["dleft"][:use_t],
+                           s["cat_bnd"][:use_t], s["cat_words"][:use_t])
+            self._depth = s["depth"]
+            self._has_cat = s["has_cat"]
+
+    @property
+    def mode(self) -> str:
+        """Resolved backend: 'native', 'jit', or 'empty'."""
+        return self._mode
+
+    def _check_fresh(self) -> None:
+        b = self._booster
+        if b._cache_token != self._token \
+                or len(b.trees) != self._num_trees:
+            raise RuntimeError(
+                "stale CompiledPredictor: the bound Booster's forest "
+                "changed after this predictor was built (invalidate_"
+                "cache() was called or trees were added); rebuild with "
+                "booster.predictor()")
+
+    def __call__(self, X):
+        """Raw margins, bit-exact with ``predict_margin``: (n,) float32
+        for single-class, (n, K) for multiclass."""
+        self._check_fresh()
+        shape = np.shape(X)
+        if len(shape) != 2 or shape[1] < self.num_features:
+            raise ValueError(
+                f"Model uses feature index {self.num_features - 1} but "
+                f"input has shape {shape}; expected (n, >= "
+                f"{self.num_features})")
+        n = shape[0]
+        K = self._K
+        if self._mode == "empty":
+            base = jnp.full((n,), self._init_score, jnp.float32)
+            return jnp.tile(base[:, None], (1, K))[:, 0] if K == 1 else \
+                jnp.tile(base[:, None], (1, K))
+        if self._mode == "native":
+            from .. import native
+            Xnp = np.ascontiguousarray(np.asarray(X, np.float32))
+            out = np.zeros((n, K), np.float32)
+            native.predict_forest(Xnp, *self._nargs, K, self._has_cat,
+                                  out)
+            out += np.float32(self._init_score)
+            return out[:, 0] if K == 1 else out
+        X = jnp.asarray(X, jnp.float32)
+        margins = _predict_forest(X, *self._jargs, self._depth, K,
+                                  self._has_cat)
+        margins = margins + self._init_score
+        return margins[:, 0] if K == 1 else margins
 
 
 def _arr_line(name: str, arr: np.ndarray) -> str:

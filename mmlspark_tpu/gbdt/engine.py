@@ -29,6 +29,16 @@ from .grower import (EFBArrays, GrowerConfig, TreeArrays, apply_shrinkage,
                      _grow_tree_impl)
 from .objectives import Objective, MulticlassObjective
 
+
+def _resolve_hist_method(method: str) -> str:
+    """pallas_fused compile-probe resolution, imported lazily: pallas
+    (+ Mosaic) must not become an eager dependency of every gbdt import
+    when the method is never requested."""
+    if method != "pallas_fused":
+        return method
+    from ..ops.pallas_histogram import resolve_histogram_method
+    return resolve_histogram_method(method)
+
 log = logging.getLogger("mmlspark_tpu.gbdt")
 
 
@@ -761,7 +771,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         lambda_l2=params.lambda_l2, min_data_in_leaf=params.min_data_in_leaf,
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
-        hist_method=params.histogram_method,
+        hist_method=_resolve_hist_method(params.histogram_method),
         packed_gather=params.packed_gather,
         voting_k=params.top_k if use_voting else 0,
         use_categorical=mapper.has_categorical,
@@ -1416,7 +1426,7 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         lambda_l2=params.lambda_l2, min_data_in_leaf=params.min_data_in_leaf,
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf,
         min_gain_to_split=params.min_gain_to_split,
-        hist_method=params.histogram_method,
+        hist_method=_resolve_hist_method(params.histogram_method),
         packed_gather=params.packed_gather,
         voting_k=params.top_k if params.parallelism == "voting" else 0,
         use_categorical=mapper.has_categorical,

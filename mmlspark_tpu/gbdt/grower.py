@@ -559,10 +559,18 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
     if (cfg.hist_method == "pallas_fused" and binsT is not None
             and cfg.num_bins <= 256):
         from ..ops.pallas_histogram import (FUSED_MAX_ROWS,
+                                            fused_compile_supported,
                                             histogram_pallas_fused)
-        if n <= FUSED_MAX_ROWS:
-            import jax as _jax
-            interp = _jax.default_backend() not in ("tpu", "axon")
+        import jax as _jax
+        interp = _jax.default_backend() not in ("tpu", "axon")
+        # probe=False: this may run under trace, so only the CACHED
+        # Mosaic verdict is consulted (the engine probes at config-build
+        # time via resolve_histogram_method).  A known-bad verdict falls
+        # through to the gather-then-pallas path below (ADVICE r5: the
+        # fused method must not hard-fail on the hardware it targets).
+        if (n <= FUSED_MAX_ROWS
+                and fused_compile_supported(interp, probe=False)
+                is not False):
 
             f_out = bins.shape[1]
 

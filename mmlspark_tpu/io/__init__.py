@@ -14,7 +14,8 @@ from .http import (
 )
 from .serving import (DistributedHTTPServer, HTTPServer,
                       MultiprocessHTTPServer, join_exchange,
-                      request_table, reply_from_table)
+                      request_table, reply_from_table, serve_forever)
+from .scoring import ColumnPlan, ScoringEngine
 from .binary import BinaryFileReader, read_binary_files
 from .powerbi import PowerBIWriter
 
@@ -24,6 +25,7 @@ __all__ = [
     "JSONInputParser", "JSONOutputParser",
     "HTTPServer", "DistributedHTTPServer", "MultiprocessHTTPServer",
     "join_exchange", "request_table", "reply_from_table",
+    "serve_forever", "ColumnPlan", "ScoringEngine",
     "BinaryFileReader", "read_binary_files",
     "PowerBIWriter",
 ]

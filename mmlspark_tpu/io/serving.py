@@ -23,6 +23,7 @@ import json
 import logging
 import queue
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -100,6 +101,22 @@ class _Exchange:
             pending.event.set()
             return True
 
+    def reply_many(self, entries: List[Tuple[str, Any, int]]) -> int:
+        """Batched reply delivery: one lock acquisition for the whole
+        micro-batch instead of one per row — the scoring engine's reply
+        hot path.  Returns the number delivered."""
+        delivered = 0
+        with self.lock:
+            for rid, response, status in entries:
+                pending = self.pending.get(rid)
+                if pending is None:
+                    continue
+                pending.response = response
+                pending.status = status
+                pending.event.set()
+                delivered += 1
+        return delivered
+
 
 class HTTPServer:
     """Accepts JSON POSTs, parks the socket, exposes micro-batches.
@@ -117,6 +134,10 @@ class HTTPServer:
 
         class Handler(BaseHTTPRequestHandler):
             disable_nagle_algorithm = True   # ms-latency serving contract
+            # HTTP/1.1 keep-alive: a closed-loop client reuses its
+            # connection instead of paying a TCP connect per request
+            # (every reply carries Content-Length, so this is safe)
+            protocol_version = "HTTP/1.1"
 
             def log_message(self, *a):  # quiet
                 pass
@@ -146,7 +167,12 @@ class HTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        # default accept backlog (5) overflows under concurrent-client
+        # bursts — the kernel drops SYNs and clients stall on 1s/3s
+        # retransmit timers, a serving p99 disaster
+        server_cls = type("_Server", (ThreadingHTTPServer,),
+                          {"request_queue_size": 128})
+        self._server = server_cls((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
@@ -163,6 +189,12 @@ class HTTPServer:
     def address(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    @property
+    def request_queue(self) -> "queue.Queue[Tuple[str, Any]]":
+        """The raw parked-request queue — the scoring engine's batcher
+        reads it directly for deadline-aware batch forming."""
+        return self._exchange.queue
+
     def get_batch(self, max_rows: int = 64, timeout: float = 0.05
                   ) -> List[Tuple[str, Any]]:
         """Pull up to ``max_rows`` parked requests (micro-batch trigger)."""
@@ -172,6 +204,10 @@ class HTTPServer:
               status: int = 200) -> bool:
         """HTTPSink: route a reply to the parked socket by request-id."""
         return self._exchange.reply(request_id, response, status)
+
+    def reply_many(self, entries: List[Tuple[str, Any, int]]) -> int:
+        """Batched reply routing (one lock for the whole micro-batch)."""
+        return self._exchange.reply_many(entries)
 
 
 class DistributedHTTPServer:
@@ -196,6 +232,10 @@ class DistributedHTTPServer:
     def addresses(self) -> List[str]:
         return [w.address for w in self.workers]
 
+    @property
+    def request_queue(self) -> "queue.Queue[Tuple[str, Any]]":
+        return self._exchange.queue
+
     def start(self) -> "DistributedHTTPServer":
         for w in self.workers:
             w.start()
@@ -212,6 +252,9 @@ class DistributedHTTPServer:
     def reply(self, request_id: str, response: Any,
               status: int = 200) -> bool:
         return self._exchange.reply(request_id, response, status)
+
+    def reply_many(self, entries: List[Tuple[str, Any, int]]) -> int:
+        return self._exchange.reply_many(entries)
 
 
 def join_exchange(exchange: str, worker_id: int,
@@ -269,6 +312,7 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
 
     class Handler(BaseHTTPRequestHandler):
         disable_nagle_algorithm = True   # ms-latency serving contract
+        protocol_version = "HTTP/1.1"    # keep-alive (see HTTPServer)
 
         def log_message(self, *a):  # quiet
             pass
@@ -307,7 +351,8 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             self.end_headers()
             self.wfile.write(body)
 
-    httpd = ThreadingHTTPServer((http_host, 0), Handler)
+    httpd = type("_Server", (ThreadingHTTPServer,),
+                 {"request_queue_size": 128})((http_host, 0), Handler)
     # a wildcard bind must not advertise 0.0.0.0: report the interface
     # this worker reaches the exchange through — the address a client on
     # another machine can actually dial (multi-host contract)
@@ -439,11 +484,18 @@ class MultiprocessHTTPServer:
                 continue
             got_conn = True
             conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-            idx = len(self._conns)
-            self._conns.append(conn)
-            self._wlocks.append(threading.Lock())
-            threading.Thread(target=self._reader, args=(idx, conn),
+            # NOT registered yet: the reader claims a _conns/_wlocks slot
+            # only after a correctly-tokened hello, so rejected or
+            # garbage peers never occupy exchange state (ADVICE r5)
+            threading.Thread(target=self._reader, args=(conn,),
                              daemon=True).start()
+        # hellos are parsed asynchronously by reader threads — a worker
+        # whose connection landed just before the deadline may not have
+        # its address recorded yet; grace-drain before declaring failure
+        grace = time.monotonic() + 2.0
+        while (any(not a for a in self.addresses)
+               and time.monotonic() < grace):
+            time.sleep(0.05)
         if any(not a for a in self.addresses):
             missing = [i for i, a in enumerate(self.addresses) if not a]
             xaddr = self.exchange_address  # before stop() closes it
@@ -462,14 +514,70 @@ class MultiprocessHTTPServer:
                 f"wrong tokens are dropped and land here)")
         return self
 
-    def _reader(self, idx: int, conn) -> None:
+    def _reader(self, conn) -> None:
+        # pre-auth read timeout: a silent non-protocol peer must not
+        # park a reader thread on the exchange forever
+        conn.settimeout(30.0)
         rfile = conn.makefile("r", encoding="utf-8")
-        authed = False
+        # registration is reported through a mutable slot so a socket
+        # error AFTER auth (worker crash mid-read) still reaches the
+        # purge below with the registered index
+        reg = [-1]   # _conns slot; claimed only after a tokened hello
+        try:
+            self._reader_loop(conn, rfile, reg)
+        except OSError:
+            pass   # pre-auth timeout, or peer reset mid-stream
+        except Exception:  # noqa: BLE001
+            # Anything else — UnicodeDecodeError from the utf-8
+            # makefile (binary/TLS peer), KeyError from a version-
+            # skewed worker's malformed park/hello — must not kill the
+            # reader with an unhandled traceback: the purge below is
+            # what unblocks reply() waiters for this worker's rids.
+            log.exception("serving: exchange reader failed; dropping "
+                          "connection")
+        idx = reg[0]
+        if idx < 0:
+            # never authed: nothing was registered for this conn, so
+            # there is no exchange state to purge — just drop it
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        # worker gone (crash/kill): its parked sockets died with it.
+        # Purge its routes so replies report undelivered immediately and
+        # release any reply() calls waiting on acks FROM THIS WORKER
+        # (acks carry the worker index — routes and acks are disjoint
+        # because reply() pops the route before registering the ack) —
+        # the surviving workers keep serving (the reference's executor
+        # loss story, SURVEY.md §5.3 applied to serving).
+        with self._lock:
+            for r in [r for r, i in self._route.items() if i == idx]:
+                self._route.pop(r, None)
+            dead_acks = [r for r, (_, i) in self._acks.items()
+                         if i == idx]
+            for r in dead_acks:
+                waiter, _ = self._acks.pop(r)
+                waiter.response = False
+                waiter.event.set()
+        # close the link so a still-alive (but protocol-broken) worker
+        # notices, and later _send()s fail fast instead of queueing
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _reader_loop(self, conn, rfile, reg: List[int]) -> None:
+        """Line-protocol pump for one exchange connection.  Writes the
+        registered ``_conns`` index into ``reg[0]`` at auth time (stays
+        -1 when the peer is dropped before authenticating — nothing
+        registered)."""
+        idx = -1
         for line in rfile:
             try:
                 msg = json.loads(line)
             except ValueError:
-                if not authed:
+                if idx < 0:
                     # garbage before auth: a non-protocol peer must not
                     # stay parked on the exchange
                     try:
@@ -479,7 +587,7 @@ class MultiprocessHTTPServer:
                     return
                 continue
             op = msg.get("op")
-            if not authed:
+            if idx < 0:
                 # first message MUST be a correctly-tokened hello: an
                 # unauthenticated peer never gets to claim a worker slot
                 # or route client traffic (ADVICE r4)
@@ -493,8 +601,15 @@ class MultiprocessHTTPServer:
                         conn.close()
                     except OSError:
                         pass
-                    return  # nothing registered for this conn — no purge
-                authed = True
+                    return  # nothing registered — no purge
+                # authed: only now claim exchange state (ADVICE r5 — a
+                # dropped peer must never consume a _conns slot)
+                conn.settimeout(None)
+                with self._lock:
+                    idx = len(self._conns)
+                    self._conns.append(conn)
+                    self._wlocks.append(threading.Lock())
+                reg[0] = idx
             if op == "hello":
                 w = msg.get("worker")
                 if (not isinstance(w, int) or not
@@ -523,27 +638,15 @@ class MultiprocessHTTPServer:
                     waiter = entry[0]
                     waiter.response = msg["delivered"]
                     waiter.event.set()
-        # worker gone (crash/kill): its parked sockets died with it.
-        # Purge its routes so replies report undelivered immediately and
-        # release any reply() calls waiting on acks FROM THIS WORKER
-        # (acks carry the worker index — routes and acks are disjoint
-        # because reply() pops the route before registering the ack) —
-        # the surviving workers keep serving (the reference's executor
-        # loss story, SURVEY.md §5.3 applied to serving).
-        with self._lock:
-            for r in [r for r, i in self._route.items() if i == idx]:
-                self._route.pop(r, None)
-            dead_acks = [r for r, (_, i) in self._acks.items()
-                         if i == idx]
-            for r in dead_acks:
-                waiter, _ = self._acks.pop(r)
-                waiter.response = False
-                waiter.event.set()
 
     def _send(self, idx: int, obj) -> None:
         data = (json.dumps(obj) + "\n").encode("utf-8")
         with self._wlocks[idx]:
             self._conns[idx].sendall(data)
+
+    @property
+    def request_queue(self) -> "queue.Queue[Tuple[str, Any]]":
+        return self.queue
 
     def get_batch(self, max_rows: int = 64, timeout: float = 0.05
                   ) -> List[Tuple[str, Any]]:
@@ -581,6 +684,37 @@ class MultiprocessHTTPServer:
                 self._acks.pop(request_id, None)
             return False
         return bool(waiter.response)
+
+    def reply_many(self, entries: List[Tuple[str, Any, int]]) -> int:
+        """Pipelined batch reply: send every reply line first, then
+        collect the delivery acks — one exchange round-trip for the
+        whole micro-batch instead of a blocking RTT per row."""
+        waiting: List[_Pending] = []
+        for rid, response, status in entries:
+            with self._lock:
+                idx = self._route.pop(rid, None)
+                if idx is None:
+                    continue
+                waiter = _Pending()
+                self._acks[rid] = (waiter, idx)
+            try:
+                self._send(idx, {"op": "reply", "rid": rid,
+                                 "response": response, "status": status})
+            except OSError:
+                with self._lock:
+                    self._acks.pop(rid, None)
+                continue
+            waiting.append((rid, waiter))
+        delivered = 0
+        deadline = time.monotonic() + self._reply_timeout + 5.0
+        for rid, waiter in waiting:
+            if waiter.event.wait(max(0.0, deadline - time.monotonic())) \
+                    and bool(waiter.response):
+                delivered += 1
+            else:
+                with self._lock:
+                    self._acks.pop(rid, None)
+        return delivered
 
     def stop(self) -> None:
         for i in range(len(self._conns)):
@@ -651,11 +785,19 @@ def serve_forever(server: HTTPServer,
                   transform: Callable[[DataTable], DataTable],
                   reply_col: str, max_rows: int = 64,
                   stop_event: Optional[threading.Event] = None) -> None:
-    """Micro-batch loop: accumulate → transform → route replies."""
-    while stop_event is None or not stop_event.is_set():
-        batch = server.get_batch(max_rows=max_rows)
-        if not batch:
-            continue
-        table = request_table(batch)
-        out = transform(table)
-        reply_from_table(server, out, reply_col)
+    """Micro-batch loop: accumulate → transform → route replies.
+
+    Thin shim over :class:`~mmlspark_tpu.io.scoring.ScoringEngine` in
+    legacy transform mode: one worker with inline replies is exactly the
+    old loop's thread shape, and the small 2 ms batch budget
+    approximates its drain-what's-queued behavior, so lone requests keep
+    their sub-poll latency.  Kept so existing callers and notebooks run
+    unchanged; new code should construct a ``ScoringEngine`` directly
+    for the pipelined hot path (deadline batching knobs, padded
+    buckets, stage stats)."""
+    from .scoring import ScoringEngine
+    engine = ScoringEngine(server, transform=transform,
+                           reply_col=reply_col, max_rows=max_rows,
+                           latency_budget_ms=2.0, num_scorers=1,
+                           num_repliers=0, on_error="raise")
+    engine.serve(stop_event)

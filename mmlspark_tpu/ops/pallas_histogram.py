@@ -34,12 +34,16 @@ hot loop).  On CPU the kernel runs in interpret mode (tests only).
 from __future__ import annotations
 
 import functools
+import logging
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+log = logging.getLogger(__name__)
 
 LO = 16          # low-nibble width
 FB = 8           # features folded per matmul: FB * LO = 128 lanes
@@ -192,6 +196,89 @@ def histogram_pallas_fused(binsT, gh_sub, idx, num_bins: int, size: int,
     diag = out[:, :, jnp.arange(FB), :, jnp.arange(FB), :]
     hist = diag.transpose(1, 0, 4, 3, 2).reshape(fp, BMAX, 3)
     return hist[:f, :num_bins, :]
+
+
+#: Cached Mosaic-compile verdict for the fused kernel on this process's
+#: backend: None = not yet probed, True/False = probe outcome.  The
+#: in-kernel ``jnp.take`` row gather has only ever run in CPU interpret
+#: mode (ADVICE r5); Mosaic's lowering of arbitrary dynamic gathers may
+#: fail on the very hardware the kernel targets, and
+#: ``histogram_method=pallas_fused`` must degrade, not hard-fail.
+_FUSED_COMPILE_OK: Optional[bool] = None
+
+
+def fused_compile_supported(interpret: bool = False,
+                            probe: bool = True) -> Optional[bool]:
+    """Whether :func:`histogram_pallas_fused` compiles on this backend.
+
+    With ``probe=True`` (default), compile-and-run a tiny instance ONCE
+    and cache the verdict — call this from un-traced setup code (the
+    engine resolves ``histogram_method`` here before building the boost
+    scan).  With ``probe=False``, return only the cached verdict
+    (``None`` = unknown) without touching the device — safe to consult
+    from inside a trace, where launching the probe would be staged into
+    the caller's jaxpr instead of executed.
+
+    Interpret mode bypasses Mosaic entirely, so it is always supported.
+    """
+    global _FUSED_COMPILE_OK
+    if interpret:
+        return True
+    if _FUSED_COMPILE_OK is None and probe:
+        try:
+            out = histogram_pallas_fused(
+                jnp.zeros((FB, 128), jnp.uint8),
+                jnp.zeros((8, 3), jnp.float32),
+                jnp.zeros((8,), jnp.int32), num_bins=16, size=8)
+            jax.block_until_ready(out)
+            _FUSED_COMPILE_OK = True
+        except Exception as e:  # noqa: BLE001 - Mosaic/XLA compile error
+            log.warning(
+                "pallas fused histogram failed to compile on backend "
+                "%s (%s: %s); falling back to the gather-then-"
+                "histogram_pallas path", jax.default_backend(),
+                type(e).__name__, e)
+            _FUSED_COMPILE_OK = False
+    return _FUSED_COMPILE_OK
+
+
+def resolve_histogram_method(method: str) -> str:
+    """Downgrade ``'pallas_fused'`` to ``'pallas'`` when the fused
+    kernel does not compile on this backend (one probe per process).
+    Every other method passes through untouched.  Called by the GBDT
+    engine at config-build time — i.e. OUTSIDE jit — so the fused branch
+    inside the traced grower only ever consults the cached verdict."""
+    if method != "pallas_fused":
+        return method
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    if fused_compile_supported(interpret):
+        return method
+    return "pallas"
+
+
+def histogram_pallas_fused_safe(binsT, gh_sub, idx, num_bins: int,
+                                size: int, **kwargs) -> jnp.ndarray:
+    """:func:`histogram_pallas_fused` with the compile-error fallback
+    for direct (eager) callers: on a Mosaic/XLA failure the segment is
+    gathered on-device and pushed through :func:`histogram_pallas`,
+    which is bit-comparable by contract.  The verdict is cached, so
+    after one failure every later call skips straight to the fallback.
+    """
+    global _FUSED_COMPILE_OK
+    interpret = bool(kwargs.get("interpret", False))
+    if fused_compile_supported(interpret) is not False:
+        try:
+            return histogram_pallas_fused(binsT, gh_sub, idx, num_bins,
+                                          size, **kwargs)
+        except Exception as e:  # noqa: BLE001 - compile failure
+            log.warning(
+                "pallas fused histogram call failed (%s: %s); using "
+                "gather-then-histogram_pallas", type(e).__name__, e)
+            _FUSED_COMPILE_OK = False
+    bins_sub = jnp.take(binsT, idx, axis=1).T       # (size, f) gather
+    kw = {k: v for k, v in kwargs.items()
+          if k in ("row_chunk", "accum", "interpret")}
+    return histogram_pallas(bins_sub, gh_sub, num_bins, **kw)
 
 
 @functools.partial(jax.jit,

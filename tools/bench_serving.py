@@ -1,103 +1,483 @@
-"""Serving latency characterization (the reference's DistributedHTTPSource
-claims millisecond-class latency; SURVEY.md §3.4).
+"""Serving hot-path benchmark: serial ``serve_forever`` baseline vs the
+pipelined :class:`~mmlspark_tpu.io.scoring.ScoringEngine` (ISSUE 1
+acceptance artifact; reference claim: millisecond-class serving,
+SURVEY.md §3.4; adaptive-batching rationale: Clipper, Crankshaw 2017).
 
-Measures end-to-end HTTP round-trip latency through the micro-batch
-serving loop for both topologies:
+Three scenarios, one model, correctness pinned bit-exact against
+``Booster.predict_margin`` before any timing:
 
-* threads  — DistributedHTTPServer (N thread-workers, one process)
-* processes — MultiprocessHTTPServer (N worker OS processes, TCP exchange)
+1. ``closed_native`` — exchange-level closed loop (no HTTP sockets),
+   native CPU scorer, 64 outstanding requests: steady-state driver
+   saturation.  Measures the decode/score/reply hot path itself.
+2. ``open_jit`` — Poisson open loop at ``--rate`` rows/s on the JITTED
+   scorer (the accelerator serving path, forced via
+   ``Booster.predictor(backend="jit")`` for BOTH drivers).  The serial
+   loop re-compiles ``_predict_forest`` for every distinct batch shape
+   it drains; the engine's power-of-two buckets compile once each.
+   Reports delivered rows/s, p50/p99, and GOODPUT within the
+   ``--slo-ms`` latency budget — the serving-throughput number that
+   matters operationally (a reply seconds late is a timeout, not a
+   served row).
+3. ``http_threads`` — end-to-end HTTP closed loop (threads topology),
+   keep-alive connections, client load in separate OS processes so the
+   server keeps its GIL.  Transport-bound on this box; reported for
+   transparency.
 
-Prints one JSON line per topology with p50/p95/p99 (ms) under sequential
-and concurrent load.  Run: ``python tools/bench_serving.py``.
+Acceptance gate: ``open_jit`` SLO-goodput ratio (engine / serial) >= 3.
+
+Run: ``python tools/bench_serving.py --out artifacts/bench_serving_r01.json``
+(defaults sized for a ~3 minute wall on a 2-core box).
 """
 
+import argparse
+import http.client
 import json
+import os
+import queue
+import subprocess
 import sys
 import threading
 import time
-import urllib.request
 
-sys.path.insert(0, ".")
-
-from mmlspark_tpu.io.serving import (DistributedHTTPServer,  # noqa: E402
-                                     MultiprocessHTTPServer,
-                                     reply_from_table, request_table)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _post(addr, payload, timeout=10.0):
-    req = urllib.request.Request(
-        addr, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read())
+# ---------------------------------------------------------------- load gen
 
-
-def _driver_loop(srv, stop):
+def _client_proc_main(addrs_csv, conns, dur, out_path):
+    """Closed-loop keep-alive HTTP clients (run as a separate process)."""
     import numpy as np
-    while not stop.is_set():
-        batch = srv.get_batch(max_rows=64, timeout=0.005)
-        if not batch:
-            continue
-        t = request_table(batch)
-        t = t.withColumn("reply", np.asarray(
-            [{"y": float(v) * 2} for v in t["x"]], dtype=object))
-        reply_from_table(srv, t, "reply")
+    addrs = addrs_csv.split(",")
+    rng = np.random.default_rng(os.getpid())
+    feats = rng.normal(size=(256, 16)).astype(np.float32)
+    payloads = [json.dumps({"features": f.tolist()}).encode()
+                for f in feats]
+    lat = []
+    lock = threading.Lock()
 
-
-def _percentiles(lat):
-    import numpy as np
-    a = np.asarray(sorted(lat)) * 1000.0
-    return {"p50_ms": round(float(np.percentile(a, 50)), 2),
-            "p95_ms": round(float(np.percentile(a, 95)), 2),
-            "p99_ms": round(float(np.percentile(a, 99)), 2)}
-
-
-def bench(kind, n_seq=200, n_conc=200, conc=16):
-    cls = (DistributedHTTPServer if kind == "threads"
-           else MultiprocessHTTPServer)
-    srv = cls(num_workers=3).start()
-    stop = threading.Event()
-    drv = threading.Thread(target=_driver_loop, args=(srv, stop),
-                           daemon=True)
-    drv.start()
-    try:
-        addrs = srv.addresses
-        _post(addrs[0], {"x": 0})          # warm
-        seq = []
-        for i in range(n_seq):
+    def client(i):
+        host, port = addrs[i % len(addrs)].replace(
+            "http://", "").rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=60)
+        stop_t = time.perf_counter() + float(dur)
+        while time.perf_counter() < stop_t:
             t0 = time.perf_counter()
-            _post(addrs[i % len(addrs)], {"x": i})
-            seq.append(time.perf_counter() - t0)
-        conc_lat = []
-        lock = threading.Lock()
-
-        def client(i):
-            t0 = time.perf_counter()
-            _post(addrs[i % len(addrs)], {"x": i})
+            try:
+                conn.request("POST", "/", payloads[(i * 37) % 256],
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+            except Exception:  # noqa: BLE001 - reconnect and continue
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                conn = http.client.HTTPConnection(host, int(port),
+                                                  timeout=60)
+                continue
             with lock:
-                conc_lat.append(time.perf_counter() - t0)
+                lat.append(time.perf_counter() - t0)
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001
+            pass
 
-        threads = []
-        for i in range(n_conc):
-            th = threading.Thread(target=client, args=(i,))
-            th.start()
-            threads.append(th)
-            if len(threads) >= conc:
-                for th2 in threads:
-                    th2.join(20)
-                threads = []
-        for th in threads:
-            th.join(20)
-        print(json.dumps({
-            "topology": kind,
-            "sequential": _percentiles(seq),
-            f"concurrent_{conc}": _percentiles(conc_lat),
-        }), flush=True)
-    finally:
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(int(conns))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with open(out_path, "w") as f:
+        json.dump(lat, f)
+
+
+class LoopServer:
+    """Exchange-contract load harness (no sockets): requests go straight
+    into ``request_queue``; every reply is latency-stamped and, in
+    closed-loop mode, immediately re-arms a new request."""
+
+    def __init__(self, X, closed_outstanding=0):
+        import numpy as np
+        self.np = np
+        self.X = X
+        self.request_queue = queue.Queue()
+        self.lock = threading.Lock()
+        self.count = 0
+        self.lat = []
+        self.t_sent = {}
+        self.outstanding = closed_outstanding
+        self.n = 0
+
+    def pump(self):
+        for _ in range(self.outstanding):
+            self.send()
+
+    def send(self):
+        with self.lock:
+            rid = str(self.n)
+            self.n += 1
+            self.t_sent[rid] = time.perf_counter()
+        payload = {"features": self.X[self.n % len(self.X)].tolist()}
+        self.request_queue.put((rid, payload))
+
+    def get_batch(self, max_rows=64, timeout=0.05):
+        batch = []
+        try:
+            batch.append(self.request_queue.get(timeout=timeout))
+            while len(batch) < max_rows:
+                batch.append(self.request_queue.get_nowait())
+        except queue.Empty:
+            pass
+        return batch
+
+    def _account(self, rid, now):
+        t0 = self.t_sent.pop(rid, None)
+        if t0 is not None:
+            self.lat.append(now - t0)
+        self.count += 1
+
+    def reply(self, rid, val, status=200):
+        with self.lock:
+            self._account(rid, time.perf_counter())
+        if self.outstanding:
+            self.send()
+        return True
+
+    def reply_many(self, entries):
+        now = time.perf_counter()
+        with self.lock:
+            for rid, _, _ in entries:
+                self._account(rid, now)
+        if self.outstanding:
+            for _ in entries:
+                self.send()
+        return len(entries)
+
+    def reset(self):
+        with self.lock:
+            self.count = 0
+            self.lat.clear()
+
+    def snapshot(self):
+        with self.lock:
+            return self.count, list(self.lat)
+
+
+def _percentiles(lat_s, slo_ms=None):
+    import numpy as np
+    if not lat_s:
+        return {"p50_ms": None, "p99_ms": None}
+    a = np.sort(np.asarray(lat_s)) * 1e3
+    out = {"p50_ms": round(float(np.percentile(a, 50)), 3),
+           "p99_ms": round(float(np.percentile(a, 99)), 3)}
+    if slo_ms is not None:
+        out[f"within_slo{slo_ms:g}ms"] = int((a <= slo_ms).sum())
+    return out
+
+
+# ---------------------------------------------------------------- drivers
+
+def make_serial_loop(scorer):
+    """The historical serial ``serve_forever`` body, verbatim: blocking
+    micro-batch pull -> request_table -> transform -> per-row replies."""
+    from mmlspark_tpu.io.serving import request_table, reply_from_table
+
+    def transform(t):
+        import numpy as np
+        preds = scorer(np.asarray(t["features"], np.float32))
+        return t.withColumn("pred", np.asarray(preds))
+
+    def loop(srv, stop, max_rows):
+        while not stop.is_set():
+            batch = srv.get_batch(max_rows=max_rows)
+            if not batch:
+                continue
+            out = transform(request_table(batch))
+            reply_from_table(srv, out, "pred")
+
+    return loop
+
+
+def run_driver(kind, srv, scorer, num_features, max_rows,
+               latency_budget_ms, num_scorers=2, num_repliers=1):
+    """Start serial loop or ScoringEngine over ``srv``; returns stop().
+
+    Engine thread knobs are per-topology: in-process native scoring
+    wants one pipeline worker with inline replies (nothing blocks, the
+    GIL serializes anyway); jit scoring and blocking reply paths want
+    the multi-worker pipeline."""
+    from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+    if kind == "serial":
+        stop = threading.Event()
+        loop = make_serial_loop(scorer)
+        th = threading.Thread(target=loop, args=(srv, stop, max_rows),
+                              daemon=True)
+        th.start()
+
+        def stopper():
+            stop.set()
+            th.join(timeout=5)
+        return stopper, None
+    eng = ScoringEngine(srv, predictor=scorer,
+                        plan=ColumnPlan("features", num_features),
+                        max_rows=max_rows,
+                        latency_budget_ms=latency_budget_ms,
+                        num_scorers=num_scorers,
+                        num_repliers=num_repliers).start()
+    return eng.stop, eng
+
+
+# ---------------------------------------------------------------- scenarios
+
+def scenario_closed_native(b, X, args):
+    """Interleaved serial/engine repeats; best-of per kind (ambient load
+    on a shared 2-core box swings single runs by 2x — interleaving plus
+    best-of compares the two drivers' actual capacity)."""
+    runs = {"serial": [], "engine": []}
+    best = {}
+    for rep in range(args.reps):
+        for kind in ("serial", "engine"):
+            srv = LoopServer(X, closed_outstanding=args.outstanding)
+            scorer = b.predictor(backend="auto")
+            stopper, eng = run_driver(kind, srv, scorer, X.shape[1],
+                                      args.max_rows, args.budget_ms,
+                                      num_scorers=1, num_repliers=0)
+            srv.pump()
+            time.sleep(1.0)                  # warm
+            srv.reset()
+            t0 = time.perf_counter()
+            time.sleep(args.duration)
+            count, lat = srv.snapshot()
+            el = time.perf_counter() - t0
+            stats = eng.stats_snapshot() if eng else None
+            stopper()
+            rps = round(count / el, 1)
+            runs[kind].append(rps)
+            if kind not in best or rps > best[kind]["rows_per_s"]:
+                best[kind] = {"rows_per_s": rps, **_percentiles(lat)}
+                if stats:
+                    best[kind]["engine_stats"] = stats
+    out = {"serial": best["serial"], "engine": best["engine"],
+           "runs": runs}
+    out["ratio_rows_per_s"] = round(
+        best["engine"]["rows_per_s"]
+        / max(best["serial"]["rows_per_s"], 1e-9), 3)
+    return out
+
+
+def scenario_open_jit(b, X, args):
+    import numpy as np
+    out = {}
+    for kind in ("serial", "engine"):
+        srv = LoopServer(X)                  # open loop: no re-arm
+        scorer = b.predictor(backend="jit")  # accelerator serving path
+        stopper, eng = run_driver(kind, srv, scorer, X.shape[1],
+                                  args.max_rows, args.budget_ms)
+        # identical minimal warm: one single-row shape
+        srv.send()
+        time.sleep(1.5)
+        srv.reset()
+        t0 = time.perf_counter()
+        stop = threading.Event()
+
+        def feeder():
+            r = np.random.default_rng(7)     # same arrivals for both
+            t_end = time.perf_counter() + args.duration
+            nxt = time.perf_counter()
+            while time.perf_counter() < t_end and not stop.is_set():
+                nxt += r.exponential(1.0 / args.rate)
+                dt = nxt - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+                srv.send()
+
+        fth = threading.Thread(target=feeder)
+        fth.start()
+        fth.join()
+        time.sleep(args.drain)               # let queued work finish
+        count, lat = srv.snapshot()
+        # completion-of-offered metric: every counted reply answers a
+        # request OFFERED inside the window (the drain accepts late
+        # replies but offers nothing new), so count/el is bounded by
+        # the offered rate and late replies show up in the percentiles
+        # rather than vanishing
+        el = time.perf_counter() - t0 - args.drain
+        stopper()
         stop.set()
-        srv.stop()
+        pct = _percentiles(lat, slo_ms=args.slo_ms)
+        goodput = pct.pop(f"within_slo{args.slo_ms:g}ms", 0) / el
+        out[kind] = {"offered_rows_per_s": args.rate,
+                     "delivered_rows_per_s": round(count / el, 1),
+                     f"goodput_slo{args.slo_ms:g}ms_rows_per_s":
+                         round(goodput, 1),
+                     **pct}
+    gkey = f"goodput_slo{args.slo_ms:g}ms_rows_per_s"
+    out["ratio_slo_goodput"] = round(
+        out["engine"][gkey] / max(out["serial"][gkey], 1e-9), 3)
+    out["ratio_p50_latency"] = round(
+        (out["serial"]["p50_ms"] or 0)
+        / max(out["engine"]["p50_ms"] or 1e-9, 1e-9), 2)
+    return out
+
+
+def scenario_http_threads(b, X, args):
+    """End-to-end HTTP closed loop, interleaved repeats, MEDIAN
+    reported (single reps swing >2x with ambient load on a shared
+    2-core box).  This scenario is transport-bound (HTTP parse + JSON
+    in handler threads plus external client processes sharing the
+    cores), so it characterizes the full-socket floor rather than the
+    driver gap."""
+    from mmlspark_tpu.io.serving import DistributedHTTPServer
+    runs = {"serial": [], "engine": []}
+    per_run = {"serial": [], "engine": []}
+    for rep in range(3):
+        for kind in ("serial", "engine"):
+            srv = DistributedHTTPServer(num_workers=3).start()
+            scorer = b.predictor(backend="auto")
+            stopper, _ = run_driver(kind, srv, scorer, X.shape[1],
+                                    args.max_rows, args.budget_ms)
+            t0 = time.perf_counter()
+            procs, outs = [], []
+            for i in range(args.client_procs):
+                path = f"/tmp/bench_serving_lat_{os.getpid()}_{i}.json"
+                outs.append(path)
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--client", ",".join(srv.addresses),
+                     str(args.client_conns),
+                     str(args.http_duration), path]))
+            for p in procs:
+                p.wait(timeout=args.http_duration + 60)
+            el = time.perf_counter() - t0
+            lat = []
+            for path in outs:
+                with open(path) as f:
+                    lat += json.load(f)
+                os.unlink(path)
+            stopper()
+            srv.stop()
+            rps = round(len(lat) / el, 1)
+            runs[kind].append(rps)
+            per_run[kind].append({"rows_per_s": rps, **_percentiles(lat)})
+    out = {"runs": runs}
+    for kind in ("serial", "engine"):
+        med = sorted(per_run[kind],
+                     key=lambda r: r["rows_per_s"])[len(per_run[kind]) // 2]
+        out[kind] = med
+    out["ratio_rows_per_s"] = round(
+        out["engine"]["rows_per_s"]
+        / max(out["serial"]["rows_per_s"], 1e-9), 3)
+    return out
+
+
+# ---------------------------------------------------------------- main
+
+def check_correctness(b, X):
+    """Bit-exact margins across every scored path, pinned BEFORE timing."""
+    import numpy as np
+    from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+    want = np.asarray(b.predict_margin(X[:64])).astype(np.float32)
+    ok = {}
+    try:
+        ok["native"] = bool(np.array_equal(
+            np.asarray(b.predictor(backend="native")(X[:64])), want))
+    except RuntimeError:
+        # no native kernel on this host: record that honestly instead
+        # of silently re-testing the jit path under a "native" label
+        ok["native"] = "unavailable"
+    ok["jit"] = bool(np.array_equal(
+        np.asarray(b.predictor(backend="jit")(X[:64])).astype(np.float32),
+        want))
+    eng = ScoringEngine(LoopServer(X), predictor=b.predictor(),
+                        plan=ColumnPlan("features", X.shape[1]))
+    batch = [(str(i), {"features": X[i].tolist()}) for i in range(64)]
+    pairs = eng._score_predictor(batch)
+    ok["engine_padded"] = bool(np.array_equal(
+        np.asarray([v for _, v in pairs], np.float32), want))
+    return ok
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="artifact JSON path")
+    ap.add_argument("--duration", type=float, default=12.0)
+    ap.add_argument("--http-duration", type=float, default=10.0)
+    ap.add_argument("--drain", type=float, default=1.0)
+    ap.add_argument("--rate", type=float, default=2000.0,
+                    help="open-loop offered rows/s")
+    ap.add_argument("--slo-ms", type=float, default=50.0)
+    ap.add_argument("--outstanding", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="interleaved repeats for closed_native")
+    ap.add_argument("--max-rows", type=int, default=256)
+    ap.add_argument("--budget-ms", type=float, default=5.0)
+    ap.add_argument("--client-procs", type=int, default=2)
+    ap.add_argument("--client-conns", type=int, default=8)
+    ap.add_argument("--trees", type=int, default=60)
+    ap.add_argument("--skip-http", action="store_true")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3000, 16)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + np.sin(X[:, 3])).astype(np.float64)
+    t0 = time.time()
+    b = LightGBMRegressor(numIterations=args.trees, numLeaves=31,
+                          parallelism="serial", verbosity=0).fit(
+        {"features": X, "label": y}).getModel()
+    print(f"model: {len(b.trees)} trees ({time.time() - t0:.1f}s)",
+          flush=True)
+
+    correctness = check_correctness(b, X)
+    print("correctness:", correctness, flush=True)
+
+    detail = {"correctness_bit_exact": correctness,
+              "model": {"trees": len(b.trees), "num_leaves": 31,
+                        "features": int(X.shape[1])},
+              "config": {"max_rows": args.max_rows,
+                         "latency_budget_ms": args.budget_ms,
+                         "engine_threads": {
+                             "closed_native": "1 worker, inline replies",
+                             "open_jit": "2 workers, 1 replier",
+                             "http_threads": "2 workers, 1 replier"},
+                         "open_loop_rate": args.rate,
+                         "slo_ms": args.slo_ms}}
+
+    print("== closed_native ==", flush=True)
+    detail["closed_native"] = scenario_closed_native(b, X, args)
+    print(json.dumps(detail["closed_native"], default=str)[:400],
+          flush=True)
+    print("== open_jit ==", flush=True)
+    detail["open_jit"] = scenario_open_jit(b, X, args)
+    print(json.dumps(detail["open_jit"]), flush=True)
+    if not args.skip_http:
+        print("== http_threads ==", flush=True)
+        detail["http_threads"] = scenario_http_threads(b, X, args)
+        print(json.dumps(detail["http_threads"]), flush=True)
+
+    gkey = f"goodput_slo{args.slo_ms:g}ms_rows_per_s"
+    result = {
+        "metric": "serving_slo_goodput_rows_per_sec",
+        "value": detail["open_jit"]["engine"][gkey],
+        "unit": "rows/s",
+        "vs_baseline": detail["open_jit"]["ratio_slo_goodput"],
+        "accept_ratio_ge_3": detail["open_jit"]["ratio_slo_goodput"] >= 3.0,
+        "detail": detail,
+    }
+    print(json.dumps({k: v for k, v in result.items() if k != "detail"}),
+          flush=True)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"artifact -> {args.out}", flush=True)
 
 
 if __name__ == "__main__":
-    bench("threads")
-    bench("processes")
+    if len(sys.argv) > 1 and sys.argv[1] == "--client":
+        _client_proc_main(*sys.argv[2:6])
+    else:
+        main()
