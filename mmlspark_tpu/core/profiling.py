@@ -111,6 +111,7 @@ class StageStats:
     def __init__(self):
         self._lock = threading.Lock()
         self._stages: Dict[str, LatencyStats] = {}
+        self._counters: Dict[str, int] = {}
         self._rows = 0
         self._t_first: Optional[float] = None
         self._t_last = 0.0
@@ -129,6 +130,19 @@ class StageStats:
             yield
         finally:
             self.timer(stage).record(time.perf_counter() - t0)
+
+    def incr(self, name: str, n: int = 1) -> None:
+        """Bump a named event counter (``n=0`` pre-registers the name so
+        a snapshot shows an explicit zero instead of a missing key —
+        the resilience counters ``shed``/``expired``/``salvaged``/
+        ``restarted`` are seeded this way by the scoring engine, so
+        "no degradation happened" is observable, not ambiguous)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
 
     def add_rows(self, n: int) -> None:
         now = time.perf_counter()
@@ -151,9 +165,11 @@ class StageStats:
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             stages = dict(self._stages)
+            counters = dict(self._counters)
         return {
             "rows": self._rows,
             "rows_per_s": round(self.rows_per_s(), 2),
+            "counters": counters,
             "stages": {name: s.snapshot() for name, s in stages.items()},
         }
 

@@ -284,9 +284,12 @@ def _ckpt_load(ckpt_dir, fp):
             "best_metric": meta["best_metric"],
             "best_iter": meta["best_iter"],
         }
-    except Exception:  # noqa: BLE001 - torn/partial snapshot
-        log.warning("checkpoint at %s is unreadable; starting fresh",
-                    path)
+    except Exception as e:  # noqa: BLE001 - torn/partial snapshot
+        # degrade-to-fresh-fit is the right behavior, but the REASON
+        # must be diagnosable — silent checkpoint loss looks identical
+        # to "no checkpoint existed" in the logs otherwise
+        log.warning("checkpoint at %s is unreadable (%s: %s); "
+                    "starting fresh", path, type(e).__name__, e)
         return None
 
 

@@ -15,7 +15,9 @@ from .http import (
 from .serving import (DistributedHTTPServer, HTTPServer,
                       MultiprocessHTTPServer, join_exchange,
                       request_table, reply_from_table, serve_forever)
-from .scoring import ColumnPlan, ScoringEngine
+from .scoring import ColumnPlan, ScoringEngine, WorkerKilled
+from .chaos import (ChaosChannel, ChaosPlan, ChaosPredictor, ChaosQueue,
+                    ChaosSocket, kill_process)
 from .binary import BinaryFileReader, read_binary_files
 from .powerbi import PowerBIWriter
 
@@ -25,7 +27,9 @@ __all__ = [
     "JSONInputParser", "JSONOutputParser",
     "HTTPServer", "DistributedHTTPServer", "MultiprocessHTTPServer",
     "join_exchange", "request_table", "reply_from_table",
-    "serve_forever", "ColumnPlan", "ScoringEngine",
+    "serve_forever", "ColumnPlan", "ScoringEngine", "WorkerKilled",
+    "ChaosChannel", "ChaosPlan", "ChaosPredictor", "ChaosQueue",
+    "ChaosSocket", "kill_process",
     "BinaryFileReader", "read_binary_files",
     "PowerBIWriter",
 ]

@@ -1,0 +1,268 @@
+"""Deterministic fault injection for the serving stack (chaos harness).
+
+The training side has had a fault harness since the seed (chunk replay in
+``gbdt/engine.py`` + ``tests/test_fault_tolerance.py``); this module is
+the serving-side equivalent: seeded, deterministic injectors that wrap
+the pieces of the serving pipeline so tests and the
+``tools/chaos_serving.py`` drill can prove the resilience layer's
+contract — *zero wrong answers, every non-delivered request gets an
+explicit reply, ready again when the faults stop* — instead of asserting
+it rhetorically.
+
+Determinism model: every injector draws its decisions from a
+:class:`ChaosChannel`, an independently seeded RNG stream keyed by
+``(seed, channel name)``.  Channels are independent, so thread
+interleaving across subsystems (a socket injector racing a predictor
+injector) never changes any single subsystem's decision sequence — the
+k-th send on a given socket channel fires or not regardless of what the
+predictor did.  Within one channel the sequence is a pure function of
+the seed and the call index.
+
+Injectors:
+
+* :class:`ChaosPredictor` — wraps a scoring callable; injects batch
+  exceptions (ordinary ``RuntimeError`` → the engine's per-row salvage
+  path) and worker kills (:class:`~mmlspark_tpu.io.scoring.WorkerKilled`,
+  a ``BaseException`` → the engine's supervision/restart path) at
+  deterministic call indices or rates.
+* :class:`ChaosQueue` — wraps a ``queue.Queue``; stalls ``get`` calls to
+  simulate a wedged intake.
+* :class:`ChaosSocket` — wraps a connected socket; injects connection
+  resets (RST via ``SO_LINGER 0``), partial writes, and slow reads and
+  writes — drive it from a client to exercise the server's slow-client
+  deadlines and reset handling.
+* :func:`kill_process` — SIGKILL a worker process (the multiprocess
+  drill's executor-loss injection).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import random
+import signal
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+from .scoring import WorkerKilled
+
+__all__ = [
+    "ChaosChannel", "ChaosPlan", "ChaosPredictor", "ChaosQueue",
+    "ChaosSocket", "WorkerKilled", "kill_process",
+]
+
+
+class ChaosChannel:
+    """One independently seeded decision stream.
+
+    ``fire(rate)`` is the k-th Bernoulli draw of this channel — the
+    sequence depends only on ``(seed, name)`` and the call index, never
+    on other channels or thread timing elsewhere.
+    """
+
+    def __init__(self, seed: Any, name: str):
+        self.name = name
+        self._rng = random.Random(f"{seed}:{name}")
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.fired = 0
+
+    def fire(self, rate: float) -> bool:
+        """Deterministic Bernoulli: True with probability ``rate``."""
+        with self._lock:
+            self.calls += 1
+            hit = rate > 0 and self._rng.random() < rate
+            if hit:
+                self.fired += 1
+            return hit
+
+    def uniform(self, lo: float, hi: float) -> float:
+        with self._lock:
+            self.calls += 1
+            return self._rng.uniform(lo, hi)
+
+
+class ChaosPlan:
+    """Seeded fault plan: a factory of named :class:`ChaosChannel`
+    streams plus the injected-fault ledger the drill report commits
+    (``counts()``)."""
+
+    def __init__(self, seed: Any = 0):
+        self.seed = seed
+        self._channels: Dict[str, ChaosChannel] = {}
+        self._lock = threading.Lock()
+
+    def channel(self, name: str) -> ChaosChannel:
+        with self._lock:
+            ch = self._channels.get(name)
+            if ch is None:
+                ch = self._channels[name] = ChaosChannel(self.seed, name)
+            return ch
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-channel ``{calls, fired}`` — the injection ledger."""
+        with self._lock:
+            chans = list(self._channels.values())
+        return {c.name: {"calls": c.calls, "fired": c.fired}
+                for c in chans}
+
+
+class ChaosPredictor:
+    """Wrap a scoring callable with deterministic failure injection.
+
+    * ``exc_rate`` — per-call probability of an ordinary
+      ``RuntimeError`` (the engine treats it as a batch failure and
+      salvages per row).
+    * ``kill_on_calls`` — exact call indices (1-based) that raise
+      :class:`WorkerKilled` instead of scoring — simulates the worker
+      thread dying mid-batch (the supervision path).  Call indices
+      count every invocation, including the engine's per-row salvage
+      retries.
+
+    The wrapper forwards ``mode`` when the inner predictor has one, so
+    the engine's pad-buckets auto-detection behaves identically.
+    """
+
+    def __init__(self, predictor: Callable, plan: ChaosPlan, *,
+                 exc_rate: float = 0.0,
+                 kill_on_calls: Iterable[int] = (),
+                 name: str = "predictor"):
+        self._inner = predictor
+        self._exc_rate = float(exc_rate)
+        self._kill_on = frozenset(int(k) for k in kill_on_calls)
+        self._chan = plan.channel(name)
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.kills = 0
+        self.excs = 0
+        if hasattr(predictor, "mode"):
+            self.mode = predictor.mode
+
+    def __call__(self, X):
+        with self._lock:
+            self.calls += 1
+            n = self.calls
+        if n in self._kill_on:
+            with self._lock:
+                self.kills += 1
+            raise WorkerKilled(f"chaos: worker kill at call {n}")
+        if self._chan.fire(self._exc_rate):
+            with self._lock:
+                self.excs += 1
+            raise RuntimeError(f"chaos: injected predictor fault "
+                               f"(call {n})")
+        return self._inner(X)
+
+
+class ChaosQueue:
+    """Wrap a ``queue.Queue`` with deterministic ``get`` stalls (a
+    wedged intake / slow upstream).  Puts pass through untouched so no
+    request is ever lost — chaos degrades, it must not drop."""
+
+    def __init__(self, inner: "queue.Queue", plan: ChaosPlan, *,
+                 stall_rate: float = 0.0, stall_s: float = 0.05,
+                 name: str = "queue"):
+        self._inner = inner
+        self._stall_rate = float(stall_rate)
+        self._stall_s = float(stall_s)
+        self._chan = plan.channel(name)
+
+    def _maybe_stall(self):
+        if self._chan.fire(self._stall_rate):
+            time.sleep(self._stall_s)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        self._maybe_stall()
+        return self._inner.get(block, timeout)
+
+    def get_nowait(self):
+        self._maybe_stall()
+        return self._inner.get_nowait()
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None):
+        return self._inner.put(item, block, timeout)
+
+    def put_nowait(self, item):
+        return self._inner.put_nowait(item)
+
+    def qsize(self) -> int:
+        return self._inner.qsize()
+
+    def empty(self) -> bool:
+        return self._inner.empty()
+
+
+class ChaosSocket:
+    """Wrap a CONNECTED socket with deterministic network faults:
+
+    * ``reset_rate`` — before a send: hard connection reset (``SO_LINGER
+      0`` close emits an RST; the caller sees ``ConnectionResetError``).
+    * ``partial_rate`` — before a send: transmit roughly half the bytes,
+      then reset — the truncated-request case a server's read path must
+      survive.
+    * ``slow_rate``/``slow_s`` — before a send or recv: stall — the
+      slow-loris case the server's read deadlines must bound.
+
+    Everything else delegates to the wrapped socket.  ``makefile`` is
+    delegated raw (buffered readers bypass injection); inject on the
+    side that calls ``sendall``/``recv``.
+    """
+
+    def __init__(self, sock, plan: ChaosPlan, *,
+                 reset_rate: float = 0.0, partial_rate: float = 0.0,
+                 slow_rate: float = 0.0, slow_s: float = 0.05,
+                 name: str = "socket"):
+        self._sock = sock
+        self._reset_rate = float(reset_rate)
+        self._partial_rate = float(partial_rate)
+        self._slow_rate = float(slow_rate)
+        self._slow_s = float(slow_s)
+        self._chan = plan.channel(name)
+        self.resets = 0
+
+    def _reset(self):
+        import socket as _socket
+        self.resets += 1
+        try:
+            # linger(on, 0): close() drops the connection with an RST
+            # instead of an orderly FIN — the "client yanked the cable"
+            # failure servers must shrug off
+            self._sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                                  struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        raise ConnectionResetError("chaos: injected connection reset")
+
+    def sendall(self, data: bytes):
+        if self._chan.fire(self._reset_rate):
+            self._reset()
+        if self._chan.fire(self._partial_rate):
+            self._sock.sendall(data[:max(1, len(data) // 2)])
+            self._reset()
+        if self._chan.fire(self._slow_rate):
+            time.sleep(self._slow_s)
+        return self._sock.sendall(data)
+
+    def recv(self, bufsize: int, *flags):
+        if self._chan.fire(self._slow_rate):
+            time.sleep(self._slow_s)
+        return self._sock.recv(bufsize, *flags)
+
+    def __getattr__(self, attr):
+        return getattr(self._sock, attr)
+
+
+def kill_process(proc_or_pid) -> int:
+    """SIGKILL a worker process (accepts a ``multiprocessing.Process``
+    or a raw pid) — the drill's executor-loss injection.  Returns the
+    pid killed."""
+    pid = getattr(proc_or_pid, "pid", proc_or_pid)
+    os.kill(int(pid), signal.SIGKILL)
+    return int(pid)

@@ -29,6 +29,38 @@ SURVEY.md §3.4):
   exposes rows/s and p50/p99 counters, the numbers
   ``tools/bench_serving.py`` commits as a BENCH artifact.
 
+On top of the fast path sits the **resilience layer** (the reference's
+operational story — executor restarts, socket allreduce recovery —
+applied to serving, SURVEY.md §5.3):
+
+* **Admission control / load shedding** — ``max_queue_depth`` bounds
+  intake: once the parked-request queue exceeds it, the overflow gets
+  an explicit ``503 {"error": "shed"}`` instead of unbounded queueing;
+  ``shed_wait_ms`` sheds requests that already waited past the budget.
+  Shedding drops from the HEAD of the queue (the oldest requests are
+  the ones closest to their deadlines — answering them late helps
+  nobody, while the fresh arrivals behind them can still make their
+  SLO).
+* **Per-request deadlines** — ``deadline_ms`` (overridable per request
+  via a ``_deadline_ms`` payload key) rejects expired requests with
+  ``504 {"error": "expired"}`` at batch-close time, BEFORE scoring —
+  an expired request never burns a batch slot.
+* **Worker supervision + per-row salvage** — a scoring worker that
+  crashes (anything escaping the per-batch handler, including the
+  chaos harness's :class:`WorkerKilled`) is restarted in place, and
+  the batch it held is salvaged row by row: rows that score get their
+  real answers, so one poison payload fails only its own request.  A
+  batch-level predictor exception takes the same per-row salvage path.
+  A supervisor thread additionally respawns any thread that truly
+  died.
+* **Graceful drain** — ``stop(drain=True)`` finishes the queued and
+  in-flight work (bounded by a timeout) before the workers exit, so a
+  rolling restart answers what it already accepted.
+
+Every degradation is counted: ``stats_snapshot()["counters"]`` always
+carries ``shed`` / ``expired`` / ``salvaged`` / ``restarted`` (seeded to
+zero), the numbers ``tools/chaos_serving.py`` asserts on.
+
 The fast decode path is :class:`ColumnPlan`: the payload-key → feature-
 column mapping is resolved ONCE, so each batch becomes one contiguous
 float32 matrix build instead of per-row dict walks through
@@ -37,7 +69,10 @@ float32 matrix build instead of per-row dict walks through
 Works with any server exposing the exchange contract
 (:class:`~mmlspark_tpu.io.serving.HTTPServer`,
 :class:`~mmlspark_tpu.io.serving.DistributedHTTPServer`,
-:class:`~mmlspark_tpu.io.serving.MultiprocessHTTPServer`).
+:class:`~mmlspark_tpu.io.serving.MultiprocessHTTPServer`).  Queue items
+may be ``(rid, payload)`` or ``(rid, payload, t_enqueue)`` — the
+in-repo exchanges stamp enqueue time so wait-shedding and deadlines
+measure true queue age; unstamped items age from first dequeue.
 """
 
 from __future__ import annotations
@@ -54,6 +89,13 @@ from ..core.profiling import StageStats
 from ..core.schema import DataTable
 
 log = logging.getLogger(__name__)
+
+
+class WorkerKilled(BaseException):
+    """Chaos/test hook: raised inside a scoring worker to simulate the
+    thread dying (a ``BaseException`` so the per-batch ``except
+    Exception`` handler does NOT absorb it — it escapes to the worker
+    shell exactly like a real crash would)."""
 
 
 def next_pow2(n: int) -> int:
@@ -171,7 +213,23 @@ class ScoringEngine:
     the old loop's shape exactly).  The reply queue is bounded: when
     repliers fall behind, workers stop pulling and requests
     back-pressure into the exchange queue.
+
+    Resilience knobs (all off/None by default except supervision — the
+    fast path is unchanged unless asked):
+
+    * ``max_queue_depth`` — shed (503) the oldest queued requests
+      whenever the backlog exceeds this after forming a batch.
+    * ``shed_wait_ms`` — shed (503) any request that already waited
+      longer than this when a batch closes.
+    * ``deadline_ms`` — expire (504) any request older than this at
+      batch-close time; a ``_deadline_ms`` payload key overrides it per
+      request.  Expired rows are rejected BEFORE scoring.
+    * ``supervise`` — run the supervisor thread that respawns worker or
+      replier threads that died (the in-place restart on a crash
+      happens regardless; see :meth:`_worker_shell`).
     """
+
+    RESILIENCE_COUNTERS = ("shed", "expired", "salvaged", "restarted")
 
     def __init__(self, server, *,
                  predictor: Optional[Callable] = None,
@@ -188,6 +246,10 @@ class ScoringEngine:
                  reply_fn: Optional[Callable[[np.ndarray], List[Any]]]
                  = None,
                  on_error: str = "reply",
+                 max_queue_depth: Optional[int] = None,
+                 shed_wait_ms: Optional[float] = None,
+                 deadline_ms: Optional[float] = None,
+                 supervise: bool = True,
                  stats: Optional[StageStats] = None):
         if (predictor is None) == (transform is None):
             raise ValueError(
@@ -198,7 +260,12 @@ class ScoringEngine:
                              "keep serving) or 'raise' (stop and "
                              "re-raise from serve())")
         if predictor is not None and plan is None:
-            plan = ColumnPlan()
+            # wire the predictor's known width into the auto plan so a
+            # wrong-width payload fails at decode time as a per-row 400
+            # instead of blowing up the whole batch at score time and
+            # coming back as salvage-path 500s (review finding)
+            plan = ColumnPlan(
+                num_features=getattr(predictor, "num_features", None))
         if pad_buckets is None:
             # padding buys a bounded compile cache on the JIT walk; the
             # native kernel has no shape-specialized compilation, so
@@ -217,15 +284,29 @@ class ScoringEngine:
         self._pad_buckets = bool(pad_buckets)
         self._reply_fn = reply_fn
         self._on_error = on_error
+        self._max_queue_depth = (None if max_queue_depth is None
+                                 else int(max_queue_depth))
+        self._shed_wait = (None if shed_wait_ms is None
+                           else float(shed_wait_ms) / 1e3)
+        self._deadline = (None if deadline_ms is None
+                          else float(deadline_ms) / 1e3)
+        self._supervise = bool(supervise)
         self._fatal: Optional[BaseException] = None
         self._died = threading.Event()
         self.stats = stats or StageStats()
+        for name in self.RESILIENCE_COUNTERS:
+            self.stats.incr(name, 0)     # observable zeros
         self._reply_q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._supervisor_thread: Optional[threading.Thread] = None
         self._form_lock = threading.Lock()   # one batch former at a time
         self._inflight = 0          # batches being decoded/scored
         self._inflight_lock = threading.Lock()
+        # worker slot -> (batch, t_first) being scored; the supervisor /
+        # worker shell salvages this when the worker crashes mid-batch
+        self._current: dict = {}
         self._reply_many = getattr(server, "reply_many", None)
         self._request_q = getattr(server, "request_queue", None)
         if self._request_q is None:  # duck-typed custom servers
@@ -244,7 +325,19 @@ class ScoringEngine:
 
     # -- batch forming -------------------------------------------------------
 
-    def _form_batch(self) -> Optional[Tuple[List[Tuple[str, Any]], float]]:
+    @staticmethod
+    def _norm(item, now: Optional[float] = None
+              ) -> Tuple[str, Any, float]:
+        """Queue items are ``(rid, payload)`` or ``(rid, payload,
+        t_enqueue)``; unstamped items age from first dequeue."""
+        if len(item) >= 3:
+            return item[0], item[1], item[2]
+        return item[0], item[1],  \
+            now if now is not None else time.perf_counter()
+
+    def _form_batch(self) -> Optional[
+            Tuple[List[Tuple[str, Any, float]], float,
+                  List[Tuple[str, Any, int]]]]:
         """Adaptive, deadline-aware close.  A batch closes when:
 
         * ``max_rows`` requests are aboard (size cap), or
@@ -256,13 +349,23 @@ class ScoringEngine:
           wait).
 
         The budget clock starts when the batch OPENS (first dequeue) —
-        the exchange does not timestamp requests at park, so time spent
-        queued while every worker was mid-score is not counted here and
-        not in the ``e2e`` stat; under sustained overload the
-        client-observed latency exceeds ``e2e`` by that queueing delay
-        (the benchmark's client-side percentiles capture it).
+        for exchanges that stamp enqueue time the shed/deadline checks
+        additionally see true queue age; for unstamped items (bare
+        2-tuples) age starts at dequeue and the ``e2e`` stat excludes
+        queueing delay (the benchmark's client-side percentiles capture
+        it).
 
-        Returns ``(batch, t_first)``; ``None`` on an idle poll tick."""
+        Admission control runs at batch close: overflow past
+        ``max_queue_depth`` is shed from the queue head, then each
+        formed row is checked against its deadline (expired → 504,
+        never scored) and the wait budget (over → 503 shed).
+
+        Returns ``(live_batch, t_first, error_replies)``; ``None`` on
+        an idle poll tick.  ``error_replies`` are the shed/expired
+        ``(rid, body, status)`` entries — delivered by the CALLER after
+        the form lock is released, because the multiprocess reply path
+        blocks on cross-process acks and must not stall every other
+        former."""
         if self._request_q is None:
             return self._form_batch_pulling()
         q = self._request_q
@@ -271,50 +374,141 @@ class ScoringEngine:
         except queue.Empty:
             return None
         t_first = time.perf_counter()
-        batch = [first]
-        deadline = t_first + self._budget
-        while len(batch) < self._max_rows:
-            try:
-                batch.append(q.get_nowait())
-                continue
-            except queue.Empty:
-                pass
-            now = time.perf_counter()
-            if now >= deadline:
-                break
-            with self._inflight_lock:
-                busy = self._inflight > 0
-            if not busy:
-                break    # scorers idle: ship immediately
-            try:
-                batch.append(q.get(timeout=min(deadline - now, 1e-3)))
-            except queue.Empty:
-                continue
-        return batch, t_first
+        batch: List[Tuple[str, Any, float]] = []
+        shed: List[Tuple[str, Any, float]] = []
+        try:
+            batch.append(self._norm(first, t_first))
+            deadline = t_first + self._budget
+            while len(batch) < self._max_rows:
+                try:
+                    batch.append(self._norm(q.get_nowait()))
+                    continue
+                except queue.Empty:
+                    pass
+                now = time.perf_counter()
+                if now >= deadline:
+                    break
+                with self._inflight_lock:
+                    busy = self._inflight > 0
+                if not busy:
+                    break    # scorers idle: ship immediately
+                try:
+                    batch.append(self._norm(
+                        q.get(timeout=min(deadline - now, 1e-3))))
+                except queue.Empty:
+                    continue
+            qsize = getattr(q, "qsize", None)
+            if self._max_queue_depth is not None and qsize is not None:
+                # bounded intake: the backlog beyond the bound is shed
+                # NOW with an explicit reply instead of queueing
+                # unboundedly.  Dropping from the head sheds the oldest
+                # waiters — the requests closest to their deadlines.
+                while qsize() > self._max_queue_depth:
+                    try:
+                        shed.append(self._norm(q.get_nowait()))
+                    except queue.Empty:
+                        break
+            live, errors = self._admit(batch, shed)
+        except Exception:  # noqa: BLE001 - form-path bug / bad item
+            # rows already pulled off the queue MUST still get replies:
+            # without this, a forming crash (malformed queue item, a
+            # duck-typed queue quirk) silently drops them and their
+            # clients hang until the handler timeout
+            return [], t_first, self._error_all(batch + shed)
+        return live, t_first, errors
 
-    def _form_batch_pulling(self
-                            ) -> Optional[Tuple[List[Tuple[str, Any]],
-                                                float]]:
+    def _form_batch_pulling(self) -> Optional[
+            Tuple[List[Tuple[str, Any, float]], float,
+                  List[Tuple[str, Any, int]]]]:
         """Same close policy over the legacy ``get_batch()`` contract
-        (servers that expose no raw queue)."""
-        batch = self._get_batch(self._max_rows, 0.05)
-        if not batch:
+        (servers that expose no raw queue; no depth-based shedding —
+        the queue is invisible here, but wait/deadline checks apply)."""
+        pulled = self._get_batch(self._max_rows, 0.05)
+        if not pulled:
             return None
         t_first = time.perf_counter()
-        deadline = t_first + self._budget
-        while len(batch) < self._max_rows:
-            now = time.perf_counter()
-            if now >= deadline:
-                break
-            with self._inflight_lock:
-                busy = self._inflight > 0
-            if not busy:
-                break    # scorers idle: ship immediately
-            batch += self._get_batch(self._max_rows - len(batch),
-                                     min(deadline - now, 1e-3))
-        return batch, t_first
+        batch: List[Tuple[str, Any, float]] = []
+        try:
+            batch = [self._norm(it, t_first) for it in pulled]
+            deadline = t_first + self._budget
+            while len(batch) < self._max_rows:
+                now = time.perf_counter()
+                if now >= deadline:
+                    break
+                with self._inflight_lock:
+                    busy = self._inflight > 0
+                if not busy:
+                    break    # scorers idle: ship immediately
+                batch += [self._norm(it, now) for it in
+                          self._get_batch(self._max_rows - len(batch),
+                                          min(deadline - now, 1e-3))]
+            live, errors = self._admit(batch, [])
+        except Exception:  # noqa: BLE001 - pulled rows must get replies
+            return [], t_first, self._error_all(batch)
+        return live, t_first, errors
 
-    def _worker(self) -> None:
+    def _error_all(self, entries) -> List[Tuple[str, Any, int]]:
+        """Last-resort 500s for rows stranded by a forming crash; an
+        entry too malformed to even yield a request id is logged and
+        dropped (nothing to address a reply to)."""
+        log.exception("batch forming failed; erroring %d dequeued rows",
+                      len(entries))
+        errors = []
+        for e in entries:
+            try:
+                errors.append((e[0], {"error": "scoring failed"}, 500))
+            except Exception:  # noqa: BLE001 - unaddressable item
+                log.warning("dropping unaddressable queue item %r", e)
+        return errors
+
+    def _admit(self, batch, shed):
+        """Split a formed batch into live rows vs shed/expired ones and
+        build the explicit degradation replies (503 shed / 504
+        expired).  Runs at batch-close time, BEFORE any scoring — an
+        expired request never burns a batch slot.  Returns
+        ``(live, error_replies)``; the caller delivers the errors
+        outside the form lock."""
+        now = time.perf_counter()
+        live, expired = [], []
+        for entry in batch:
+            rid, payload, t_enq = entry
+            age = now - t_enq
+            dl = self._deadline
+            if isinstance(payload, dict) and "_deadline_ms" in payload:
+                try:
+                    dl = float(payload["_deadline_ms"]) / 1e3
+                except (TypeError, ValueError):
+                    pass
+            if dl is not None and age > dl:
+                expired.append(entry)
+            elif self._shed_wait is not None and age > self._shed_wait:
+                shed.append(entry)
+            else:
+                live.append(entry)
+        errors = []
+        if shed:
+            self.stats.incr("shed", len(shed))
+            errors += [(e[0], {"error": "shed"}, 503) for e in shed]
+        if expired:
+            self.stats.incr("expired", len(expired))
+            errors += [(e[0], {"error": "expired"}, 504)
+                       for e in expired]
+        return live, errors
+
+    def _reply_errors(self, entries) -> None:
+        """Deliver explicit degradation replies (shed/expired/crash) —
+        no latency timers, these are not scored rows."""
+        try:
+            if self._reply_many is not None:
+                self._reply_many(entries)
+            else:
+                for rid, body, status in entries:
+                    self._server.reply(rid, body, status)
+        except Exception:  # noqa: BLE001 - reply path must not kill form
+            log.exception("failed delivering %d degradation replies",
+                          len(entries))
+
+    def _worker(self, slot: int) -> None:
         """Pipeline worker: form (serialized) → decode → score → reply
         (inline or handed to a replier)."""
         while True:
@@ -323,10 +517,19 @@ class ScoringEngine:
                     return
                 formed = self._form_batch()
             if formed is None:
+                if self._draining.is_set():
+                    return   # drain mode: queue dry — exit cleanly
                 continue
-            batch, t_first = formed
+            batch, t_first, errors = formed
+            if errors:
+                # shed/expired replies, delivered OUTSIDE the form lock
+                # (the multiprocess reply path blocks on acks)
+                self._reply_errors(errors)
+            if not batch:
+                continue     # everything formed was shed/expired
             self.stats.timer("batch_form").record(
                 time.perf_counter() - t_first)
+            self._current[slot] = (batch, t_first)
             with self._inflight_lock:
                 self._inflight += 1
             try:
@@ -343,11 +546,11 @@ class ScoringEngine:
                     self._stop.set()
                     return
                 # hot-path semantics: a bad batch must not kill the
-                # worker — 500 it and keep serving
-                log.exception("scoring batch of %d failed; replying 500",
-                              len(batch))
-                pairs = [(rid, {"error": "scoring failed"}, 500)
-                         for rid, _ in batch]
+                # worker — salvage it row by row so one poison payload
+                # fails only its own request
+                log.exception("scoring batch of %d failed; salvaging "
+                              "per-row", len(batch))
+                pairs = self._salvage_batch(batch)
             finally:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -355,6 +558,96 @@ class ScoringEngine:
                 self._deliver(pairs, t_first)
             else:
                 self._reply_q.put((pairs, t_first, time.perf_counter()))
+            self._current.pop(slot, None)
+
+    def _worker_shell(self, slot: int) -> None:
+        """Crash boundary around :meth:`_worker`: anything escaping the
+        per-batch handler (a :class:`WorkerKilled` chaos injection, a
+        bug in the form/deliver path) restarts the worker in place
+        after salvaging the batch it held — the engine's worker-
+        supervision contract.  ``KeyboardInterrupt``/``SystemExit``
+        still propagate."""
+        while True:
+            try:
+                self._worker(slot)
+                return                        # clean stop/drain exit
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:  # noqa: BLE001 - crash boundary
+                if self._stop.is_set():
+                    return
+                log.exception("scoring worker %d crashed; restarting",
+                              slot)
+                self.stats.incr("restarted")
+                inflight = self._current.pop(slot, None)
+                if inflight is not None:
+                    self._salvage_crashed(*inflight)
+
+    def _salvage_crashed(self, batch, t_first: float) -> None:
+        """Recover the batch a crashed worker held: score it row by row
+        and deliver; a second crash during salvage fails the remaining
+        rows with explicit 500s (bounded — a worker that dies on every
+        call must not loop forever on one batch).  A crash after
+        partial delivery can re-reply rows the exchange already
+        routed; the exchange drops replies to popped ids, and the
+        salvage re-scores the same rows so a double reply carries the
+        identical value."""
+        try:
+            pairs = self._salvage_batch(batch)
+            self._deliver(pairs, t_first)
+        except BaseException:  # noqa: BLE001 - salvage must terminate
+            log.exception("salvage of crashed batch failed; erroring "
+                          "%d rows", len(batch))
+            self._reply_errors([(e[0], {"error": "scoring failed"}, 500)
+                                for e in batch])
+
+    def _salvage_batch(self, batch):
+        """Batch-level scoring failed: retry each row alone so only the
+        poison row(s) fail.  Rows rescued this way count as
+        ``salvaged``."""
+        score_one = (self._score_predictor if self._predictor is not None
+                     else self._score_transform)
+        pairs, rescued = [], 0
+        for entry in batch:
+            try:
+                row_pairs = score_one([entry])
+            except Exception:  # noqa: BLE001 - this row is the poison
+                pairs.append((entry[0], {"error": "scoring failed"},
+                              500))
+                continue
+            # a 2-tuple result row scored; 3-tuples are decode 400s
+            rescued += sum(1 for p in row_pairs if len(p) == 2)
+            pairs.extend(row_pairs)
+        if rescued:
+            self.stats.incr("salvaged", rescued)
+        return pairs
+
+    def _supervisor(self) -> None:
+        """Belt-and-braces thread supervision: the worker shell restarts
+        crashes in place, but a thread that truly died (shell itself
+        failed, replier crashed) is respawned here so capacity
+        recovers."""
+        while not self._stop.wait(0.2):
+            if self._draining.is_set():
+                continue     # drain exits are legitimate deaths
+            for i, t in enumerate(self._threads):
+                if t.is_alive() or self._stop.is_set():
+                    continue
+                scorer = i < self._num_scorers
+                log.warning("%s thread %d found dead; respawning",
+                            "scoring" if scorer else "replier", i)
+                self.stats.incr("restarted")
+                if scorer:
+                    nt = threading.Thread(target=self._worker_shell,
+                                          args=(i,),
+                                          name=f"scoring-worker-{i}",
+                                          daemon=True)
+                else:
+                    nt = threading.Thread(
+                        target=self._replier,
+                        name=f"scoring-replier-{i}", daemon=True)
+                self._threads[i] = nt
+                nt.start()
 
     # -- scoring -------------------------------------------------------------
 
@@ -373,7 +666,7 @@ class ScoringEngine:
         return m.tolist()
 
     def _score_predictor(self, batch):
-        payloads = [p for _, p in batch]
+        payloads = [e[1] for e in batch]
         with self.stats.time("decode"):
             try:
                 X = self._plan.decode(payloads)
@@ -382,7 +675,7 @@ class ScoringEngine:
         if X is None:
             return self._score_predictor_salvage(batch)
         vals = self._score_matrix(X, X.shape[0])
-        return [(rid, vals[i]) for i, (rid, _) in enumerate(batch)]
+        return [(e[0], vals[i]) for i, e in enumerate(batch)]
 
     def _score_predictor_salvage(self, batch):
         """The vectorized decode failed: decode per row so ONE malformed
@@ -391,7 +684,8 @@ class ScoringEngine:
         ``max_rows`` innocent neighbors)."""
         rows, order, bad = [], [], []
         width = self._plan.num_features
-        for rid, p in batch:
+        for entry in batch:
+            rid, p = entry[0], entry[1]
             try:
                 r = self._plan.decode([p])
             except Exception:  # noqa: BLE001
@@ -445,16 +739,28 @@ class ScoringEngine:
             pairs, t_first, t_handoff = item
             self.stats.timer("queue_wait").record(
                 time.perf_counter() - t_handoff)
-            self._deliver(pairs, t_first)
+            try:
+                self._deliver(pairs, t_first)
+            except Exception:  # noqa: BLE001 - one bad delivery must
+                # not kill the replier (dropping every queued batch and
+                # wedging workers on the bounded reply queue); give the
+                # batch explicit 500s and keep draining
+                log.exception("reply delivery failed; erroring %d rows",
+                              len(pairs))
+                self._reply_errors(
+                    [(e[0], {"error": "scoring failed"}, 500)
+                     for e in pairs])
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "ScoringEngine":
         self._stop.clear()
+        self._draining.clear()
         self._died.clear()
         self._fatal = None
+        self._current.clear()
         self._threads = [
-            threading.Thread(target=self._worker,
+            threading.Thread(target=self._worker_shell, args=(i,),
                              name=f"scoring-worker-{i}", daemon=True)
             for i in range(self._num_scorers)]
         self._threads += [
@@ -463,19 +769,56 @@ class ScoringEngine:
             for i in range(self._num_repliers)]
         for t in self._threads:
             t.start()
+        if self._supervise:
+            self._supervisor_thread = threading.Thread(
+                target=self._supervisor, name="scoring-supervisor",
+                daemon=True)
+            self._supervisor_thread.start()
+        # readiness wiring: servers exposing a ready_check slot (the
+        # /readyz endpoint) report this engine's liveness
+        if hasattr(self._server, "ready_check"):
+            try:
+                self._server.ready_check = self.is_ready
+            except AttributeError:
+                pass
         return self
 
-    def stop(self) -> None:
-        """Drain-and-join: workers stop pulling at their next form tick
-        (finishing the batch in hand, replies included), then repliers
-        drain on sentinels."""
+    def is_ready(self) -> bool:
+        """Liveness for ``/readyz``: started, not stopping, and at
+        least one scoring worker alive."""
+        if not self._threads or self._stop.is_set() \
+                or self._draining.is_set():
+            return False
+        return any(t.is_alive()
+                   for t in self._threads[:self._num_scorers])
+
+    def stop(self, drain: bool = False, drain_timeout: float = 10.0
+             ) -> None:
+        """Drain-and-join.  Default: workers stop pulling at their next
+        form tick (finishing the batch in hand, replies included), then
+        repliers drain on sentinels.  With ``drain=True`` the workers
+        first keep forming until the request queue runs dry (bounded by
+        ``drain_timeout``), so everything already accepted is answered
+        before exit — the graceful-restart path.  Callers should stop
+        intake (server accept) first or the drain chases a moving
+        queue until the timeout."""
+        if drain and not self._stop.is_set():
+            self._draining.set()
+            deadline = time.monotonic() + drain_timeout
+            for t in self._threads[:self._num_scorers]:
+                t.join(timeout=max(0.0,
+                                   deadline - time.monotonic()))
         self._stop.set()
+        self._draining.set()   # unblock any drain-mode check
         for t in self._threads[:self._num_scorers]:
             t.join(timeout=5)
         for _ in range(self._num_repliers):
             self._reply_q.put(None)
         for t in self._threads[self._num_scorers:]:
             t.join(timeout=5)
+        if self._supervisor_thread is not None:
+            self._supervisor_thread.join(timeout=5)
+            self._supervisor_thread = None
         self._threads = []
 
     def serve(self, stop_event: Optional[threading.Event] = None) -> None:
@@ -499,6 +842,7 @@ class ScoringEngine:
     # -- observability -------------------------------------------------------
 
     def stats_snapshot(self) -> dict:
-        """Rows/s plus per-stage count/mean/p50/p99 — the counters the
-        serving BENCH artifact records."""
+        """Rows/s plus per-stage count/mean/p50/p99 and the resilience
+        counters (``shed``/``expired``/``salvaged``/``restarted``) —
+        the numbers the serving BENCH and chaos artifacts record."""
         return self.stats.snapshot()

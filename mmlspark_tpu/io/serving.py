@@ -35,13 +35,116 @@ from ..core.schema import DataTable
 log = logging.getLogger(__name__)
 
 
+class _QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """Serving-wide HTTP server invariants, in ONE place for both the
+    in-process and worker-process paths:
+
+    * accept backlog 128 — the default (5) overflows under concurrent-
+      client bursts; the kernel drops SYNs and clients stall on 1s/3s
+      retransmit timers, a serving p99 disaster;
+    * quiet ``handle_error`` — a client that resets or abandons its
+      connection is business as usual for a public-facing server (the
+      chaos drill injects exactly these); log at debug instead of
+      spraying tracebacks to stderr.  Anything else still gets a full
+      traceback.
+    """
+
+    request_queue_size = 128
+
+    def handle_error(self, request, client_address):
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError,
+                            BrokenPipeError)):
+            log.debug("serving: client %s dropped: %r",
+                      client_address, exc)
+            return
+        log.exception("serving: unhandled error for client %s",
+                      client_address)
+
+
+class _ServingHandler(BaseHTTPRequestHandler):
+    """Shared plumbing for every serving HTTP handler: quiet logging,
+    HTTP/1.1 keep-alive, JSON replies, and the /healthz + /readyz
+    endpoints.  Subclasses define ``do_POST``, a ``timeout`` (the
+    slow-client read deadline — http.server applies it as the socket
+    timeout and closes the connection on expiry), and ``_ready()``."""
+
+    disable_nagle_algorithm = True   # ms-latency serving contract
+    # HTTP/1.1 keep-alive: a closed-loop client reuses its connection
+    # instead of paying a TCP connect per request (every reply carries
+    # Content-Length, so this is safe)
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send_json(self, status, obj):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _ready(self) -> bool:
+        return False
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            # liveness: the accept loop is running
+            self._send_json(200, {"status": "ok"})
+        elif self.path == "/readyz":
+            try:
+                ready = bool(self._ready())
+            except Exception:  # noqa: BLE001
+                ready = False
+            self._send_json(200 if ready else 503, {"ready": ready})
+        else:
+            self.send_error(404)
+
+
 class _Pending:
-    __slots__ = ("event", "response", "status")
+    __slots__ = ("event", "response", "status", "t_park")
 
     def __init__(self):
         self.event = threading.Event()
         self.response: Any = None
         self.status = 200
+        self.t_park = time.monotonic()
+
+
+class _TrackedQueue(queue.Queue):
+    """A Queue that tracks the request ids currently aboard, so a
+    reconnecting worker's re-park can restore the reply route WITHOUT
+    double-enqueueing a request whose first copy is still queued
+    (scoring it twice would burn batch slots and, in transform mode,
+    run user code twice).  ``_put``/``_get`` are Queue's documented
+    under-mutex extension hooks."""
+
+    def __init__(self):
+        super().__init__()
+        self.rids = set()
+
+    def _put(self, item):
+        self.rids.add(item[0])
+        super()._put(item)
+
+    def _get(self):
+        item = super()._get()
+        self.rids.discard(item[0])
+        return item
+
+    def put_unique(self, item) -> bool:
+        """Enqueue unless this rid is already aboard; returns whether
+        the item was enqueued."""
+        with self.not_full:
+            if item[0] in self.rids:
+                return False
+            self._put(item)
+            self.unfinished_tasks += 1
+            self.not_empty.notify()
+            return True
 
 
 class _Exchange:
@@ -53,21 +156,55 @@ class _Exchange:
     cross-worker reply routing of the reference's DistributedHTTPSource /
     HTTPSink pair (expected path io/http/DistributedHTTPSource.scala,
     UNVERIFIED; SURVEY.md §3.4).
+
+    Lifecycle of a ``pending`` entry: the handler that parked it always
+    pops it via :meth:`unpark` (reply, timeout, or client error alike),
+    and request ids are uuid4 — never recycled, so a late reply can
+    never deliver into a reused id.  As a backstop against a handler
+    thread dying between park and unpark (daemon teardown, a killed
+    worker thread), :meth:`park` amortizes a sweep that drops entries
+    older than ``2 * reply_timeout + sweep_grace`` — a leaked entry
+    outlives its client by a bounded margin instead of forever.
     """
 
-    def __init__(self, reply_timeout: float = 30.0):
-        self.queue: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
+    _SWEEP_EVERY = 256
+
+    def __init__(self, reply_timeout: float = 30.0,
+                 sweep_grace: float = 10.0):
+        self.queue: "queue.Queue[Tuple[str, Any, float]]" = queue.Queue()
         self.pending: Dict[str, _Pending] = {}
         self.lock = threading.Lock()
         self.reply_timeout = reply_timeout
+        self.sweep_grace = sweep_grace
+        self._parks = 0
 
     def park(self, payload: Any) -> Tuple[str, _Pending]:
         rid = uuid.uuid4().hex
         pending = _Pending()
         with self.lock:
             self.pending[rid] = pending
-        self.queue.put((rid, payload))
+            self._parks += 1
+            if self._parks % self._SWEEP_EVERY == 0:
+                self._sweep_locked()
+        # queue items carry the enqueue stamp so the scoring engine's
+        # wait-shedding and per-request deadlines see true queue age
+        self.queue.put((rid, payload, time.perf_counter()))
         return rid, pending
+
+    def _sweep_locked(self) -> None:
+        """Drop pending entries whose handler must be gone (no event is
+        set — a live handler unparks within ``reply_timeout``).  Called
+        under ``self.lock``."""
+        horizon = time.monotonic() - (2 * self.reply_timeout
+                                      + self.sweep_grace)
+        stale = [r for r, p in self.pending.items()
+                 if p.t_park < horizon]
+        for r in stale:
+            del self.pending[r]
+        if stale:
+            log.warning("serving: swept %d orphaned pending replies "
+                        "(handler died between park and unpark)",
+                        len(stale))
 
     def unpark(self, rid: str) -> bool:
         """Remove a parked request after its wait ended.  Returns whether a
@@ -81,11 +218,15 @@ class _Exchange:
 
     def get_batch(self, max_rows: int = 64, timeout: float = 0.05
                   ) -> List[Tuple[str, Any]]:
+        """Pull a micro-batch as legacy ``(rid, payload)`` 2-tuples (the
+        enqueue stamps ride the raw queue only — direct-queue readers
+        like the scoring engine use them; batch pullers keep the
+        pre-resilience contract)."""
         batch: List[Tuple[str, Any]] = []
         try:
-            batch.append(self.queue.get(timeout=timeout))
+            batch.append(self.queue.get(timeout=timeout)[:2])
             while len(batch) < max_rows:
-                batch.append(self.queue.get_nowait())
+                batch.append(self.queue.get_nowait()[:2])
         except queue.Empty:
             pass
         return batch
@@ -128,19 +269,23 @@ class HTTPServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout: float = 30.0,
-                 exchange: Optional[_Exchange] = None):
+                 exchange: Optional[_Exchange] = None,
+                 request_read_timeout: float = 30.0):
         self._exchange = exchange or _Exchange(reply_timeout)
+        # /readyz hook: the scoring engine installs its liveness check
+        # here at start(); None means "no engine attached yet" → 503
+        self.ready_check: Optional[Callable[[], bool]] = None
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            disable_nagle_algorithm = True   # ms-latency serving contract
-            # HTTP/1.1 keep-alive: a closed-loop client reuses its
-            # connection instead of paying a TCP connect per request
-            # (every reply carries Content-Length, so this is safe)
-            protocol_version = "HTTP/1.1"
+        class Handler(_ServingHandler):
+            # slow-client read deadline: a peer that opens a connection
+            # and trickles (or never sends) its request body gets cut
+            # off instead of parking a handler thread forever
+            timeout = request_read_timeout
 
-            def log_message(self, *a):  # quiet
-                pass
+            def _ready(self):
+                check = outer.ready_check
+                return check is not None and bool(check())
 
             def do_POST(self):
                 if api_path not in ("/", self.path):
@@ -167,12 +312,7 @@ class HTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        # default accept backlog (5) overflows under concurrent-client
-        # bursts — the kernel drops SYNs and clients stall on 1s/3s
-        # retransmit timers, a serving p99 disaster
-        server_cls = type("_Server", (ThreadingHTTPServer,),
-                          {"request_queue_size": 128})
-        self._server = server_cls((host, port), Handler)
+        self._server = _QuietThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
@@ -190,9 +330,10 @@ class HTTPServer:
         return f"http://{self.host}:{self.port}"
 
     @property
-    def request_queue(self) -> "queue.Queue[Tuple[str, Any]]":
-        """The raw parked-request queue — the scoring engine's batcher
-        reads it directly for deadline-aware batch forming."""
+    def request_queue(self) -> "queue.Queue[Tuple[str, Any, float]]":
+        """The raw parked-request queue (enqueue-stamped 3-tuples) — the
+        scoring engine's batcher reads it directly for deadline-aware
+        batch forming and queue-age shedding."""
         return self._exchange.queue
 
     def get_batch(self, max_rows: int = 64, timeout: float = 0.05
@@ -221,11 +362,13 @@ class DistributedHTTPServer:
     """
 
     def __init__(self, num_workers: int = 2, host: str = "127.0.0.1",
-                 api_path: str = "/", reply_timeout: float = 30.0):
+                 api_path: str = "/", reply_timeout: float = 30.0,
+                 request_read_timeout: float = 30.0):
         self._exchange = _Exchange(reply_timeout)
         self.workers = [
             HTTPServer(host, 0, api_path, reply_timeout,
-                       exchange=self._exchange)
+                       exchange=self._exchange,
+                       request_read_timeout=request_read_timeout)
             for _ in range(num_workers)]
 
     @property
@@ -233,7 +376,17 @@ class DistributedHTTPServer:
         return [w.address for w in self.workers]
 
     @property
-    def request_queue(self) -> "queue.Queue[Tuple[str, Any]]":
+    def ready_check(self) -> Optional[Callable[[], bool]]:
+        """/readyz hook, fanned out to every worker server."""
+        return self.workers[0].ready_check if self.workers else None
+
+    @ready_check.setter
+    def ready_check(self, check: Optional[Callable[[], bool]]) -> None:
+        for w in self.workers:
+            w.ready_check = check
+
+    @property
+    def request_queue(self) -> "queue.Queue[Tuple[str, Any, float]]":
         return self._exchange.queue
 
     def start(self) -> "DistributedHTTPServer":
@@ -259,12 +412,21 @@ class DistributedHTTPServer:
 
 def join_exchange(exchange: str, worker_id: int,
                   http_host: str = "0.0.0.0", api_path: str = "/",
-                  reply_timeout: float = 30.0, token: str = "") -> None:
+                  reply_timeout: float = 30.0, token: str = "",
+                  request_read_timeout: float = 30.0,
+                  reconnect_tries: int = 5,
+                  reconnect_backoff: Tuple[float, float] = (0.1, 2.0)
+                  ) -> None:
     """Run ONE serving worker against a remote exchange — the multi-host
     entrypoint (each machine runs this next to its accelerator; the
     reference's per-executor DistributedHTTPSource server,
     SURVEY.md §3.4).  Blocks until the exchange sends ``stop`` or the
-    connection drops.  ``exchange`` is the driver's
+    connection drops beyond repair: a dropped exchange link is re-dialed
+    with bounded exponential backoff (``reconnect_tries`` attempts,
+    delays clamped to ``reconnect_backoff=(base, cap)`` seconds) and the
+    worker's still-parked requests are re-queued onto the restored
+    exchange, so an exchange blip does not kill the in-flight requests
+    this worker holds sockets for.  ``exchange`` is the driver's
     ``MultiprocessHTTPServer(spawn_workers=False).exchange_address``;
     ``worker_id`` must be the unique slot index in [0, num_workers);
     ``token`` is the driver's ``MultiprocessHTTPServer.token`` shared
@@ -275,12 +437,17 @@ def join_exchange(exchange: str, worker_id: int,
     encrypt the line protocol."""
     host, _, port = exchange.rpartition(":")
     _mp_worker_main(host, int(port), int(worker_id), http_host, api_path,
-                    reply_timeout, token)
+                    reply_timeout, token, request_read_timeout,
+                    reconnect_tries, reconnect_backoff)
 
 
 def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                     http_host: str, api_path: str,
-                    reply_timeout: float, token: str = "") -> None:
+                    reply_timeout: float, token: str = "",
+                    request_read_timeout: float = 30.0,
+                    reconnect_tries: int = 5,
+                    reconnect_backoff: Tuple[float, float] = (0.1, 2.0)
+                    ) -> None:
     """Worker-process entrypoint (module-level for spawn-pickling).
 
     Owns REAL client sockets in its own process: parks each HTTP request
@@ -292,30 +459,51 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     reference where HTTPSink's reply lands on whichever executor parked
     the socket (expected path io/http/DistributedHTTPSource.scala,
     UNVERIFIED; SURVEY.md §3.4).
+
+    Resilience: the exchange link is held in a mutable slot; when the
+    read pump sees the link die it reconnects with bounded backoff,
+    re-hellos, and re-parks every request still pending here (the
+    requeue half of the executor-loss story — the driver purged those
+    routes when the old link died, so without the re-park the parked
+    clients could only ever time out).  ``/healthz`` reports process
+    liveness; ``/readyz`` reports whether the exchange link is up.
     """
     import socket as _socket
 
-    conn = _socket.create_connection((driver_host, driver_port))
-    # the exchange is a request/reply line protocol: without TCP_NODELAY,
-    # Nagle + delayed-ACK quantizes every reply at ~40 ms
-    conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-    rfile = conn.makefile("r", encoding="utf-8")
+    # "engine_ready" mirrors the driver's ready beacon (None until the
+    # first beacon arrives — treated as ready so a beacon-less driver
+    # degrades to link-up readiness, the pre-beacon contract)
+    link: Dict[str, Any] = {"conn": None, "engine_ready": None}
     wlock = threading.Lock()
+    pending: Dict[str, _Pending] = {}
+    payloads: Dict[str, Any] = {}   # rid -> payload, kept for re-park
+    plock = threading.Lock()
+
+    def connect():
+        c = _socket.create_connection((driver_host, driver_port))
+        # the exchange is a request/reply line protocol: without
+        # TCP_NODELAY, Nagle + delayed-ACK quantizes replies at ~40 ms
+        c.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        return c
 
     def send(obj):
         data = (json.dumps(obj) + "\n").encode("utf-8")
         with wlock:
-            conn.sendall(data)
+            c = link["conn"]
+            if c is None:
+                raise OSError("exchange link down")
+            c.sendall(data)
 
-    pending: Dict[str, _Pending] = {}
-    plock = threading.Lock()
+    link["conn"] = connect()
 
-    class Handler(BaseHTTPRequestHandler):
-        disable_nagle_algorithm = True   # ms-latency serving contract
-        protocol_version = "HTTP/1.1"    # keep-alive (see HTTPServer)
+    class Handler(_ServingHandler):
+        timeout = request_read_timeout   # slow-client read deadline
 
-        def log_message(self, *a):  # quiet
-            pass
+        def _ready(self):
+            # link up AND the driver's engine (if it beacons readiness
+            # over the exchange) has not declared itself down
+            return (link["conn"] is not None
+                    and link["engine_ready"] is not False)
 
         def do_POST(self):
             if api_path not in ("/", self.path):
@@ -332,16 +520,27 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             p = _Pending()
             with plock:
                 pending[rid] = p
-            send({"op": "park", "rid": rid, "payload": payload})
+                payloads[rid] = payload
+            try:
+                send({"op": "park", "rid": rid, "payload": payload})
+            except OSError:
+                # link down RIGHT NOW: stay parked — the reconnect pump
+                # re-parks everything in ``pending`` once the link is
+                # back, and the wait below bounds the client's exposure
+                pass
             ok = p.event.wait(reply_timeout)
             with plock:
                 # atomic here, where the socket lives: once popped, a
                 # racing reply acks delivered=False and the driver
                 # reports the timeout truthfully
                 p2 = pending.pop(rid, None)
+                payloads.pop(rid, None)
             delivered = p2 is not None and p2.event.is_set()
             if not delivered and not ok:
-                send({"op": "expire", "rid": rid})
+                try:
+                    send({"op": "expire", "rid": rid})
+                except OSError:
+                    pass   # link down — driver purged the route anyway
                 self.send_error(504, "pipeline timeout")
                 return
             body = json.dumps(p.response).encode("utf-8")
@@ -351,34 +550,90 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             self.end_headers()
             self.wfile.write(body)
 
-    httpd = type("_Server", (ThreadingHTTPServer,),
-                 {"request_queue_size": 128})((http_host, 0), Handler)
+    httpd = _QuietThreadingHTTPServer((http_host, 0), Handler)
     # a wildcard bind must not advertise 0.0.0.0: report the interface
     # this worker reaches the exchange through — the address a client on
     # another machine can actually dial (multi-host contract)
     adv_host = httpd.server_address[0]
     if adv_host in ("0.0.0.0", "", "::"):
-        adv_host = conn.getsockname()[0]
-    send({"op": "hello", "worker": worker_id, "token": token,
-          "host": adv_host, "port": httpd.server_address[1]})
+        adv_host = link["conn"].getsockname()[0]
+
+    def hello():
+        send({"op": "hello", "worker": worker_id, "token": token,
+              "host": adv_host, "port": httpd.server_address[1]})
+
+    hello()
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
-    for line in rfile:
-        msg = json.loads(line)
-        if msg["op"] == "stop":
+    base, cap = reconnect_backoff
+    stopped = False
+    while not stopped:
+        rfile = link["conn"].makefile("r", encoding="utf-8")
+        try:
+            for line in rfile:
+                msg = json.loads(line)
+                if msg["op"] == "stop":
+                    stopped = True
+                    break
+                if msg["op"] == "ready":
+                    # driver readiness beacon → worker /readyz truth
+                    link["engine_ready"] = bool(msg.get("value"))
+                    continue
+                if msg["op"] == "reply":
+                    rid = msg["rid"]
+                    with plock:
+                        p = pending.get(rid)
+                        if p is not None:
+                            p.response = msg["response"]
+                            p.status = msg.get("status", 200)
+                            p.event.set()
+                    send({"op": "ack", "rid": rid,
+                          "delivered": p is not None})
+        except (OSError, ValueError):
+            pass   # link died mid-line — fall through to reconnect
+        if stopped:
             break
-        if msg["op"] == "reply":
-            rid = msg["rid"]
+        # link dropped: mark down (readyz flips, new parks queue up
+        # locally), then bounded-backoff reconnect
+        with wlock:
+            old, link["conn"] = link["conn"], None
+        try:
+            old.close()   # actively notify the driver's reader
+        except OSError:
+            pass
+        newc = None
+        for attempt in range(max(0, int(reconnect_tries))):
+            time.sleep(min(base * (2 ** attempt), cap))
+            try:
+                newc = connect()
+                break
+            except OSError:
+                continue
+        if newc is None:
+            break   # reconnect budget exhausted: shut down
+        with wlock:
+            link["conn"] = newc
+        try:
+            hello()
+            # REQUEUE: re-park every request still waiting here — the
+            # driver purged this worker's routes when the old link
+            # died, so these rids are unknown to it until re-parked
             with plock:
-                p = pending.get(rid)
-                if p is not None:
-                    p.response = msg["response"]
-                    p.status = msg.get("status", 200)
-                    p.event.set()
-            send({"op": "ack", "rid": rid, "delivered": p is not None})
+                requeue = [(r, payloads[r]) for r in pending
+                           if r in payloads]
+            for rid, payload in requeue:
+                send({"op": "park", "rid": rid, "payload": payload})
+        except OSError:
+            continue   # new link died instantly — loop re-enters
     httpd.shutdown()
     httpd.server_close()
-    conn.close()
+    with wlock:
+        c, link["conn"] = link["conn"], None
+    if c is not None:
+        try:
+            c.close()
+        except OSError:
+            pass
 
 
 class MultiprocessHTTPServer:
@@ -402,12 +657,40 @@ class MultiprocessHTTPServer:
     correctly-tokened hello; still firewall the exchange port to
     cluster hosts — the token authenticates joiners, the line protocol
     itself is plaintext.
+
+    Failure handling (the reference's executor-loss story applied to
+    serving): a dead worker link is detected by its reader thread,
+    which purges the worker's routes (so replies report undelivered
+    immediately instead of hanging), releases its ack waiters, and
+    REOPENS its worker slot — the exchange keeps accepting after
+    ``start()``, so a respawned or reconnecting worker re-hellos into
+    the freed slot.  With ``supervise_workers=True`` (spawned topology)
+    a dead worker PROCESS is respawned automatically; its parked client
+    sockets died with it (those clients see a reset and retry), but
+    capacity and readiness recover without operator action.
+    ``self.counters`` tracks ``worker_deaths`` / ``worker_respawns``.
+
+    Every timeout is constructor-level config so drills and tests can
+    tighten them: ``request_read_timeout`` (worker HTTP slow-client
+    deadline), ``preauth_timeout`` (exchange reader pre-auth),
+    ``ack_grace`` (reply-ack wait beyond ``reply_timeout``),
+    ``reconnect_tries``/``reconnect_backoff`` (worker link re-dial),
+    ``sweep_grace`` (orphaned route/pending sweep slack).
     """
+
+    _SWEEP_EVERY = 512
 
     def __init__(self, num_workers: int = 2, host: str = "127.0.0.1",
                  api_path: str = "/", reply_timeout: float = 30.0,
                  spawn_workers: bool = True, join_timeout: float = 20.0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 request_read_timeout: float = 30.0,
+                 preauth_timeout: float = 30.0,
+                 ack_grace: float = 5.0,
+                 reconnect_tries: int = 5,
+                 reconnect_backoff: Tuple[float, float] = (0.1, 2.0),
+                 supervise_workers: bool = True,
+                 sweep_grace: float = 10.0):
         import secrets
         import socket as _socket
 
@@ -415,27 +698,56 @@ class MultiprocessHTTPServer:
         self._listener = _socket.socket()
         self._listener.bind((host, 0))
         self._listener.listen(num_workers)
-        self.queue: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
-        self._route: Dict[str, int] = {}       # rid -> worker index
-        self._acks: Dict[str, _Pending] = {}   # rid -> ack waiter
+        self.queue: _TrackedQueue = _TrackedQueue()
+        # rid -> (worker conn index, monotonic park time); the stamp
+        # bounds how long an orphaned route can leak (see _sweep_routes)
+        self._route: Dict[str, Tuple[int, float]] = {}
+        self._acks: Dict[str, Tuple[_Pending, int]] = {}  # rid -> waiter
         self._lock = threading.Lock()
         self._conns: List[Any] = []
         self._wlocks: List[threading.Lock] = []
+        self._free_slots: List[int] = []   # reusable dead _conns slots
+        self._conn_worker: Dict[int, int] = {}  # conn idx -> worker slot
         self.addresses: List[str] = [""] * num_workers
+        self.counters = {"worker_deaths": 0, "worker_respawns": 0}
+        # the scoring engine installs its liveness check here; the
+        # beacon thread broadcasts it to worker processes so their
+        # /readyz reflects ENGINE readiness, not just link liveness
+        self.ready_check: Optional[Callable[[], bool]] = None
         self._reply_timeout = reply_timeout
         self._join_timeout = join_timeout
+        self._request_read_timeout = request_read_timeout
+        self._preauth_timeout = preauth_timeout
+        self._ack_grace = ack_grace
+        self._reconnect_tries = reconnect_tries
+        self._reconnect_backoff = reconnect_backoff
+        self._supervise_workers = bool(supervise_workers)
+        self._sweep_grace = sweep_grace
+        self._parks = 0
+        self._host = host
+        self._api_path = api_path
+        self._closing = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._proc_supervisor: Optional[threading.Thread] = None
+        self._ready_beacon: Optional[threading.Thread] = None
 
         self._procs = []
+        self._spawn_workers = spawn_workers
         if spawn_workers:
-            import multiprocessing as mp
-            ctx = mp.get_context("spawn")  # no inherited jax/thread state
-            dh, dp = self._listener.getsockname()
-            self._procs = [
-                ctx.Process(target=_mp_worker_main,
-                            args=(dh, dp, i, host, api_path,
-                                  reply_timeout, self.token),
-                            daemon=True)
-                for i in range(num_workers)]
+            self._procs = [self._make_proc(i)
+                           for i in range(num_workers)]
+
+    def _make_proc(self, worker_id: int):
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")  # no inherited jax/thread state
+        dh, dp = self._listener.getsockname()
+        return ctx.Process(
+            target=_mp_worker_main,
+            args=(dh, dp, worker_id, self._host, self._api_path,
+                  self._reply_timeout, self.token,
+                  self._request_read_timeout, self._reconnect_tries,
+                  self._reconnect_backoff),
+            daemon=True)
 
     @property
     def exchange_address(self) -> str:
@@ -510,14 +822,86 @@ class MultiprocessHTTPServer:
                 f"worker slots {missing} never joined {xaddr} within "
                 f"{budget}s: start one join_exchange(...) per slot with "
                 f"a unique id in [0, {len(self.addresses)}) and this "
-                f"server's .token (invalid/duplicate ids and missing or "
-                f"wrong tokens are dropped and land here)")
+                f"server's .token (invalid ids and missing or wrong "
+                f"tokens are dropped and land here; a duplicate id "
+                f"takes over its slot)")
+        # keep accepting AFTER the initial join: a worker that dies (or
+        # whose link drops) re-hellos into its freed slot — without this
+        # the topology could never heal (ISSUE 3)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="exchange-accept", daemon=True)
+        self._accept_thread.start()
+        if self._procs and self._supervise_workers:
+            self._proc_supervisor = threading.Thread(
+                target=self._supervise_procs, name="worker-supervisor",
+                daemon=True)
+            self._proc_supervisor.start()
+        self._ready_beacon = threading.Thread(
+            target=self._beacon_loop, name="ready-beacon", daemon=True)
+        self._ready_beacon.start()
         return self
+
+    def _beacon_loop(self) -> None:
+        """Broadcast the installed ``ready_check`` verdict to every
+        slotted worker so worker-process ``/readyz`` tells the truth
+        about the ENGINE, not just the exchange link.  No check
+        installed → no beacons → workers fall back to link-up
+        readiness."""
+        while not self._closing.wait(0.5):
+            check = self.ready_check
+            if check is None:
+                continue
+            try:
+                r = bool(check())
+            except Exception:  # noqa: BLE001
+                r = False
+            with self._lock:
+                idxs = list(self._conn_worker)
+            for i in idxs:
+                try:
+                    self._send(i, {"op": "ready", "value": r})
+                except (OSError, IndexError):
+                    pass   # dying link: its reader handles the purge
+
+    def _accept_loop(self) -> None:
+        """Post-start accept pump: rejoining/respawned workers (and any
+        garbage peers — the reader auth handles those) keep landing
+        after the initial join window closes."""
+        import socket as _socket
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except (TimeoutError, OSError):
+                continue   # 0.2 s listener timeout, or closing
+            try:
+                conn.setsockopt(_socket.IPPROTO_TCP,
+                                _socket.TCP_NODELAY, 1)
+            except OSError:
+                continue
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _supervise_procs(self) -> None:
+        """Spawned-worker supervision: a dead worker PROCESS is
+        respawned into its slot (the reader-death purge already freed
+        the slot and failed its in-flight replies).  The respawn binds
+        a fresh HTTP port — ``addresses`` updates on its hello, so
+        callers should re-read it rather than cache."""
+        while not self._closing.wait(0.5):
+            for i, p in enumerate(self._procs):
+                if p.is_alive() or self._closing.is_set():
+                    continue
+                log.warning("serving: worker process %d died "
+                            "(exitcode %s); respawning", i, p.exitcode)
+                self.counters["worker_respawns"] += 1
+                newp = self._make_proc(i)
+                self._procs[i] = newp
+                newp.start()
 
     def _reader(self, conn) -> None:
         # pre-auth read timeout: a silent non-protocol peer must not
         # park a reader thread on the exchange forever
-        conn.settimeout(30.0)
+        conn.settimeout(self._preauth_timeout)
         rfile = conn.makefile("r", encoding="utf-8")
         # registration is reported through a mutable slot so a socket
         # error AFTER auth (worker crash mid-read) still reaches the
@@ -544,15 +928,21 @@ class MultiprocessHTTPServer:
             except OSError:
                 pass
             return
-        # worker gone (crash/kill): its parked sockets died with it.
-        # Purge its routes so replies report undelivered immediately and
-        # release any reply() calls waiting on acks FROM THIS WORKER
-        # (acks carry the worker index — routes and acks are disjoint
-        # because reply() pops the route before registering the ack) —
-        # the surviving workers keep serving (the reference's executor
-        # loss story, SURVEY.md §5.3 applied to serving).
+        # worker gone (crash/kill/link drop): purge its routes so
+        # replies report undelivered immediately, release any reply()
+        # calls waiting on acks FROM THIS WORKER (acks carry the worker
+        # index — routes and acks are disjoint because reply() pops the
+        # route before registering the ack), and REOPEN its worker slot
+        # so a respawned or reconnecting worker can hello back in — the
+        # surviving workers keep serving (the reference's executor loss
+        # story, SURVEY.md §5.3 applied to serving).  Requests from
+        # this worker still in ``self.queue`` score normally; their
+        # replies find no route and report undelivered (a killed
+        # worker's client sockets died with it — if the worker is alive
+        # and merely reconnecting, it re-parks them itself).
         with self._lock:
-            for r in [r for r, i in self._route.items() if i == idx]:
+            for r in [r for r, (i, _) in self._route.items()
+                      if i == idx]:
                 self._route.pop(r, None)
             dead_acks = [r for r, (_, i) in self._acks.items()
                          if i == idx]
@@ -560,12 +950,29 @@ class MultiprocessHTTPServer:
                 waiter, _ = self._acks.pop(r)
                 waiter.response = False
                 waiter.event.set()
+            w = self._conn_worker.pop(idx, None)
+            if w is not None and 0 <= w < len(self.addresses):
+                self.addresses[w] = ""   # slot freed for rejoin
+            if w is not None:
+                # only a conn that actually HELD a worker slot counts
+                # as a worker death — an authed peer with an invalid/
+                # superseded hello never represented capacity (a
+                # takeover's stale link lands here too: its slot entry
+                # was already moved to the new conn, so no death)
+                self.counters["worker_deaths"] += 1
         # close the link so a still-alive (but protocol-broken) worker
         # notices, and later _send()s fail fast instead of queueing
         try:
             conn.close()
         except OSError:
             pass
+        # free the slot for reuse LAST — only after every reference to
+        # idx above has been purged
+        with self._lock:
+            if 0 <= idx < len(self._conns) \
+                    and self._conns[idx] is conn:
+                self._conns[idx] = None
+                self._free_slots.append(idx)
 
     def _reader_loop(self, conn, rfile, reg: List[int]) -> None:
         """Line-protocol pump for one exchange connection.  Writes the
@@ -603,12 +1010,18 @@ class MultiprocessHTTPServer:
                         pass
                     return  # nothing registered — no purge
                 # authed: only now claim exchange state (ADVICE r5 — a
-                # dropped peer must never consume a _conns slot)
+                # dropped peer must never consume a _conns slot).  Dead
+                # slots are reused so worker flapping cannot grow the
+                # conn table without bound.
                 conn.settimeout(None)
                 with self._lock:
-                    idx = len(self._conns)
-                    self._conns.append(conn)
-                    self._wlocks.append(threading.Lock())
+                    if self._free_slots:
+                        idx = self._free_slots.pop()
+                        self._conns[idx] = conn
+                    else:
+                        idx = len(self._conns)
+                        self._conns.append(conn)
+                        self._wlocks.append(threading.Lock())
                 reg[0] = idx
             if op == "hello":
                 w = msg.get("worker")
@@ -618,16 +1031,45 @@ class MultiprocessHTTPServer:
                                 "worker id %r (need 0..%d)", w,
                                 len(self.addresses) - 1)
                     continue
-                if self.addresses[w]:
-                    log.warning("serving: duplicate hello for worker "
-                                "slot %d ignored (unique ids required)",
-                                w)
-                    continue
+                # newest-wins slot claim: a tokened hello for an
+                # occupied slot means the worker reconnected before the
+                # old link's death was detected (asymmetric partition —
+                # ISSUE 3 review finding).  Take the slot over and
+                # close the stale link; dropping its _conn_worker entry
+                # FIRST means the stale reader's purge cannot wipe the
+                # live worker's address.  (Two genuinely distinct
+                # workers sharing an id will flap here — that operator
+                # error is loudly logged either way.)
+                stale = None
+                with self._lock:
+                    old_idx = next(
+                        (i for i, ww in self._conn_worker.items()
+                         if ww == w), None)
+                    if old_idx is not None and old_idx != idx:
+                        log.warning(
+                            "serving: worker slot %d re-helloed on a "
+                            "new connection; replacing the stale link",
+                            w)
+                        self._conn_worker.pop(old_idx, None)
+                        stale = self._conns[old_idx]
+                    self._conn_worker[idx] = w
                 self.addresses[w] = f"http://{msg['host']}:{msg['port']}"
+                if stale is not None:
+                    try:
+                        stale.close()   # force the old reader's purge
+                    except OSError:
+                        pass
             elif op == "park":
                 with self._lock:
-                    self._route[msg["rid"]] = idx
-                self.queue.put((msg["rid"], msg["payload"]))
+                    self._route[msg["rid"]] = (idx, time.monotonic())
+                    self._parks += 1
+                    if self._parks % self._SWEEP_EVERY == 0:
+                        self._sweep_routes_locked()
+                # put_unique: a reconnect re-park whose first copy is
+                # still queued only restores the route (above) — it
+                # must not enqueue a second copy to be scored twice
+                self.queue.put_unique((msg["rid"], msg["payload"],
+                                       time.perf_counter()))
             elif op == "expire":
                 with self._lock:
                     self._route.pop(msg["rid"], None)
@@ -642,19 +1084,38 @@ class MultiprocessHTTPServer:
     def _send(self, idx: int, obj) -> None:
         data = (json.dumps(obj) + "\n").encode("utf-8")
         with self._wlocks[idx]:
-            self._conns[idx].sendall(data)
+            c = self._conns[idx]
+            if c is None:
+                raise OSError("exchange link closed")
+            c.sendall(data)
+
+    def _sweep_routes_locked(self) -> None:
+        """Drop routes whose worker-side handler must be gone: a live
+        handler expires its rid at ``reply_timeout``; entries older
+        than twice that (+ grace) mean the expire never arrived (wedged
+        worker handler thread).  Called under ``self._lock``."""
+        horizon = time.monotonic() - (2 * self._reply_timeout
+                                      + self._sweep_grace)
+        stale = [r for r, (_, t) in self._route.items() if t < horizon]
+        for r in stale:
+            del self._route[r]
+        if stale:
+            log.warning("serving: swept %d orphaned reply routes",
+                        len(stale))
 
     @property
-    def request_queue(self) -> "queue.Queue[Tuple[str, Any]]":
+    def request_queue(self) -> "queue.Queue[Tuple[str, Any, float]]":
         return self.queue
 
     def get_batch(self, max_rows: int = 64, timeout: float = 0.05
                   ) -> List[Tuple[str, Any]]:
+        """Micro-batch pull as legacy ``(rid, payload)`` 2-tuples; the
+        enqueue stamps stay on the raw queue for the scoring engine."""
         batch: List[Tuple[str, Any]] = []
         try:
-            batch.append(self.queue.get(timeout=timeout))
+            batch.append(self.queue.get(timeout=timeout)[:2])
             while len(batch) < max_rows:
-                batch.append(self.queue.get_nowait())
+                batch.append(self.queue.get_nowait()[:2])
         except queue.Empty:
             pass
         return batch
@@ -666,9 +1127,10 @@ class MultiprocessHTTPServer:
         decides atomically, so a reply racing the worker-side timeout
         reports exactly what the client saw)."""
         with self._lock:
-            idx = self._route.pop(request_id, None)
-            if idx is None:
+            entry = self._route.pop(request_id, None)
+            if entry is None:
                 return False
+            idx = entry[0]
             waiter = _Pending()
             self._acks[request_id] = (waiter, idx)
         try:
@@ -679,7 +1141,7 @@ class MultiprocessHTTPServer:
             with self._lock:
                 self._acks.pop(request_id, None)
             return False
-        if not waiter.event.wait(self._reply_timeout + 5.0):
+        if not waiter.event.wait(self._reply_timeout + self._ack_grace):
             with self._lock:
                 self._acks.pop(request_id, None)
             return False
@@ -692,9 +1154,10 @@ class MultiprocessHTTPServer:
         waiting: List[_Pending] = []
         for rid, response, status in entries:
             with self._lock:
-                idx = self._route.pop(rid, None)
-                if idx is None:
+                entry = self._route.pop(rid, None)
+                if entry is None:
                     continue
+                idx = entry[0]
                 waiter = _Pending()
                 self._acks[rid] = (waiter, idx)
             try:
@@ -706,7 +1169,8 @@ class MultiprocessHTTPServer:
                 continue
             waiting.append((rid, waiter))
         delivered = 0
-        deadline = time.monotonic() + self._reply_timeout + 5.0
+        deadline = time.monotonic() + self._reply_timeout \
+            + self._ack_grace
         for rid, waiter in waiting:
             if waiter.event.wait(max(0.0, deadline - time.monotonic())) \
                     and bool(waiter.response):
@@ -717,6 +1181,7 @@ class MultiprocessHTTPServer:
         return delivered
 
     def stop(self) -> None:
+        self._closing.set()    # accept loop + supervisor wind down
         for i in range(len(self._conns)):
             try:
                 self._send(i, {"op": "stop"})
@@ -727,11 +1192,22 @@ class MultiprocessHTTPServer:
             if p.is_alive():
                 p.terminate()
         for c in self._conns:
+            if c is None:
+                continue   # freed slot (dead worker link)
             try:
                 c.close()
             except OSError:
                 pass
         self._listener.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+            self._accept_thread = None
+        if self._proc_supervisor is not None:
+            self._proc_supervisor.join(timeout=5)
+            self._proc_supervisor = None
+        if self._ready_beacon is not None:
+            self._ready_beacon.join(timeout=5)
+            self._ready_beacon = None
 
 
 def request_table(batch: List[Tuple[str, Any]]) -> DataTable:
@@ -739,9 +1215,11 @@ def request_table(batch: List[Tuple[str, Any]]) -> DataTable:
 
     Dict payloads with shared keys become real columns (vector columns for
     list values); anything else lands in a ``value`` object column.
+    Entries may be ``(rid, payload)`` or the stamped ``(rid, payload,
+    t_enqueue)`` triples the resilience-aware queue carries.
     """
-    ids = np.asarray([rid for rid, _ in batch], dtype=object)
-    payloads = [p for _, p in batch]
+    ids = np.asarray([e[0] for e in batch], dtype=object)
+    payloads = [e[1] for e in batch]
     cols: Dict[str, Any] = {"id": ids}
     if payloads and all(isinstance(p, dict) for p in payloads):
         keys = set(payloads[0])

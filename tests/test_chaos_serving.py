@@ -1,0 +1,458 @@
+"""Serving-side chaos smoke (tier-1 fast): seeded fault injection over
+the ScoringEngine resilience layer — admission control, per-request
+deadlines, per-row salvage, worker supervision, drain, health endpoints
+(ISSUE 3).  The full multiprocess drill lives in
+``tools/chaos_serving.py``; this file is the < 30 s CPU subset wired
+into the tier-1 run so resilience regressions fail tests, not just
+drills."""
+
+import json
+import queue
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.chaos import (ChaosPlan, ChaosPredictor, ChaosQueue,
+                                   ChaosSocket)
+from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+from mmlspark_tpu.io.serving import HTTPServer
+
+
+class FakeServer:
+    """Exchange-contract stub: a raw request queue + recorded replies."""
+
+    def __init__(self, q=None):
+        self.request_queue = q if q is not None else queue.Queue()
+        self.replies = []
+        self._lock = threading.Lock()
+
+    def reply(self, rid, val, status=200):
+        with self._lock:
+            self.replies.append((rid, val, status))
+        return True
+
+    def by_rid(self):
+        with self._lock:
+            return {r[0]: r for r in self.replies}
+
+
+def scorer(X):
+    """Deterministic ground truth for bit-exactness checks."""
+    return X[:, 0] * 2.0 + X[:, 1]
+
+
+def wait_replies(srv, n, timeout=10.0):
+    deadline = time.time() + timeout
+    while len(srv.replies) < n and time.time() < deadline:
+        time.sleep(0.01)
+    return len(srv.replies)
+
+
+class TestChaosDeterminism:
+    def test_channel_sequence_reproducible(self):
+        s1 = [ChaosPlan(seed=42).channel("x").fire(0.3)
+              for _ in range(1)]  # warm form check below uses fresh plans
+        p1, p2 = ChaosPlan(seed=42), ChaosPlan(seed=42)
+        seq1 = [p1.channel("x").fire(0.3) for _ in range(200)]
+        seq2 = [p2.channel("x").fire(0.3) for _ in range(200)]
+        assert seq1 == seq2
+        assert any(seq1) and not all(seq1)   # actually Bernoulli(0.3)
+        assert s1[0] == seq1[0]
+
+    def test_channels_independent(self):
+        """Interleaving draws on another channel must not perturb a
+        channel's own sequence (thread-interleaving determinism)."""
+        pa = ChaosPlan(7)
+        a1 = [pa.channel("a").fire(0.5) for _ in range(100)]
+        pb = ChaosPlan(7)
+        a2 = []
+        for _ in range(100):
+            pb.channel("b").fire(0.5)       # noise on another channel
+            a2.append(pb.channel("a").fire(0.5))
+        assert a1 == a2
+
+    def test_plan_counts_ledger(self):
+        p = ChaosPlan(3)
+        for _ in range(50):
+            p.channel("c").fire(0.5)
+        counts = p.counts()["c"]
+        assert counts["calls"] == 50
+        assert 0 < counts["fired"] < 50
+
+
+class TestEngineChaos:
+    def test_worker_kill_restarts_and_salvages(self):
+        """A WorkerKilled mid-batch (thread death) restarts the worker
+        and salvages the batch per-row: every request answered, values
+        exact, restarted/salvaged counters visible."""
+        plan = ChaosPlan(seed=11)
+        pred = ChaosPredictor(scorer, plan, kill_on_calls={1})
+        srv = FakeServer()
+        X = np.arange(24, dtype=np.float32).reshape(12, 2)
+        for i in range(12):
+            srv.request_queue.put((f"r{i}", {"features": X[i].tolist()}))
+        eng = ScoringEngine(srv, predictor=pred,
+                            plan=ColumnPlan("features", 2),
+                            max_rows=64, latency_budget_ms=20.0).start()
+        try:
+            assert wait_replies(srv, 12) == 12
+            want = scorer(X)
+            by = srv.by_rid()
+            for i in range(12):
+                assert by[f"r{i}"][2] == 200
+                assert by[f"r{i}"][1] == pytest.approx(float(want[i]))
+            snap = eng.stats_snapshot()
+            assert snap["counters"]["restarted"] >= 1
+            assert snap["counters"]["salvaged"] == 12
+            assert pred.kills == 1
+            # engine recovered: it still serves after the faults
+            srv.request_queue.put(("post", {"features": [5.0, 1.0]}))
+            assert wait_replies(srv, 13) == 13
+            # raw count too: dict dedup would hide a double-delivery
+            assert len(srv.replies) == 13
+            assert srv.by_rid()["post"][1] == pytest.approx(11.0)
+            assert eng.is_ready()
+        finally:
+            eng.stop()
+
+    def test_predictor_faults_zero_wrong_answers(self):
+        """30% injected predictor faults: every request gets an
+        explicit reply, every 200 is exact, failures are explicit 500s
+        — never a wrong value, never a hang."""
+        plan = ChaosPlan(seed=5)
+        pred = ChaosPredictor(scorer, plan, exc_rate=0.3)
+        srv = FakeServer()
+        eng = ScoringEngine(srv, predictor=pred,
+                            plan=ColumnPlan("features", 2),
+                            max_rows=8, latency_budget_ms=2.0).start()
+        X = np.arange(120, dtype=np.float32).reshape(60, 2)
+        try:
+            for i in range(60):
+                srv.request_queue.put(
+                    (f"r{i}", {"features": X[i].tolist()}))
+                if i % 7 == 0:
+                    time.sleep(0.002)      # vary batch shapes
+            assert wait_replies(srv, 60) == 60
+            want = scorer(X)
+            by = srv.by_rid()
+            statuses = {s for _, _, s in srv.replies}
+            assert statuses <= {200, 500}
+            for i in range(60):
+                rid = f"r{i}"
+                if by[rid][2] == 200:
+                    assert by[rid][1] == pytest.approx(float(want[i]))
+                else:
+                    assert by[rid][1] == {"error": "scoring failed"}
+            assert eng.stats_snapshot()["counters"]["salvaged"] > 0
+        finally:
+            eng.stop()
+
+    def test_shed_under_burst(self):
+        """A burst past max_queue_depth sheds the overflow with explicit
+        503s — every request answered exactly once, live rows exact."""
+
+        def slow(X):
+            time.sleep(0.02)
+            return scorer(X)
+
+        srv = FakeServer()
+        X = np.arange(80, dtype=np.float32).reshape(40, 2)
+        for i in range(40):
+            srv.request_queue.put((f"r{i}", {"features": X[i].tolist()}))
+        eng = ScoringEngine(srv, predictor=slow,
+                            plan=ColumnPlan("features", 2),
+                            max_rows=4, latency_budget_ms=1.0,
+                            max_queue_depth=4, num_scorers=2).start()
+        try:
+            assert wait_replies(srv, 40) == 40
+            by = srv.by_rid()
+            assert len(by) == 40               # exactly-once replies
+            want = scorer(X)
+            n_shed = 0
+            for i in range(40):
+                rid, val, status = by[f"r{i}"]
+                if status == 503:
+                    n_shed += 1
+                    assert val == {"error": "shed"}
+                else:
+                    assert status == 200
+                    assert val == pytest.approx(float(want[i]))
+            assert n_shed > 0
+            assert eng.stats_snapshot()["counters"]["shed"] == n_shed
+        finally:
+            eng.stop()
+
+    def test_deadline_expiry_skips_scoring(self):
+        """Requests already past their deadline are 504d at batch close
+        and the predictor NEVER sees them (no burned batch slots)."""
+        calls = []
+
+        def counting(X):
+            calls.append(len(X))
+            return scorer(X)
+
+        srv = FakeServer()
+        old = time.perf_counter() - 10.0    # stamped 10 s ago
+        for i in range(4):
+            srv.request_queue.put(
+                (f"stale{i}", {"features": [1.0, 0.0]}, old))
+        eng = ScoringEngine(srv, predictor=counting,
+                            plan=ColumnPlan("features", 2),
+                            deadline_ms=1000.0,
+                            latency_budget_ms=5.0).start()
+        try:
+            assert wait_replies(srv, 4) == 4
+            assert all(s == 504 and v == {"error": "expired"}
+                       for _, v, s in srv.replies)
+            assert calls == []             # nothing was scored
+            assert eng.stats_snapshot()["counters"]["expired"] == 4
+            # fresh requests still score; per-request override honored
+            srv.request_queue.put(
+                ("fresh", {"features": [3.0, 1.0]}))
+            srv.request_queue.put(
+                ("custom", {"features": [1.0, 1.0],
+                            "_deadline_ms": 0.001},
+                 time.perf_counter() - 0.5))
+            assert wait_replies(srv, 6) == 6
+            by = srv.by_rid()
+            assert by["fresh"][1] == pytest.approx(7.0)
+            assert by["custom"][2] == 504
+        finally:
+            eng.stop()
+
+    def test_queue_stall_chaos_only_delays(self):
+        """A stalling intake queue slows things down but loses nothing."""
+        plan = ChaosPlan(seed=9)
+        srv = FakeServer(ChaosQueue(queue.Queue(), plan,
+                                    stall_rate=0.5, stall_s=0.005))
+        eng = ScoringEngine(srv, predictor=scorer,
+                            plan=ColumnPlan("features", 2),
+                            latency_budget_ms=2.0).start()
+        try:
+            X = np.arange(40, dtype=np.float32).reshape(20, 2)
+            for i in range(20):
+                srv.request_queue.put(
+                    (f"r{i}", {"features": X[i].tolist()}))
+            assert wait_replies(srv, 20) == 20
+            want = scorer(X)
+            by = srv.by_rid()
+            for i in range(20):
+                assert by[f"r{i}"][1] == pytest.approx(float(want[i]))
+        finally:
+            eng.stop()
+
+    def test_stop_drain_answers_queued_work(self):
+        """stop(drain=True) answers everything already accepted before
+        the workers exit."""
+        srv = FakeServer()
+        eng = ScoringEngine(srv, predictor=scorer,
+                            plan=ColumnPlan("features", 2),
+                            max_rows=4, latency_budget_ms=1.0).start()
+        for i in range(30):
+            srv.request_queue.put((f"r{i}", {"features": [float(i), 0.0]}))
+        eng.stop(drain=True, drain_timeout=10.0)
+        assert len(srv.replies) == 30
+        assert srv.request_queue.qsize() == 0
+        assert not eng.is_ready()
+
+
+class TestHealthEndpoints:
+    def _get(self, url, timeout=5.0):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_healthz_and_readyz_lifecycle(self):
+        srv = HTTPServer().start()
+        try:
+            assert self._get(srv.address + "/healthz") \
+                == (200, {"status": "ok"})
+            # no engine attached yet: alive but not ready
+            assert self._get(srv.address + "/readyz") \
+                == (503, {"ready": False})
+            eng = ScoringEngine(srv, predictor=scorer,
+                                plan=ColumnPlan("features", 2)).start()
+            try:
+                assert self._get(srv.address + "/readyz") \
+                    == (200, {"ready": True})
+            finally:
+                eng.stop()
+            assert self._get(srv.address + "/readyz") \
+                == (503, {"ready": False})
+        finally:
+            srv.stop()
+
+
+class TestSlowAndBrokenClients:
+    def test_slow_client_read_deadline_frees_handler(self):
+        """A client that sends headers then trickles nothing must be
+        cut off by the read deadline, and the server keeps serving."""
+        srv = HTTPServer(request_read_timeout=0.5).start()
+        eng = ScoringEngine(srv, predictor=scorer,
+                            plan=ColumnPlan("features", 2),
+                            latency_budget_ms=2.0).start()
+        try:
+            s = socket.create_connection((srv.host, srv.port), timeout=5)
+            t0 = time.perf_counter()
+            s.sendall(b"POST / HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: 100\r\n\r\n")   # body never sent
+            s.settimeout(5.0)
+            data = s.recv(4096)     # server must close, not hang
+            elapsed = time.perf_counter() - t0
+            assert data == b""
+            assert elapsed < 4.0
+            s.close()
+            # a normal request still round-trips
+            req = urllib.request.Request(
+                srv.address,
+                data=json.dumps({"features": [2.0, 1.0]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read()) == pytest.approx(5.0)
+        finally:
+            eng.stop()
+            srv.stop()
+
+    def test_chaos_socket_resets_do_not_kill_server(self):
+        """ChaosSocket-driven clients (resets, partial writes, stalls)
+        against the HTTP server: the server survives and clean clients
+        keep getting exact answers."""
+        plan = ChaosPlan(seed=23)
+        srv = HTTPServer(request_read_timeout=1.0).start()
+        eng = ScoringEngine(srv, predictor=scorer,
+                            plan=ColumnPlan("features", 2),
+                            latency_budget_ms=2.0).start()
+        payload = json.dumps({"features": [1.0, 1.0]}).encode()
+        raw = (b"POST / HTTP/1.1\r\nHost: x\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Content-Length: %d\r\n\r\n%s" % (len(payload), payload))
+        try:
+            for i in range(12):
+                base = socket.create_connection((srv.host, srv.port),
+                                                timeout=5)
+                cs = ChaosSocket(base, plan, reset_rate=0.3,
+                                 partial_rate=0.3, slow_rate=0.2,
+                                 slow_s=0.01, name=f"client{i}")
+                try:
+                    cs.sendall(raw)
+                    base.settimeout(5.0)
+                    base.recv(4096)
+                except (ConnectionResetError, OSError):
+                    pass        # the injected fault — server's problem
+                finally:
+                    try:
+                        base.close()
+                    except OSError:
+                        pass
+            # at least one injector actually fired across the clients
+            assert any(c["fired"] > 0 for c in plan.counts().values())
+            # clean client: exact answer after the abuse
+            req = urllib.request.Request(
+                srv.address,
+                data=json.dumps({"features": [4.0, 2.0]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read()) == pytest.approx(10.0)
+        finally:
+            eng.stop()
+            srv.stop()
+
+
+class TestFormRobustness:
+    def test_duck_queue_without_qsize_still_serves(self):
+        """max_queue_depth against a duck-typed queue exposing no
+        qsize(): depth shedding is skipped, nothing crashes, every
+        request is answered (review finding: a forming crash must not
+        strand dequeued rows)."""
+
+        class MiniQ:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def put(self, item):
+                self._q.put(item)
+
+            def get(self, block=True, timeout=None):
+                return self._q.get(block, timeout)
+
+            def get_nowait(self):
+                return self._q.get_nowait()
+
+        srv = FakeServer(MiniQ())
+        eng = ScoringEngine(srv, predictor=scorer,
+                            plan=ColumnPlan("features", 2),
+                            max_rows=4, latency_budget_ms=2.0,
+                            max_queue_depth=2).start()
+        try:
+            for i in range(10):
+                srv.request_queue.put(
+                    (f"r{i}", {"features": [float(i), 0.0]}))
+            assert wait_replies(srv, 10) == 10
+            assert all(s == 200 for _, _, s in srv.replies)
+            assert eng.stats_snapshot()["counters"]["restarted"] == 0
+        finally:
+            eng.stop()
+
+    def test_malformed_queue_item_gets_error_not_hang(self):
+        """A non-tuple garbage item on the raw queue crashes forming;
+        co-dequeued legit rows must still get replies."""
+        srv = FakeServer()
+        srv.request_queue.put(("good1", {"features": [1.0, 0.0]}))
+        srv.request_queue.put(42)          # garbage (not a tuple)
+        srv.request_queue.put(("good2", {"features": [2.0, 0.0]}))
+        eng = ScoringEngine(srv, predictor=scorer,
+                            plan=ColumnPlan("features", 2),
+                            max_rows=8, latency_budget_ms=20.0).start()
+        try:
+            assert wait_replies(srv, 2) == 2
+            by = srv.by_rid()
+            # the two addressable rows were answered (values or 500s —
+            # the contract is no silent drops), the garbage was dropped
+            assert set(by) == {"good1", "good2"}
+        finally:
+            eng.stop()
+
+    def test_tracked_queue_put_unique(self):
+        """Driver-queue dedup behind reconnect re-park: a rid still
+        aboard is not enqueued twice; once dequeued it may re-enter."""
+        from mmlspark_tpu.io.serving import _TrackedQueue
+        q = _TrackedQueue()
+        assert q.put_unique(("a", {"x": 1}, 0.0)) is True
+        assert q.put_unique(("a", {"x": 1}, 0.0)) is False
+        assert q.qsize() == 1
+        assert q.get()[0] == "a"
+        assert q.put_unique(("a", {"x": 1}, 0.0)) is True
+
+
+class TestExchangeLeakRegression:
+    def test_late_reply_after_timeout_no_leak(self):
+        """ISSUE 3 satellite: a reply arriving AFTER the handler's wait
+        expired must neither deliver nor leak the pending entry."""
+        from mmlspark_tpu.io.serving import _Exchange
+        ex = _Exchange(reply_timeout=0.2)
+        rid, pending = ex.park({"x": 1})
+        ok = pending.event.wait(ex.reply_timeout)   # expires
+        assert not ok
+        assert not ex.unpark(rid)                   # handler cleanup
+        assert ex.pending == {}                     # no leaked entry
+        assert ex.reply(rid, {"y": 2}) is False     # late reply refused
+
+    def test_orphaned_pending_swept(self):
+        """A pending entry whose handler died (never unparked) is swept
+        after the bounded horizon instead of leaking forever."""
+        from mmlspark_tpu.io.serving import _Exchange
+        ex = _Exchange(reply_timeout=0.01, sweep_grace=0.0)
+        rid, _ = ex.park({"x": 1})          # handler "dies" here
+        time.sleep(0.05)                    # > 2*reply_timeout + grace
+        for _ in range(ex._SWEEP_EVERY):    # trigger the amortized sweep
+            r2, p2 = ex.park({"x": 2})
+            ex.unpark(r2)
+        assert rid not in ex.pending
+        assert ex.reply(rid, {"y": 9}) is False

@@ -328,9 +328,10 @@ class TestDeadlineBatching:
         finally:
             eng.stop()
 
-    def test_scorer_exception_replies_500(self):
-        """A predictor blow-up (not a bad request) 500s the batch and
-        the worker keeps serving."""
+    def test_scorer_exception_salvages_per_row(self):
+        """A TRANSIENT predictor blow-up no longer 500s the batch: the
+        engine retries row by row, so the rows score on the salvage
+        pass and the worker keeps serving (ISSUE 3 resilience layer)."""
         calls = []
 
         def flaky(X):
@@ -348,11 +349,45 @@ class TestDeadlineBatching:
             deadline = time.time() + 5
             while not srv.replies and time.time() < deadline:
                 time.sleep(0.01)
-            assert srv.replies[0][2] == 500
+            assert srv.replies[0] == ("r1", pytest.approx(1.0), 200)
+            assert eng.stats_snapshot()["counters"]["salvaged"] == 1
             srv.request_queue.put(("r2", {"features": [3.0, 0.0]}))
             while len(srv.replies) < 2 and time.time() < deadline:
                 time.sleep(0.01)
             assert srv.replies[1] == ("r2", pytest.approx(3.0), 200)
+        finally:
+            eng.stop()
+
+    def test_persistent_poison_row_fails_alone(self):
+        """A payload that ALWAYS crashes the predictor gets its own 500
+        after per-row salvage; co-batched neighbors still score."""
+
+        def poisoned(X):
+            if np.any(X[:, 0] == 666.0):
+                raise RuntimeError("poison payload")
+            return X[:, 0]
+
+        srv = FakeServer()
+        eng = ScoringEngine(srv, predictor=poisoned,
+                            plan=ColumnPlan("features", 2),
+                            max_rows=8, latency_budget_ms=30.0,
+                            pad_buckets=False)
+        # enqueue BEFORE start so all three land in ONE batch — the
+        # salvage accounting below depends on them being co-batched
+        srv.request_queue.put(("g1", {"features": [1.0, 0.0]}))
+        srv.request_queue.put(("bad", {"features": [666.0, 0.0]}))
+        srv.request_queue.put(("g2", {"features": [2.0, 0.0]}))
+        eng.start()
+        try:
+            deadline = time.time() + 5
+            while len(srv.replies) < 3 and time.time() < deadline:
+                time.sleep(0.01)
+            by_rid = {r[0]: r for r in srv.replies}
+            assert by_rid["bad"][2] == 500
+            assert by_rid["g1"][1] == pytest.approx(1.0)
+            assert by_rid["g2"][1] == pytest.approx(2.0)
+            snap = eng.stats_snapshot()
+            assert snap["counters"]["salvaged"] == 2
         finally:
             eng.stop()
 

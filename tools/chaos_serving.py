@@ -1,0 +1,354 @@
+"""Serving chaos drill (ISSUE 3 acceptance artifact): inject worker
+kill + connection resets + ~10% malformed payloads into the FULL
+multiprocess serving topology and verify the resilience contract:
+
+1. **zero wrong answers** — every delivered 200 is bit-exact vs the
+   clean-run margin for that row;
+2. **no hangs** — every request resolves with an explicit outcome
+   (reply, 4xx/5xx/shed/expired, or a connection error from the killed
+   worker — never a client timeout);
+3. **recovery** — after the faults stop, the killed worker slot is
+   respawned, every worker's ``/readyz`` is green, the engine reports
+   ready, and a clean pass returns bit-exact answers.
+
+Topology: ``MultiprocessHTTPServer`` (2 spawned worker processes,
+supervised) + ``ScoringEngine`` over a real trained booster wrapped in
+``ChaosPredictor``.  All injection draws from a seeded ``ChaosPlan`` —
+same seed, same fault schedule.
+
+Run: ``python tools/chaos_serving.py --out artifacts/chaos_serving_r03.json``
+(~2 min wall on a 2-core CPU box; worker spawns dominate).
+"""
+
+import argparse
+import http.client
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUTCOMES = ("ok", "wrong", "bad_request", "server_error", "shed",
+            "expired", "conn_error", "timeout", "other")
+
+
+class Ledger:
+    """Thread-safe per-outcome tally for one drill phase."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counts = {k: 0 for k in OUTCOMES}
+        self.sent = 0
+
+    def record(self, outcome):
+        with self.lock:
+            self.counts[outcome] += 1
+
+    def snapshot(self):
+        with self.lock:
+            return {"sent": self.sent, **self.counts}
+
+
+def classify(status, value, want_i):
+    if status == 200:
+        ok = (isinstance(value, (int, float))
+              and float(value) == float(want_i))
+        return "ok" if ok else "wrong"
+    if status == 400:
+        return "bad_request"
+    if status == 503:
+        return "shed"
+    if status == 504:
+        return "expired"
+    if status >= 500:
+        return "server_error"
+    return "other"
+
+
+def post_once(addr, body, timeout):
+    """One HTTP POST; returns (status, parsed_json_or_None)."""
+    host, port = addr.replace("http://", "").rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("POST", "/", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, None
+    finally:
+        conn.close()
+
+
+def client_worker(cid, srv, X, want, plan, ledger, n_requests,
+                  malformed_rate, reset_rate, timeout):
+    """One closed-loop chaos client: mostly-clean POSTs with injected
+    malformed payloads and ChaosSocket-driven resets/partial writes."""
+    from mmlspark_tpu.io.chaos import ChaosSocket
+    mal = plan.channel(f"malformed{cid}")
+    rst = plan.channel(f"reset{cid}")
+    for k in range(n_requests):
+        i = (cid * 37 + k) % len(X)
+        payload = json.dumps({"features": X[i].tolist()}).encode()
+        with ledger.lock:
+            ledger.sent += 1
+        try:
+            addrs = [a for a in srv.addresses if a]
+            if not addrs:
+                ledger.record("conn_error")   # mid-respawn window
+                time.sleep(0.2)
+                continue
+            addr = addrs[(cid + k) % len(addrs)]
+            if mal.fire(malformed_rate):
+                # alternate malformed kinds: broken JSON (worker-side
+                # 400) and a wrong-width vector (engine-side 400)
+                if k % 2 == 0:
+                    body = b"{not json" + payload
+                else:
+                    body = json.dumps(
+                        {"features": X[i].tolist()[:3]}).encode()
+                status, _ = post_once(addr, body, timeout)
+                ledger.record("bad_request" if status == 400
+                              else classify(status, None, None))
+            elif rst.fire(reset_rate):
+                # raw-socket client that resets/truncates mid-request
+                host, port = addr.replace("http://", "").rsplit(":", 1)
+                raw = (b"POST / HTTP/1.1\r\nHost: x\r\n"
+                       b"Content-Type: application/json\r\n"
+                       b"Content-Length: %d\r\n\r\n%s"
+                       % (len(payload), payload))
+                base = socket.create_connection((host, int(port)),
+                                                timeout=timeout)
+                cs = ChaosSocket(base, plan, reset_rate=0.5,
+                                 partial_rate=0.5,
+                                 name=f"sock{cid}")
+                try:
+                    cs.sendall(raw)
+                    base.settimeout(timeout)
+                    base.recv(4096)
+                except (ConnectionResetError, OSError):
+                    pass       # the injected fault, by design
+                finally:
+                    try:
+                        base.close()
+                    except OSError:
+                        pass
+                ledger.record("conn_error")
+            else:
+                status, value = post_once(addr, payload, timeout)
+                ledger.record(classify(status, value, want[i]))
+        except socket.timeout:
+            ledger.record("timeout")          # a HANG — drill fails
+        except (ConnectionError, http.client.HTTPException, OSError):
+            ledger.record("conn_error")       # killed worker's clients
+
+
+def clean_pass(srv, X, want, ledger, n_requests, timeout):
+    for k in range(n_requests):
+        i = k % len(X)
+        with ledger.lock:
+            ledger.sent += 1
+        addrs = [a for a in srv.addresses if a]
+        addr = addrs[k % len(addrs)]
+        payload = json.dumps({"features": X[i].tolist()}).encode()
+        try:
+            status, value = post_once(addr, payload, timeout)
+            ledger.record(classify(status, value, want[i]))
+        except socket.timeout:
+            ledger.record("timeout")
+        except (ConnectionError, http.client.HTTPException, OSError):
+            ledger.record("conn_error")
+
+
+def http_get_status(addr, path, timeout=5.0):
+    host, port = addr.replace("http://", "").rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().status
+    except (ConnectionError, socket.timeout, OSError):
+        return -1
+    finally:
+        conn.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="artifact JSON path")
+    ap.add_argument("--seed", type=int, default=303)
+    ap.add_argument("--clients", type=int, default=6)
+    ap.add_argument("--requests", type=int, default=40,
+                    help="chaos-phase requests per client")
+    ap.add_argument("--malformed-rate", type=float, default=0.10)
+    ap.add_argument("--reset-rate", type=float, default=0.10)
+    ap.add_argument("--exc-rate", type=float, default=0.05,
+                    help="injected predictor fault rate")
+    ap.add_argument("--thread-kill-call", type=int, default=25,
+                    help="predictor call index that raises WorkerKilled "
+                         "(engine worker-thread death; 0 disables)")
+    ap.add_argument("--kill-after", type=float, default=1.5,
+                    help="seconds into the chaos phase to SIGKILL a "
+                         "worker process")
+    ap.add_argument("--recovery-timeout", type=float, default=120.0)
+    ap.add_argument("--client-timeout", type=float, default=20.0)
+    ap.add_argument("--trees", type=int, default=10)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+    from mmlspark_tpu.io.chaos import (ChaosPlan, ChaosPredictor,
+                                       kill_process)
+    from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+    from mmlspark_tpu.io.serving import MultiprocessHTTPServer
+
+    rng = np.random.default_rng(args.seed)
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2]).astype(np.float64)
+    t0 = time.time()
+    b = LightGBMRegressor(numIterations=args.trees, numLeaves=15,
+                          parallelism="serial", verbosity=0).fit(
+        {"features": X, "label": y}).getModel()
+    # the ground truth every delivered answer must match bit-exactly
+    want = np.asarray(b.predict_margin(X)).astype(np.float32)
+    print(f"model: {len(b.trees)} trees ({time.time() - t0:.1f}s)",
+          flush=True)
+
+    plan = ChaosPlan(seed=args.seed)
+    kills = ({args.thread_kill_call} if args.thread_kill_call > 0
+             else set())
+    pred = ChaosPredictor(b.predictor(), plan, exc_rate=args.exc_rate,
+                          kill_on_calls=kills)
+
+    srv = MultiprocessHTTPServer(
+        num_workers=2, reply_timeout=10.0, request_read_timeout=3.0,
+        ack_grace=3.0, supervise_workers=True).start()
+    engine = ScoringEngine(
+        srv, predictor=pred, plan=ColumnPlan("features", X.shape[1]),
+        max_rows=64, latency_budget_ms=5.0, num_scorers=2,
+        num_repliers=1, max_queue_depth=512, deadline_ms=8000.0).start()
+
+    detail = {"seed": args.seed,
+              "config": {"workers": 2, "clients": args.clients,
+                         "requests_per_client": args.requests,
+                         "malformed_rate": args.malformed_rate,
+                         "reset_rate": args.reset_rate,
+                         "exc_rate": args.exc_rate,
+                         "thread_kill_call": args.thread_kill_call,
+                         "kill_after_s": args.kill_after,
+                         "trees": len(b.trees)}}
+    try:
+        # ---- phase A: chaos ------------------------------------------
+        print("== chaos phase ==", flush=True)
+        chaos = Ledger()
+        threads = [threading.Thread(
+            target=client_worker,
+            args=(c, srv, X, want, plan, chaos, args.requests,
+                  args.malformed_rate, args.reset_rate,
+                  args.client_timeout), daemon=True)
+            for c in range(args.clients)]
+        t_phase = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(args.kill_after)
+        victim = srv._procs[0]
+        pid = kill_process(victim)
+        print(f"killed worker process 0 (pid {pid})", flush=True)
+        for t in threads:
+            t.join(timeout=args.client_timeout * args.requests)
+        hung_clients = sum(t.is_alive() for t in threads)
+        detail["chaos"] = chaos.snapshot()
+        detail["chaos"]["wall_s"] = round(time.time() - t_phase, 1)
+        detail["chaos"]["hung_clients"] = hung_clients
+        detail["killed_pid"] = pid
+        print(json.dumps(detail["chaos"]), flush=True)
+
+        # ---- phase B: recovery ---------------------------------------
+        print("== recovery ==", flush=True)
+        t_rec = time.time()
+        deadline = time.time() + args.recovery_timeout
+        recovered = False
+        while time.time() < deadline:
+            addrs = [a for a in srv.addresses if a]
+            if (len(addrs) == 2 and engine.is_ready()
+                    and all(http_get_status(a, "/readyz") == 200
+                            for a in addrs)):
+                recovered = True
+                break
+            time.sleep(0.5)
+        detail["recovery"] = {
+            "recovered_ready": recovered,
+            "wall_s": round(time.time() - t_rec, 1),
+            "worker_deaths": srv.counters["worker_deaths"],
+            "worker_respawns": srv.counters["worker_respawns"]}
+        print(json.dumps(detail["recovery"]), flush=True)
+
+        # ---- phase C: clean pass after faults stop -------------------
+        print("== clean pass ==", flush=True)
+        pred._exc_rate = 0.0           # faults stop
+        clean = Ledger()
+        if recovered:
+            clean_pass(srv, X, want, clean, 40, args.client_timeout)
+        detail["clean"] = clean.snapshot()
+        print(json.dumps(detail["clean"]), flush=True)
+
+        snap = engine.stats_snapshot()
+        detail["engine_counters"] = snap["counters"]
+        detail["engine_rows"] = snap["rows"]
+        detail["injected"] = plan.counts()
+        detail["injected_predictor"] = {"calls": pred.calls,
+                                        "excs": pred.excs,
+                                        "kills": pred.kills}
+    finally:
+        engine.stop()
+        srv.stop()
+
+    ch, cl = detail["chaos"], detail["clean"]
+    verdicts = {
+        "zero_wrong_answers": ch["wrong"] == 0 and cl["wrong"] == 0,
+        "no_hangs": (ch["timeout"] == 0 and ch["hung_clients"] == 0
+                     and cl["timeout"] == 0),
+        "every_request_resolved":
+            sum(ch[k] for k in OUTCOMES) == ch["sent"]
+            and sum(cl[k] for k in OUTCOMES) == cl["sent"],
+        "served_through_chaos": ch["ok"] > 0,
+        "explicit_errors_only":
+            ch["other"] == 0 and cl["other"] == 0,
+        "recovered_ready": detail["recovery"]["recovered_ready"],
+        "clean_pass_all_exact":
+            cl["sent"] > 0 and cl["ok"] == cl["sent"],
+        "worker_respawned":
+            detail["recovery"]["worker_respawns"] >= 1,
+        "worker_thread_restarted":
+            args.thread_kill_call == 0
+            or detail["engine_counters"]["restarted"] >= 1,
+        "counters_exposed": all(
+            k in detail["engine_counters"]
+            for k in ("shed", "expired", "salvaged", "restarted")),
+    }
+    result = {
+        "metric": "chaos_serving_drill",
+        "value": int(all(verdicts.values())),
+        "unit": "pass",
+        "verdicts": verdicts,
+        "detail": detail,
+    }
+    print(json.dumps({"verdicts": verdicts,
+                      "pass": bool(all(verdicts.values()))}),
+          flush=True)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"artifact -> {args.out}", flush=True)
+    return 0 if all(verdicts.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
