@@ -112,6 +112,7 @@ class StageStats:
         self._lock = threading.Lock()
         self._stages: Dict[str, LatencyStats] = {}
         self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
         self._rows = 0
         self._t_first: Optional[float] = None
         self._t_last = 0.0
@@ -144,6 +145,17 @@ class StageStats:
         with self._lock:
             return self._counters.get(name, 0)
 
+    def set_gauge(self, name: str, value: float) -> None:
+        """Record a point-in-time level (last-write-wins) — e.g. the
+        elastic watchdog's worst peer heartbeat age, where "how stale
+        NOW" matters and a count or latency distribution would not."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
     def add_rows(self, n: int) -> None:
         now = time.perf_counter()
         with self._lock:
@@ -166,10 +178,12 @@ class StageStats:
         with self._lock:
             stages = dict(self._stages)
             counters = dict(self._counters)
+            gauges = dict(self._gauges)
         return {
             "rows": self._rows,
             "rows_per_s": round(self.rows_per_s(), 2),
             "counters": counters,
+            "gauges": gauges,
             "stages": {name: s.snapshot() for name, s in stages.items()},
         }
 

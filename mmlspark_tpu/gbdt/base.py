@@ -83,6 +83,15 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                         "Tree learner parallelism: serial, data, feature or "
                         "voting (mapped to mesh axes on TPU)",
                         default="data", typeConverter=TypeConverters.toString)
+    autoMeshMinRows = Param(
+        "autoMeshMinRows",
+        "Minimum training rows before fit() auto-shards across all "
+        "visible devices when no mesh is pinned; smaller fits train "
+        "serially (the per-fit shard_map compile and collective "
+        "overhead dwarfs any win on small data).  setMesh() always "
+        "shards regardless of size; 0 restores unconditional "
+        "auto-sharding.",
+        default=65536, typeConverter=TypeConverters.toInt)
     useBarrierExecutionMode = Param(
         "useBarrierExecutionMode",
         "Accepted for API parity; TPU meshes are always gang-scheduled",
@@ -381,9 +390,13 @@ class LightGBMBase(Estimator, LightGBMParams):
         # reference trains across all executors (SURVEY.md §3.1); the
         # parallelism param picks the axis layout.
         # goss stays serial unless a mesh is pinned explicitly (per-shard
-        # sampling is a semantic choice); dart is host-loop only
+        # sampling is a semantic choice); dart is host-loop only.
+        # Below autoMeshMinRows the fit stays serial: sharding a few
+        # thousand rows buys nothing and pays a multi-second shard_map
+        # compile plus per-iteration collectives.
         if mesh is None and grad_override is None and ranking_info is None \
-                and self.getBoostingType() not in ("goss", "dart"):
+                and self.getBoostingType() not in ("goss", "dart") \
+                and len(y_train) >= self.getAutoMeshMinRows():
             import jax
             if jax.device_count() > 1:
                 from .distributed import resolve_mesh

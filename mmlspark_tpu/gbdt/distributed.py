@@ -43,6 +43,21 @@ from .grower import (GrowerConfig, TreeArrays, _grow_tree_impl,
 from .objectives import Objective
 
 
+def _shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable ``shard_map``: newer jax exposes ``jax.shard_map``
+    with a ``check_vma`` kwarg; older releases ship it as
+    ``jax.experimental.shard_map.shard_map`` with the same check under
+    the ``check_rep`` name.  Every mesh path routes through this one
+    shim so a jax upgrade/downgrade is a one-line event, not a broken
+    distributed subsystem."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 VALID_PARALLELISM = ("serial", "data", "feature", "data+feature", "voting")
 
 
@@ -171,7 +186,7 @@ def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
                          else P(None, DATA_AXIS, None))
     else:
         val_hist_spec = P(None, None) if K == 1 else P(None, None, None)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), sc_spec, P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), P(None, None),
@@ -244,7 +259,7 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
 
     bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
     val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), bag_spec,
@@ -304,7 +319,7 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
 
     bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
     val_hist_spec = P(None, DATA_AXIS, None) if has_val else P(None, None)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS, None),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), bag_spec,
@@ -340,7 +355,7 @@ def make_ranking_dart_step(mesh: Mesh, cfg: GrowerConfig, lr: float,
         tree = apply_shrinkage(tree, lr)
         return tree, tree.leaf_value[row_leaf]
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS, None, None),
@@ -392,7 +407,7 @@ def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
     binsT_spec = (P(FEATURE_AXIS, DATA_AXIS) if fshard
                   else P(None, DATA_AXIS))
     fi_spec = P(FEATURE_AXIS, None) if fshard else P(None, None)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=(bins_spec, binsT_spec, sc_spec,
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
@@ -430,7 +445,7 @@ def make_tree_predict(mesh: Mesh, num_leaves: int, num_class: int = 1):
             return jax.vmap(lambda t: walk(t, bins))(trees_st).T
         out_spec = P(DATA_AXIS, None)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         pred, mesh=mesh,
         in_specs=(P(), bins_spec),
         out_specs=out_spec,
@@ -533,7 +548,7 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
 
     val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
     bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         steps, mesh=mesh,
         in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS, None, None),

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import debug as _debug
+from ..core.profiling import StageStats
 from .binning import BinMapper, fit_bin_mapper
 from .booster import Booster, HostTree, host_tree_from_arrays
 from .grower import (EFBArrays, GrowerConfig, TreeArrays, apply_shrinkage,
@@ -101,15 +102,25 @@ class TrainParams:
     enable_bundle: bool = False
     max_conflict_rate: float = 0.0
     #: cross-process mid-fit checkpointing (SURVEY.md §5.3 elasticity):
-    #: non-empty = a directory where the serial scan loop persists
+    #: non-empty = a directory where the chunked scan loops persist
     #: (trees, scores, RNG streams, early-stopping state) at every chunk
     #: boundary; a killed fit re-run with the SAME inputs and params
     #: resumes from the last completed chunk bit-identically.  The
-    #: snapshot is fingerprinted against (shape, params) and ignored
-    #: with a warning on mismatch; it is deleted on successful
-    #: completion.  Serial gbdt/goss/rf/multiclass scan paths; inert
-    #: (with a warning) for dart/ranking host loops and mesh paths.
+    #: snapshot is fingerprinted against (shape, params, topology) and
+    #: ignored with a warning on mismatch; it is deleted on successful
+    #: completion.  Live for the serial AND mesh gbdt/goss/rf/multiclass
+    #: scan paths, including multicontroller sharded ingestion (each
+    #: process persists its own score shards into the shared directory;
+    #: see docs/fault-tolerance.md); inert (with a warning) for
+    #: dart/ranking host loops.
     checkpoint_dir: str = ""
+    #: chunk-boundary cadence when checkpointing: the scan chunk is
+    #: bounded to this many iterations so at most this much work is
+    #: lost to a process death.  Smaller = finer recovery granularity,
+    #: more host syncs.  Chunking never changes the forest (the scan
+    #: body is per-iteration), so this knob is excluded from the resume
+    #: fingerprint.
+    checkpoint_chunk: int = 32
     #: raw passthrough params recorded into the model file (parity with the
     #: reference's passThroughArgs).  Keys that NAME a TrainParams field
     #: are applied onto it (string-coerced) in ``__post_init__`` — like
@@ -181,7 +192,56 @@ def _dummy_val(K: int):
 # -- cross-process mid-fit checkpointing (TrainParams.checkpoint_dir) -------
 
 _CKPT_FILE = "boost_checkpoint.npz"       # meta + loop state, atomic
-_CKPT_CHUNK = "boost_chunk_{:04d}.npz"    # one per tree chunk, write-once
+#: one per tree chunk, write-once.  The index field is wide enough that a
+#: Criteo-class fit (T up to 10^6 with chunk=1) never collides with the
+#: clear glob, which is DERIVED from this template (``_ckpt_glob``), not
+#: hand-maintained alongside it.
+_CKPT_CHUNK = "boost_chunk_{:06d}.npz"
+#: per-process mesh state, stamped with the boundary iteration so the
+#: state write (first) and the meta write (last, process 0) are never
+#: torn against each other: the meta's ``it`` names exactly the state
+#: generation that was durable before it.  The prefix is a separate
+#: constant so the per-process GC glob (prefix + ``*``) stays correct
+#: if the iteration field is ever widened.
+_CKPT_MESH_PREFIX = "mesh_state_p{:03d}_it"
+_CKPT_MESH_STATE = _CKPT_MESH_PREFIX + "{:06d}.npz"
+
+#: Process-wide training recovery observability (the training-side
+#: analog of ``ScoringEngine.stats()``): cumulative counters over every
+#: fit in this process, seeded to explicit zeros so "no recovery event
+#: happened" is observable rather than a missing key.  Tests and the
+#: chaos drill snapshot before/after a fit and assert deltas.
+train_stats = StageStats()
+for _k in ("chunks_replayed", "ckpt_saved", "ckpt_resumed",
+           "ckpt_discarded"):
+    train_stats.incr(_k, 0)
+del _k
+
+
+def _ckpt_glob(template: str) -> str:
+    """Glob pattern for a checkpoint filename template, derived from the
+    template's own format fields (every ``{...}`` becomes ``*``) so a
+    template change can never silently orphan files."""
+    import re
+    return re.sub(r"\{[^{}]*\}", "*", template)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-renamed file survives power loss (the
+    rename itself lives in the directory's metadata; fsyncing the file
+    alone is not enough).  Best-effort: some platforms refuse directory
+    fds, and a checkpoint must never kill the fit it protects."""
+    import os
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _ckpt_fingerprint(n, f, K, params, labels, bins, weights,
@@ -195,7 +255,11 @@ def _ckpt_fingerprint(n, f, K, params, labels, bins, weights,
     same-shape fit on DIFFERENT inputs starts fresh instead of silently
     blending two fits."""
     import hashlib
-    d = {k: v for k, v in params.__dict__.items() if k != "checkpoint_dir"}
+    # checkpoint_dir/checkpoint_chunk shape WHERE and HOW OFTEN snapshots
+    # land, never the boosting trajectory — excluded so moving the
+    # directory or retuning the boundary cadence doesn't orphan a resume
+    d = {k: v for k, v in params.__dict__.items()
+         if k not in ("checkpoint_dir", "checkpoint_chunk")}
     h = hashlib.sha256(
         f"{n}|{f}|{K}|{sorted(d.items())!r}".encode("utf-8"))
     h.update(np.ascontiguousarray(np.asarray(labels)).tobytes())
@@ -221,9 +285,60 @@ def _ckpt_save(ckpt_dir, fp, it, trees_chunks, scores, val_scores,
     the early-stopping bests — is replaced atomically (tmp + fsync +
     rename) last, so a torn save leaves the PREVIOUS boundary loadable.
     A resumed fit replays the remaining chunks on bit-identical inputs."""
-    import json as _json
     import os
     os.makedirs(ckpt_dir, exist_ok=True)
+    _ckpt_write_chunks(ckpt_dir, trees_chunks)
+    _ckpt_write_meta(
+        ckpt_dir, fp, it, len(trees_chunks), rng, bag_rng, best_metric,
+        best_iter,
+        arrays={"scores": np.asarray(scores),
+                "val_scores": np.asarray(val_scores),
+                "cur_bag": np.asarray(cur_bag)},
+        extra_meta={"n_trees": _ckpt_tree_count(trees_chunks)})
+    train_stats.incr("ckpt_saved")
+
+
+def _ckpt_tree_count(trees_chunks) -> int:
+    """Total trees across the chunk list — endorsed by the meta so a
+    load can detect a STALE over-meta chunk file.  The write-once skip
+    in :func:`_ckpt_write_chunks` is only sound while the chunk CADENCE
+    is unchanged: ``checkpoint_chunk`` is deliberately outside the
+    fingerprint (retuning it must not orphan a resume), so a crash
+    between a chunk write and its meta replace, followed by a resume
+    with a different cadence, can leave file ``n`` holding a different
+    iteration count than the new meta implies — identical VALUES are
+    guaranteed by bit-identical replay, counts are not.  Validating
+    the endorsed total at load turns that silent wrong-forest into a
+    discard-and-start-fresh."""
+    # shape alone: no D2H transfer for device-resident mesh chunks
+    return int(sum(ch[0].shape[0] for ch in trees_chunks))
+
+
+def _ckpt_read_chunks(ckpt_dir, n_chunks, n_trees=None):
+    """Load the write-once tree chunk files, closing each npz (a
+    lingering NpzFile holds its zip member open; resumed gangs would
+    otherwise accumulate one fd per chunk per process).  When the
+    meta's endorsed ``n_trees`` is given, a total-count mismatch —
+    a stale over-meta chunk from a different ``checkpoint_chunk``
+    cadence (see :func:`_ckpt_tree_count`) — raises, which the load
+    paths turn into discard-and-start-fresh."""
+    import os
+    chunks = []
+    for i in range(n_chunks):
+        with np.load(os.path.join(ckpt_dir, _CKPT_CHUNK.format(i))) as cz:
+            chunks.append(TreeArrays(*[cz[name]
+                                       for name in TreeArrays._fields]))
+    if n_trees is not None and _ckpt_tree_count(chunks) != n_trees:
+        raise ValueError(
+            f"tree chunk files hold {_ckpt_tree_count(chunks)} trees "
+            f"but the checkpoint meta endorses {n_trees} (stale chunk "
+            f"from a different checkpoint_chunk cadence)")
+    return chunks
+
+
+def _ckpt_write_chunks(ckpt_dir, trees_chunks) -> None:
+    """Write-once tree chunk files (fsync'd, atomic rename each)."""
+    import os
     for i, ch in enumerate(trees_chunks):
         cpath = os.path.join(ckpt_dir, _CKPT_CHUNK.format(i))
         if os.path.exists(cpath):
@@ -235,24 +350,37 @@ def _ckpt_save(ckpt_dir, fp, it, trees_chunks, scores, val_scores,
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, cpath)
+
+
+def _ckpt_write_meta(ckpt_dir, fp, it, n_chunks, rng, bag_rng,
+                     best_metric, best_iter, arrays, extra_meta=None
+                     ) -> None:
+    """The small meta/state file, replaced atomically LAST so a torn
+    save leaves the previous boundary loadable; the containing
+    directory is fsync'd after the rename so the rename itself survives
+    power loss (the file fsync alone only makes the INODE durable, not
+    the directory entry pointing at it)."""
+    import json as _json
+    import os
     meta = {
         "fingerprint": fp, "it": int(it),
-        "n_chunks": len(trees_chunks),
+        "n_chunks": int(n_chunks),
         "rng_state": rng.bit_generator.state,
         "bag_rng_state": bag_rng.bit_generator.state,
         "best_metric": float(best_metric), "best_iter": int(best_iter),
     }
+    if extra_meta:
+        meta.update(extra_meta)
     tmp = os.path.join(ckpt_dir, _CKPT_FILE + ".tmp")
     with open(tmp, "wb") as fh:
         np.savez(fh,
                  __meta__=np.frombuffer(
                      _json.dumps(meta).encode("utf-8"), np.uint8),
-                 scores=np.asarray(scores),
-                 val_scores=np.asarray(val_scores),
-                 cur_bag=np.asarray(cur_bag))
+                 **arrays)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, os.path.join(ckpt_dir, _CKPT_FILE))
+    _fsync_dir(ckpt_dir)
 
 
 def _ckpt_load(ckpt_dir, fp):
@@ -264,21 +392,24 @@ def _ckpt_load(ckpt_dir, fp):
     if not os.path.exists(path):
         return None
     try:
-        z = np.load(path)
-        meta = _json.loads(bytes(z["__meta__"]).decode("utf-8"))
-        if meta["fingerprint"] != fp:
-            log.warning("checkpoint at %s belongs to a different fit "
-                        "(data or params changed); starting fresh", path)
-            return None
-        chunks = []
-        for i in range(meta["n_chunks"]):
-            cz = np.load(os.path.join(ckpt_dir, _CKPT_CHUNK.format(i)))
-            chunks.append(TreeArrays(*[cz[name]
-                                       for name in TreeArrays._fields]))
+        with np.load(path) as z:
+            meta = _json.loads(bytes(z["__meta__"]).decode("utf-8"))
+            if meta["fingerprint"] != fp:
+                log.warning("checkpoint at %s belongs to a different "
+                            "fit (data or params changed); starting "
+                            "fresh", path)
+                train_stats.incr("ckpt_discarded")
+                return None
+            arrays = {k: z[k] for k in ("scores", "val_scores",
+                                        "cur_bag")}
         return {
-            "it": meta["it"], "trees_chunks": chunks,
-            "scores": z["scores"], "val_scores": z["val_scores"],
-            "cur_bag": z["cur_bag"],
+            "it": meta["it"],
+            "trees_chunks": _ckpt_read_chunks(ckpt_dir,
+                                              meta["n_chunks"],
+                                              meta.get("n_trees")),
+            "scores": arrays["scores"],
+            "val_scores": arrays["val_scores"],
+            "cur_bag": arrays["cur_bag"],
             "rng_state": meta["rng_state"],
             "bag_rng_state": meta["bag_rng_state"],
             "best_metric": meta["best_metric"],
@@ -290,19 +421,279 @@ def _ckpt_load(ckpt_dir, fp):
         # to "no checkpoint existed" in the logs otherwise
         log.warning("checkpoint at %s is unreadable (%s: %s); "
                     "starting fresh", path, type(e).__name__, e)
+        train_stats.incr("ckpt_discarded")
         return None
 
 
 def _ckpt_clear(ckpt_dir) -> None:
     import glob
     import os
-    for p in ([os.path.join(ckpt_dir, _CKPT_FILE)]
-              + glob.glob(os.path.join(
-                  ckpt_dir, _CKPT_CHUNK.format(0).replace("0000", "*")))):
+    # ".tmp" partials too: a crash mid-atomic-write leaves one behind,
+    # and the resumed fit may never rewrite that index
+    paths = [os.path.join(ckpt_dir, _CKPT_FILE),
+             os.path.join(ckpt_dir, _CKPT_FILE + ".tmp")]
+    for tpl in (_CKPT_CHUNK, _CKPT_MESH_STATE):
+        for pat in (_ckpt_glob(tpl), _ckpt_glob(tpl) + ".tmp"):
+            paths += glob.glob(os.path.join(ckpt_dir, pat))
+    for p in paths:
         try:
             os.remove(p)
         except OSError:
             pass
+
+
+def _ckpt_fingerprint_mesh(n, f, K, params, labels, bins, w,
+                           init_scores, mesh, shard_data=None) -> str:
+    """Mesh-fit resume fingerprint: the serial digest plus the mesh
+    topology (shape and process count), so a resume under a different
+    shard layout starts fresh instead of scattering shards wrongly.
+
+    Under sharded ingestion the digest covers only GLOBAL metadata
+    (params, concatenated labels/weights/init-scores, per-shard sizes)
+    — inputs every controller shares — so the shared fingerprint is
+    identical on every process with no coordination round.  Feature
+    VALUES are covered per process by :func:`_local_bins_digest`
+    (stored in each state file, validated locally, and made unanimous
+    by the gang gate in ``_train_distributed``)."""
+    import hashlib
+    from ..core.mesh import DATA_AXIS, FEATURE_AXIS
+    if shard_data is not None:
+        sizes = list(shard_data["sizes"])
+        y_cat = np.concatenate(
+            [np.asarray(y) for y in shard_data["label_shards"]])
+        w_cat = np.concatenate(
+            [np.asarray(ws) for ws in shard_data["weight_shards"]])
+        iss = shard_data.get("init_score_shards")
+        is_cat = (None if iss is None or any(s is None for s in iss)
+                  else np.concatenate([np.asarray(s) for s in iss]))
+        base = _ckpt_fingerprint(n, f, K, params, y_cat,
+                                 np.zeros((0, f), np.uint8), w_cat,
+                                 is_cat)
+        base = hashlib.sha256(
+            (base + "|sizes=" + ",".join(map(str, sizes))
+             ).encode("utf-8")).hexdigest()
+    else:
+        base = _ckpt_fingerprint(n, f, K, params, labels, bins, w,
+                                 init_scores)
+    topo = (f"|mesh={int(mesh.shape[DATA_AXIS])}x"
+            f"{int(mesh.shape[FEATURE_AXIS])}"
+            f"|procs={jax.process_count()}")
+    return hashlib.sha256((base + topo).encode("utf-8")).hexdigest()
+
+
+def _local_bins_digest(shard_data) -> str:
+    """Digest of the per-process inputs THIS process contributes under
+    sharded ingestion: its feature shards AND its init-score shards.
+    The shared mesh fingerprint can only cover metadata every
+    controller holds (labels, weights, sizes) — init scores are
+    excluded there too, because under multicontroller ingestion every
+    process holds ``None`` in its peers' slots.  Without this digest a
+    re-run on re-extracted feature values, or a continuation re-run
+    with a different ``initModelPath``'s margins, would silently
+    resume and blend two fits — the exact failure
+    ``_ckpt_fingerprint`` hashes ``bins`` and ``init_scores`` to
+    prevent on the serial path.  Non-sharded mesh fits return ""
+    (their bins and init scores are already in the shared
+    fingerprint)."""
+    import hashlib
+    if shard_data is None:
+        return ""
+    h = hashlib.sha256()
+    for b in shard_data["bins_shards"]:
+        if b is not None:
+            h.update(np.ascontiguousarray(np.asarray(b)).tobytes())
+    iss = shard_data.get("init_score_shards")
+    if iss is not None:
+        for i, s in enumerate(iss):
+            if s is not None:
+                # slot index tagged so present/absent layout changes
+                # can never alias
+                h.update(f"|is{i}|".encode("utf-8"))
+                h.update(np.ascontiguousarray(
+                    np.asarray(s, np.float32)).tobytes())
+    return h.hexdigest()
+
+
+def _ckpt_shard_bounds(index, shape):
+    """Normalize an addressable-shard index (tuple of slices) to
+    JSON-able ``[[start, stop], ...]`` bounds."""
+    return [list(s.indices(dim)[:2]) for s, dim in zip(index, shape)]
+
+
+def _ckpt_save_mesh(ckpt_dir, fp, it, trees_chunks, scores, val_scores,
+                    cur_bag, rng, bag_rng, best_metric, best_iter,
+                    local_digest="") -> None:
+    """Mesh/multicontroller chunk-boundary snapshot.
+
+    Write order gives crash consistency without any cross-process
+    commit protocol:
+
+    1. every process writes its OWN it-stamped state file — the
+       addressable shards of the (sharded, possibly non-fully-
+       addressable) score vectors plus the host-side bag mask —
+       atomically (tmp + fsync + rename);
+    2. processes barrier (``sync_global_devices``) so the meta can
+       never name a boundary some peer hasn't persisted;
+    3. process 0 replaces the meta file (fingerprint, it, RNG streams,
+       early-stopping bests) and fsyncs the directory;
+    4. each process garbage-collects its own OLDER state generations.
+
+    A crash anywhere leaves the meta pointing at a complete, durable
+    state generation: before step 3 the previous generation's files are
+    still on disk (step 4 hasn't run), after step 3 the new generation
+    is fully written.  Tree chunks are write-once and shared (trees are
+    replicated across the mesh), so process 0 alone persists them.
+    """
+    import glob
+    import os
+    pid = jax.process_index()
+    nproc = jax.process_count()
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if pid == 0:
+        _ckpt_write_chunks(ckpt_dir, trees_chunks)
+    arrays = {"cur_bag": np.asarray(cur_bag)}
+    shards_meta = []
+    seen = set()
+    for name, arr in (("scores", scores), ("val_scores", val_scores)):
+        for sh in arr.addressable_shards:
+            bounds = _ckpt_shard_bounds(sh.index, arr.shape)
+            key = (name, str(bounds))
+            if key in seen:      # replicas (e.g. along the feature axis)
+                continue
+            seen.add(key)
+            arrays[f"shard_{len(shards_meta)}"] = np.asarray(sh.data)
+            shards_meta.append({"name": name, "bounds": bounds})
+    import json as _json
+    pmeta = {"fingerprint": fp, "it": int(it), "pid": pid,
+             "local_digest": local_digest, "shards": shards_meta}
+    spath = os.path.join(ckpt_dir, _CKPT_MESH_STATE.format(pid, int(it)))
+    tmp = spath + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh,
+                 __meta__=np.frombuffer(
+                     _json.dumps(pmeta).encode("utf-8"), np.uint8),
+                 **arrays)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, spath)
+    _fsync_dir(ckpt_dir)
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_save_{it}")
+    if pid == 0:
+        _ckpt_write_meta(ckpt_dir, fp, it, len(trees_chunks), rng,
+                         bag_rng, best_metric, best_iter, arrays={},
+                         extra_meta={"nproc": nproc, "mesh": True,
+                                     "n_trees": _ckpt_tree_count(
+                                         trees_chunks)})
+    if nproc > 1:
+        # second barrier: no peer may GC its PREVIOUS generation until
+        # the meta naming the new one is durable — otherwise a gang
+        # crash in the window between a peer's GC and process 0's meta
+        # replace leaves the meta pointing at a generation whose state
+        # files are already gone (full restart instead of bounded loss)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_meta_{it}")
+    # GC this process's older state generations (the meta naming `it`
+    # is durable for every process once written, and peers never read
+    # another process's shard data, only its __meta__ for validation)
+    own_glob = _CKPT_MESH_PREFIX.format(pid) + "*"
+    for p in glob.glob(os.path.join(ckpt_dir, own_glob)):
+        if p != spath:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    train_stats.incr("ckpt_saved")
+
+
+def _ckpt_load_mesh(ckpt_dir, fp, scores_like, val_scores_like,
+                    local_digest=""):
+    """Validate and load a mesh snapshot; None when absent/torn/
+    mismatched (degrade to a fresh fit, exactly like the serial path).
+
+    The shared parts of the verdict — meta present, fingerprint match,
+    one state file per process stamped with the meta's ``it`` and
+    fingerprint — are a pure function of the SHARED checkpoint
+    directory, so every controller reaches them identically with no
+    coordination round.  The ``local_digest`` check (this process's own
+    feature data) can legitimately diverge across processes; the caller
+    makes the final verdict unanimous with a gang allgather.  Each
+    process materializes only its own state file's arrays; peers' files
+    are opened for their ``__meta__`` validation alone.
+    """
+    import json as _json
+    import os
+    path = os.path.join(ckpt_dir, _CKPT_FILE)
+    if not os.path.exists(path):
+        return None
+    pid = jax.process_index()
+    try:
+        with np.load(path) as z:
+            meta = _json.loads(bytes(z["__meta__"]).decode("utf-8"))
+        if meta["fingerprint"] != fp:
+            log.warning("mesh checkpoint at %s belongs to a different "
+                        "fit (data, params or topology changed); "
+                        "starting fresh", path)
+            train_stats.incr("ckpt_discarded")
+            return None
+        it = meta["it"]
+        nproc = meta.get("nproc", 1)
+        own_meta, own_arrays = None, None
+        for p in range(nproc):
+            spath = os.path.join(ckpt_dir,
+                                 _CKPT_MESH_STATE.format(p, it))
+            # materialize-and-close: peers' files are opened for their
+            # __meta__ alone, and a lingering NpzFile leaks one fd per
+            # peer per resume
+            with np.load(spath) as sz:
+                pmeta = _json.loads(
+                    bytes(sz["__meta__"]).decode("utf-8"))
+                if pmeta["fingerprint"] != fp or pmeta["it"] != it:
+                    raise ValueError(
+                        f"state file for process {p} does not match "
+                        f"the checkpoint meta (boundary {it})")
+                if p == pid:
+                    own_meta = pmeta
+                    own_arrays = {k: sz[k] for k in sz.files
+                                  if k != "__meta__"}
+        if own_meta.get("local_digest", "") != local_digest:
+            # cheap string check FIRST: rejecting here must not pay the
+            # full-forest chunk read below
+            log.warning("mesh checkpoint state for process %d was "
+                        "written against different local feature data; "
+                        "starting fresh", pid)
+            train_stats.incr("ckpt_discarded")
+            return None
+        chunks = _ckpt_read_chunks(ckpt_dir, meta["n_chunks"],
+                                   meta.get("n_trees"))
+        lookup = {}
+        for i, sm in enumerate(own_meta["shards"]):
+            lookup[(sm["name"], str(sm["bounds"]))] = \
+                own_arrays[f"shard_{i}"]
+
+        def restore(name, like):
+            def cb(index):
+                bounds = _ckpt_shard_bounds(index, like.shape)
+                return lookup[(name, str(bounds))]
+            return jax.make_array_from_callback(
+                like.shape, like.sharding, cb)
+
+        return {
+            "it": it, "trees_chunks": chunks,
+            "scores": restore("scores", scores_like),
+            "val_scores": restore("val_scores", val_scores_like),
+            "cur_bag": np.asarray(own_arrays["cur_bag"]),
+            "rng_state": meta["rng_state"],
+            "bag_rng_state": meta["bag_rng_state"],
+            "best_metric": meta["best_metric"],
+            "best_iter": meta["best_iter"],
+        }
+    except Exception as e:  # noqa: BLE001 - torn/partial snapshot
+        log.warning("mesh checkpoint at %s is unusable (%s: %s); "
+                    "starting fresh", path, type(e).__name__, e)
+        train_stats.incr("ckpt_discarded")
+        return None
 
 
 @functools.partial(jax.jit,
@@ -847,7 +1238,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
     if params.fault_tolerant_retries > 0:
         _chunk = min(_chunk, 32)
     if params.checkpoint_dir:
-        _chunk = min(_chunk, 32)
+        _chunk = min(_chunk, max(1, params.checkpoint_chunk))
     check_fit_budget(
         n_local=-(-n // _dn), num_features=f,
         num_bins=mapper.num_total_bins, num_leaves=params.num_leaves,
@@ -858,11 +1249,6 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                      if val_bins is not None else 0),
         data_shards=_dn, verbosity=params.verbosity)
     if use_mesh:
-        if params.checkpoint_dir:
-            log.warning("checkpoint_dir is inert for mesh training "
-                        "(use faultTolerantRetries for in-process chunk "
-                        "replay; cross-process mesh elasticity restarts "
-                        "from a saved model via initModelPath)")
         if ranking_info is not None:
             if init_scores is not None:
                 raise NotImplementedError(
@@ -990,7 +1376,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         ckpt = ""
     if ckpt:
         # bounded chunks = bounded lost work after a process death
-        chunk = min(chunk, 32)
+        chunk = min(chunk, max(1, params.checkpoint_chunk))
         ckpt_fp = _ckpt_fingerprint(n, f, K, params, labels, bins, w,
                                     init_scores)
 
@@ -1177,6 +1563,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 # this run's saves and then stitched into ITS meta
                 _ckpt_clear(ckpt)
             else:
+                train_stats.incr("ckpt_resumed")
                 it = snap["it"]
                 trees_chunks = list(snap["trees_chunks"])
                 scores = jnp.asarray(snap["scores"])
@@ -1268,6 +1655,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                                         cfg=cfg, lr=params.learning_rate,
                                         K=K, has_val=has_val,
                                         efb=efb_dev, rf=use_rf))
+                        train_stats.incr("chunks_replayed")
                         log.warning(
                             "chunk at iteration %d failed (attempt %d/%d);"
                             " re-uploading state and replaying",
@@ -1320,7 +1708,10 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             if stop:
                 break
             it += C
-            if ckpt:
+            if ckpt and it < T:
+                # it == T would snapshot state the very next statement
+                # clears; a crash in that window just replays the final
+                # chunk from the previous boundary
                 _ckpt_save(ckpt, ckpt_fp, it, trees_chunks, scores,
                            val_scores, cur_bag, rng, bag_rng,
                            best_metric, best_iter)
@@ -1449,6 +1840,8 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         _chunk = min(_chunk, 8)
     if params.fault_tolerant_retries > 0:
         _chunk = min(_chunk, 32)
+    if params.checkpoint_dir:
+        _chunk = min(_chunk, max(1, params.checkpoint_chunk))
     check_fit_budget(
         n_local=max(sizes), num_features=f_sh,
         num_bins=mapper.num_total_bins, num_leaves=params.num_leaves,
@@ -1544,6 +1937,10 @@ def _train_distributed_ranking(bins, labels, w, mapper, objective, params,
     dn = int(mesh.shape[DATA_AXIS])
     fn_shards = int(mesh.shape[FEATURE_AXIS])
     has_val = val_bins is not None and val_metric is not None
+    if params.checkpoint_dir:
+        log.warning("checkpoint_dir is inert for mesh lambdarank (the "
+                    "packed-query scan state is not checkpointed); "
+                    "restart a killed ranking fit from initModelPath")
 
     if shard_data is None:
         perm, real, (qidx, qmask, gains, labq, invmax) = shard_queries(
@@ -1877,6 +2274,9 @@ def _train_distributed_dart(bins, labels, w, mapper, objective, params,
     if params.fault_tolerant_retries > 0:
         log.warning("faultTolerantRetries is inert for boostingType='dart'"
                     " (per-iteration host loop; no chunk snapshots)")
+    if params.checkpoint_dir:
+        log.warning("checkpoint_dir is inert for mesh dart (per-iteration"
+                    " host loop; no chunk boundaries to snapshot)")
 
     if shard_data is not None:
         sizes = list(shard_data["sizes"])
@@ -2036,6 +2436,20 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
             goss_keys_m = jax.random.split(
                 jax.random.PRNGKey(params.bagging_seed),
                 params.num_iterations)
+    # Mesh checkpointing (checkpoint_dir is LIVE here, serial-style):
+    # the fingerprint is computed from the ORIGINAL inputs — before any
+    # EFB rebundling rebinds ``bins`` — plus the mesh topology, so a
+    # resume under a different (process count, shard layout) starts
+    # fresh instead of scattering shards wrongly.
+    ckpt = params.checkpoint_dir
+    ckpt_fp = None
+    ckpt_local = ""
+    if ckpt:
+        ckpt_fp = _ckpt_fingerprint_mesh(n, f, K, params, labels, bins,
+                                         w, init_scores, mesh,
+                                         shard_data)
+        ckpt_local = _local_bins_digest(shard_data)
+
     # EFB under a data mesh: one bundling plan from the full host matrix
     # (columns are global), per-shard bundled rows, shard-local expansion
     # before the psum.  GOSS scores through the training matrix by
@@ -2156,12 +2570,61 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         # from the same source — no second host copy.
         chunk = min(chunk, 32)
         ft_vb = vb if has_val else None   # already padded
+    if ckpt:
+        # bounded chunks = bounded lost work after a controller death
+        chunk = min(chunk, max(1, params.checkpoint_chunk))
     cur = np.ones(n, np.float32)
     chunks: List[TreeArrays] = []
     cb_list: List[TreeArrays] = []
     best_metric, best_iter = np.inf, -1
     stop_iter = T
     it = 0
+    if ckpt:
+        snap = _ckpt_load_mesh(ckpt, ckpt_fp, scores, val_scores,
+                               local_digest=ckpt_local)
+        if jax.process_count() > 1:
+            # the verdict must be UNANIMOUS: the local_digest check (and
+            # a torn own-state read) can diverge per process, and a gang
+            # where one controller resumes while another starts fresh
+            # computes garbage collectives
+            from jax.experimental import multihost_utils
+            peers_ok = multihost_utils.process_allgather(
+                np.asarray([snap is not None], np.int32))
+            if snap is not None and not bool(peers_ok.all()):
+                log.warning("a peer controller rejected the mesh "
+                            "checkpoint; starting fresh gang-wide")
+                train_stats.incr("ckpt_discarded")
+                snap = None
+        if snap is None:
+            # purge stale generations: write-once chunk files of an
+            # abandoned fit must not be skipped-over by this run's
+            # saves and then stitched into ITS meta (the verdict is
+            # gang-unanimous — see above — so only process 0 deletes,
+            # and the barrier keeps peers from racing their first save
+            # against the purge)
+            if jax.process_index() == 0:
+                _ckpt_clear(ckpt)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("ckpt_stale_clear")
+        else:
+            train_stats.incr("ckpt_resumed")
+            it = snap["it"]
+            chunks = list(snap["trees_chunks"])
+            scores = snap["scores"]
+            val_scores = snap["val_scores"]
+            cur = np.asarray(snap["cur_bag"], np.float32)
+            rng.bit_generator.state = snap["rng_state"]
+            bag_rng.bit_generator.state = snap["bag_rng_state"]
+            best_metric = snap["best_metric"]
+            best_iter = snap["best_iter"]
+            if callbacks:
+                log.warning("resuming mesh fit from checkpoint at "
+                            "iteration %d: callbacks replay only for "
+                            "the remaining iterations", it)
+            elif params.verbosity > 0:
+                log.info("resuming mesh fit from checkpoint at "
+                         "iteration %d", it)
     while it < T:
         C = min(chunk, T - it)
         if use_bag:
@@ -2223,6 +2686,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                         # fail identically
                     if attempt >= ftr:
                         raise
+                    train_stats.incr("chunks_replayed")
                     log.warning(
                         "mesh chunk at iteration %d failed (attempt "
                         "%d/%d); re-uploading the gang's inputs and "
@@ -2298,6 +2762,21 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         if stop:
             break
         it += C
+        if ckpt and it < T:
+            # skip the final boundary: it == T would pay the D2H shard
+            # copies and two gang barriers for a snapshot the clear
+            # below deletes immediately
+            _ckpt_save_mesh(ckpt, ckpt_fp, it, chunks, scores,
+                            val_scores, cur, rng, bag_rng, best_metric,
+                            best_iter, local_digest=ckpt_local)
+    if ckpt:
+        if jax.process_count() > 1:
+            # every controller must be past its last possible read of
+            # the snapshot before anyone deletes it
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ckpt_clear")
+        if jax.process_index() == 0:
+            _ckpt_clear(ckpt)
 
     trees, nls = _fetch_host_trees(chunks, params.num_leaves, mapper)
     trees, nls = trees[:stop_iter * K], nls[:stop_iter * K]

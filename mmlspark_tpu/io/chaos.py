@@ -33,6 +33,20 @@ Injectors:
   deadlines and reset handling.
 * :func:`kill_process` — SIGKILL a worker process (the multiprocess
   drill's executor-loss injection).
+
+Training-channel injectors (the ``tools/chaos_training.py`` drill and
+``tests/test_chaos_training.py`` smoke; ISSUE 4):
+
+* :class:`ChaosBoostStep` — wraps a chunk-step callable (the engine's
+  ``_boost_scan`` family or a distributed step) and raises at
+  deterministic chunk indices or rates — exercises the
+  ``faultTolerantRetries`` replay path.
+* :func:`corrupt_file` — torn-write truncation or deterministic
+  bit-flip of a checkpoint snapshot; the engine must degrade to a
+  fresh fit, never train on garbage.
+* :class:`ChaosHeartbeat` — a watchdog ``write_hook`` that stalls
+  heartbeat writes, driving the elastic layer's straggler / lease
+  machinery.
 """
 
 from __future__ import annotations
@@ -49,8 +63,10 @@ from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 from .scoring import WorkerKilled
 
 __all__ = [
-    "ChaosChannel", "ChaosPlan", "ChaosPredictor", "ChaosQueue",
-    "ChaosSocket", "WorkerKilled", "kill_process",
+    "ChaosBoostStep", "ChaosChannel", "ChaosControllerKill",
+    "ChaosHeartbeat", "ChaosPlan", "ChaosPredictor", "ChaosQueue",
+    "ChaosSocket", "WorkerKilled", "corrupt_file", "kill_process",
+    "read_ckpt_boundary",
 ]
 
 
@@ -266,3 +282,178 @@ def kill_process(proc_or_pid) -> int:
     pid = getattr(proc_or_pid, "pid", proc_or_pid)
     os.kill(int(pid), signal.SIGKILL)
     return int(pid)
+
+
+class ChaosBoostStep:
+    """Wrap a training chunk-step callable with deterministic failures.
+
+    * ``fail_on_calls`` — exact call indices (1-based, counting every
+      invocation INCLUDING replays) that raise ``RuntimeError`` instead
+      of running — the "device/tunnel loss at chunk k" injection the
+      engine's ``faultTolerantRetries`` replay must absorb.
+    * ``exc_rate`` — per-call Bernoulli failure, drawn from the plan's
+      channel (thread-interleaving deterministic, like every injector).
+
+    The failure is an ordinary ``RuntimeError`` (the engine replays it)
+    — deterministic sanitizer errors (checkify) are deliberately NOT
+    simulated here because the engine must re-raise those unreplayed.
+    """
+
+    def __init__(self, step: Callable, plan: ChaosPlan, *,
+                 exc_rate: float = 0.0,
+                 fail_on_calls: Iterable[int] = (),
+                 name: str = "boost_step"):
+        self._inner = step
+        self._exc_rate = float(exc_rate)
+        self._fail_on = frozenset(int(k) for k in fail_on_calls)
+        self._chan = plan.channel(name)
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.failures = 0
+
+    def __call__(self, *args, **kwargs):
+        with self._lock:
+            self.calls += 1
+            n = self.calls
+        if n in self._fail_on or self._chan.fire(self._exc_rate):
+            with self._lock:
+                self.failures += 1
+            raise RuntimeError(
+                f"chaos: injected chunk-step failure (call {n})")
+        return self._inner(*args, **kwargs)
+
+
+def corrupt_file(path: str, plan: Optional[ChaosPlan] = None, *,
+                 mode: str = "bitflip", name: str = "ckpt") -> str:
+    """Corrupt a snapshot file in place — the torn-write / bit-rot
+    injection for checkpoint recovery drills.
+
+    * ``mode="torn"`` — truncate to half its length: the partial write
+      a power cut leaves behind when the writer skipped the
+      atomic-rename discipline.
+    * ``mode="bitflip"`` — flip one byte at a deterministic offset
+      (drawn from the plan's channel; the file midpoint without a
+      plan): silent media corruption an npz CRC must catch.
+
+    Returns ``path``.  The engine's load paths must treat the result as
+    absent — degrade to a fresh fit, never a crash, never garbage.
+    """
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot corrupt empty file {path}")
+    if mode == "torn":
+        with open(path, "r+b") as fh:
+            fh.truncate(max(1, size // 2))
+        return path
+    if mode == "bitflip":
+        if plan is not None:
+            off = int(plan.channel(name).uniform(0, max(0, size - 1)))
+        else:
+            off = size // 2
+        with open(path, "r+b") as fh:
+            fh.seek(off)
+            b = fh.read(1)
+            fh.seek(off)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        return path
+    raise ValueError(f"unknown corruption mode {mode!r} "
+                     "(use 'torn' or 'bitflip')")
+
+
+def read_ckpt_boundary(ckpt_dir: str) -> Optional[int]:
+    """The boundary iteration named by the durable checkpoint meta in
+    ``ckpt_dir`` (None when absent or mid-replace).  The ONE reader the
+    training chaos tools poll with — the meta file is replaced
+    atomically, so a read never sees a torn write — closing the npz
+    each cycle (a lingering NpzFile leaks one fd per poll)."""
+    import json as _json
+
+    import numpy as np
+
+    # lazy import: the meta filename lives with the writer; a rename
+    # there must not leave this poller watching a path that never
+    # appears (io stays import-decoupled from gbdt at module load)
+    from ..gbdt.engine import _CKPT_FILE
+    meta = os.path.join(ckpt_dir, _CKPT_FILE)
+    try:
+        with np.load(meta) as z:
+            return int(_json.loads(
+                bytes(z["__meta__"]).decode("utf-8"))["it"])
+    except Exception:  # noqa: BLE001 - absent / replace race
+        return None
+
+
+class ChaosControllerKill(threading.Thread):
+    """SIGKILL the CURRENT process the moment a checkpoint boundary
+    ``>= at_boundary`` becomes durable in ``ckpt_dir`` — the drill's
+    "controller dies mid-fit" injection, timed off the checkpoint meta
+    itself so the death deterministically lands between chunk
+    boundaries (an outside killer racing the fit can miss the window
+    entirely on a fast fit).
+
+    SIGKILL runs no cleanup — no atexit, no finally, no flush — which
+    is exactly the failure the recovery contract must absorb."""
+
+    def __init__(self, ckpt_dir: str, at_boundary: int, *,
+                 poll_s: float = 0.03):
+        super().__init__(daemon=True, name="chaos-controller-kill")
+        self._ckpt_dir = ckpt_dir
+        self._at = int(at_boundary)
+        self._poll_s = float(poll_s)
+
+    def run(self) -> None:
+        while True:
+            it = read_ckpt_boundary(self._ckpt_dir)
+            if it is not None and it >= self._at:
+                kill_process(os.getpid())
+            time.sleep(self._poll_s)
+
+
+class ChaosHeartbeat:
+    """Heartbeat stall injector: a ``write_hook`` for
+    :class:`~mmlspark_tpu.gbdt.elastic.HeartbeatWatchdog` that delays
+    lease-file touches so PEERS observe a stale heartbeat.
+
+    Two modes, composable:
+
+    * ``after_s``/``stall_s`` — ONE deterministic stall of ``stall_s``
+      seconds once ``after_s`` have elapsed since the first tick (the
+      drill's "shard goes quiet mid-fit" event; choose ``stall_s``
+      between the peer's straggler threshold and its lease timeout to
+      exercise straggler accounting without triggering a gang
+      restart).
+    * ``rate``/``rate_stall_s`` — per-tick Bernoulli stalls drawn from
+      the plan's channel (sustained jitter).
+    """
+
+    def __init__(self, plan: Optional[ChaosPlan] = None, *,
+                 after_s: float = 0.0, stall_s: float = 0.0,
+                 rate: float = 0.0, rate_stall_s: float = 0.05,
+                 name: str = "heartbeat"):
+        self._after_s = float(after_s)
+        self._stall_s = float(stall_s)
+        self._rate = float(rate)
+        self._rate_stall_s = float(rate_stall_s)
+        if rate > 0 and plan is None:
+            # a silently disabled injector would let a drill go green
+            # having injected nothing
+            raise ValueError("ChaosHeartbeat with rate > 0 needs a "
+                             "ChaosPlan to draw from")
+        self._chan = plan.channel(name) if rate > 0 else None
+        self._t0: Optional[float] = None
+        self._fired = False
+        self.stalls = 0
+
+    def __call__(self) -> None:
+        now = time.monotonic()
+        if self._t0 is None:
+            self._t0 = now
+        if (self._stall_s > 0 and not self._fired
+                and now - self._t0 >= self._after_s):
+            self._fired = True
+            self.stalls += 1
+            time.sleep(self._stall_s)
+            return
+        if self._chan is not None and self._chan.fire(self._rate):
+            self.stalls += 1
+            time.sleep(self._rate_stall_s)

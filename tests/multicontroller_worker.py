@@ -25,9 +25,15 @@ def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
     if mode == "multi":
-        jax.distributed.initialize(
-            coordinator_address=f"127.0.0.1:{port}",
-            num_processes=2, process_id=pid)
+        # gloo CPU collectives + bounded-backoff rendezvous (the
+        # elastic layer's helpers; gbdt/elastic.py) — a raw initialize
+        # here both flakes on EADDRINUSE and, on this image's jax,
+        # hits the stub CPU collective backend
+        from mmlspark_tpu.gbdt.elastic import (enable_cpu_collectives,
+                                               initialize_with_retry)
+        enable_cpu_collectives()
+        initialize_with_retry(f"127.0.0.1:{port}", 2, pid,
+                              retries=2, backoff_s=0.5)
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh

@@ -240,7 +240,7 @@ class TestMidFitResume:
                    _np.zeros(len(y), _np.float32),
                    _np.zeros(1, _np.float32),
                    _np.ones(len(y), _np.float32), rng, rng, _np.inf, -1)
-        assert os.path.exists(os.path.join(ck, "boost_chunk_0000.npz"))
+        assert os.path.exists(os.path.join(ck, "boost_chunk_000000.npz"))
         m = train(bins, y, None, mapper, get_objective("binary"), p1)
         ref = train(bins, y, None, mapper, get_objective("binary"),
                     TrainParams(num_iterations=6, num_leaves=7,

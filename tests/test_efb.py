@@ -212,7 +212,8 @@ class TestMeshEFB:
             LightGBMClassifier(parallelism="serial", **kw).fit(t)
             .transform(t)["probability"])[:, 1]
         p_mesh = np.asarray(
-            LightGBMClassifier(parallelism="data", **kw).fit(t)
+            LightGBMClassifier(parallelism="data", autoMeshMinRows=0,
+                               **kw).fit(t)
             .transform(t)["probability"])[:, 1]
         assert np.median(np.abs(p_mesh - p_serial)) < 1e-5
         assert np.quantile(np.abs(p_mesh - p_serial), 0.99) < 0.05
@@ -221,7 +222,8 @@ class TestMeshEFB:
         X, y = _sparse_table(rng)
         t = {"features": X, "label": y}
         kw = dict(numIterations=12, numLeaves=15, verbosity=0,
-                  minDataInLeaf=5, parallelism="data")
+                  minDataInLeaf=5, parallelism="data",
+                  autoMeshMinRows=0)      # small table: force the mesh
         p_plain = np.asarray(
             LightGBMClassifier(**kw).fit(t).transform(t)["probability"]
         )[:, 1]
@@ -237,7 +239,8 @@ class TestMeshEFB:
         t = {"features": X, "label": y3}
         m = LightGBMClassifier(numIterations=5, numLeaves=7, verbosity=0,
                                objective="multiclass", enableBundle=True,
-                               parallelism="data").fit(t)
+                               parallelism="data",
+                               autoMeshMinRows=0).fit(t)
         p = np.asarray(m.transform(t)["probability"])
         assert np.isfinite(p).all()
 
@@ -247,6 +250,7 @@ class TestMeshEFB:
         X, y = _sparse_table(rng)
         m = LightGBMClassifier(numIterations=5, numLeaves=7, verbosity=0,
                                enableBundle=True,
-                               parallelism="data+feature").fit(
+                               parallelism="data+feature",
+                               autoMeshMinRows=0).fit(
             {"features": X, "label": y})
         assert m is not None

@@ -88,6 +88,7 @@ class TestMeshFaultTolerance:
     def _fit_mesh(self, table, **kw):
         return LightGBMClassifier(numIterations=24, numLeaves=15,
                                   parallelism="data", verbosity=0,
+                                  autoMeshMinRows=0,  # force the mesh
                                   **kw).fit(table)
 
     def test_mesh_injected_failure_replayed_identically(self, table,

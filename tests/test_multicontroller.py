@@ -11,24 +11,17 @@ needs.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
 import numpy as np
 import pytest
 
+from mmlspark_tpu.gbdt.elastic import free_port as _free_port
+
 _WORKER = os.path.join(os.path.dirname(__file__),
                        "multicontroller_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _run_worker(mode, port, pid, outdir):
@@ -40,13 +33,45 @@ def _run_worker(mode, port, pid, outdir):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
 
+def _addr_in_use(err: str) -> bool:
+    return "EADDRINUSE" in err or "address already in use" in err.lower()
+
+
+def _run_multi_round(outdir, attempts=3):
+    """One 2-controller round; _free_port() closes the socket before the
+    coordinator rebinds it, so another process can steal the port in
+    between — on EADDRINUSE the WHOLE round retries with a fresh port
+    (both controllers must agree on the coordinator address, so a
+    worker-local fresh port cannot fix it)."""
+    last = None
+    for _ in range(attempts):
+        port = _free_port()
+        p0 = _run_worker("multi", port, 0, outdir)
+        p1 = _run_worker("multi", port, 1, outdir)
+        try:
+            out0, err0 = p0.communicate(timeout=540)
+            out1, err1 = p1.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            # a wedged gang (one controller stuck in a collective) must
+            # not leak two live jax workers into the rest of the session
+            for p in (p0, p1):
+                if p.poll() is None:
+                    p.kill()
+                p.communicate()
+            raise
+        if (p0.returncode != 0 or p1.returncode != 0) \
+                and (_addr_in_use(err0) or _addr_in_use(err1)):
+            last = (err0, err1)
+            continue
+        return port, p0, p1, out0, err0, err1
+    raise AssertionError(
+        f"coordinator port stayed in use across {attempts} fresh-port "
+        f"attempts:\n{last[0][-1500:]}\n{last[1][-1500:]}")
+
+
 def test_two_controller_none_slot_matches_single_controller(tmp_path):
     outdir = str(tmp_path)
-    port = _free_port()
-    p0 = _run_worker("multi", port, 0, outdir)
-    p1 = _run_worker("multi", port, 1, outdir)
-    out0, err0 = p0.communicate(timeout=540)
-    out1, err1 = p1.communicate(timeout=540)
+    port, p0, p1, out0, err0, err1 = _run_multi_round(outdir)
     assert p0.returncode == 0, f"controller 0 failed:\n{err0[-3000:]}"
     assert p1.returncode == 0, f"controller 1 failed:\n{err1[-3000:]}"
     assert "WORKER_OK" in out0
