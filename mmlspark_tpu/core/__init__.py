@@ -8,6 +8,9 @@ from .pipeline import (PipelineStage, Transformer, Estimator, Model, Pipeline,
 from .mesh import (build_mesh, get_mesh, use_mesh, distributed_initialize,
                    DATA_AXIS, FEATURE_AXIS)
 from .utils import ClusterUtil, FaultToleranceUtils, StopWatch
+from .telemetry import (MetricsRegistry, EventJournal, get_registry,
+                        get_journal, new_trace_id, render_prometheus,
+                        merge_snapshots, read_journal)
 
 __all__ = [
     "Param", "Params", "TypeConverters", "HasInputCol", "HasOutputCol",
@@ -20,4 +23,7 @@ __all__ = [
     "build_mesh", "get_mesh", "use_mesh", "distributed_initialize",
     "DATA_AXIS", "FEATURE_AXIS",
     "ClusterUtil", "FaultToleranceUtils", "StopWatch",
+    "MetricsRegistry", "EventJournal", "get_registry", "get_journal",
+    "new_trace_id", "render_prometheus", "merge_snapshots",
+    "read_journal",
 ]

@@ -168,20 +168,30 @@ class StageStats:
     def rows(self) -> int:
         return self._rows
 
+    def _rows_per_s_locked(self) -> float:
+        if self._t_first is None or self._t_last <= self._t_first:
+            return 0.0
+        return self._rows / (self._t_last - self._t_first)
+
     def rows_per_s(self) -> float:
         with self._lock:
-            if self._t_first is None or self._t_last <= self._t_first:
-                return 0.0
-            return self._rows / (self._t_last - self._t_first)
+            return self._rows_per_s_locked()
 
     def snapshot(self) -> Dict[str, object]:
+        # one lock acquisition for the WHOLE top-level read: reading
+        # self._rows and calling rows_per_s() after release could pair a
+        # newer row count with an older window (or vice versa), so a
+        # concurrent add_rows() made rows and rows_per_s mutually
+        # inconsistent in one snapshot
         with self._lock:
             stages = dict(self._stages)
             counters = dict(self._counters)
             gauges = dict(self._gauges)
+            rows = self._rows
+            rows_per_s = self._rows_per_s_locked()
         return {
-            "rows": self._rows,
-            "rows_per_s": round(self.rows_per_s(), 2),
+            "rows": rows,
+            "rows_per_s": round(rows_per_s, 2),
             "counters": counters,
             "gauges": gauges,
             "stages": {name: s.snapshot() for name, s in stages.items()},
@@ -212,12 +222,20 @@ def summarize_trace(out_dir: str, top: int = 25
                     ) -> List[Tuple[float, str]]:
     """Aggregate device-op durations from the newest perfetto JSON export
     under ``out_dir``.  Returns ``[(total_ms, op_name), ...]`` sorted
-    descending; empty when no trace file exists."""
+    descending, with one trailing ``(total_device_ms,
+    "total_device_ms")`` summary row (the whole-trace device time —
+    what the committed PERF.md evidence compares across runs); empty
+    when no trace file exists.
+
+    "Newest" is by mtime: the profiler names exports by timestamp
+    strings whose lexicographic order diverges from chronology across
+    hosts/sessions (and a re-run into the same dir must win)."""
     paths = glob.glob(os.path.join(out_dir, "**", "*.trace.json.gz"),
                       recursive=True)
     if not paths:
         return []
-    with gzip.open(sorted(paths)[-1], "rt") as fh:
+    newest = max(paths, key=lambda p: (os.path.getmtime(p), p))
+    with gzip.open(newest, "rt") as fh:
         data = json.load(fh)
     events = data.get("traceEvents", [])
     agg: Dict[Tuple[int, str], float] = defaultdict(float)
@@ -237,4 +255,5 @@ def summarize_trace(out_dir: str, top: int = 25
         dev_pids = [max(by_pid, key=by_pid.get)] if by_pid else []
     rows = sorted(((d / 1e3, name) for (pid, name), d in agg.items()
                    if pid in dev_pids), reverse=True)
-    return rows[:top]
+    total_ms = round(sum(ms for ms, _ in rows), 3)
+    return rows[:top] + [(total_ms, "total_device_ms")]

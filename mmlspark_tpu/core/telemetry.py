@@ -1,0 +1,391 @@
+"""Unified telemetry — the framework's observability subsystem.
+
+The reference stack's observability story is the Spark UI plus the
+``Timer`` pipeline stage (SURVEY.md §5.1).  This port grew three
+DISCONNECTED stats surfaces instead — ``StageStats`` in the scoring
+engine, the module-global ``train_stats`` in the GBDT engine, and the
+elastic watchdog's heartbeat gauges — with no export endpoint and no way
+to correlate a slow request with what actually happened.  This module
+federates them (ISSUE 5):
+
+* :class:`MetricsRegistry` — a process-wide registry of named stats
+  sources (anything with a ``snapshot()`` in the
+  :class:`~mmlspark_tpu.core.profiling.StageStats` shape), rendered as
+  Prometheus text exposition for the ``/metrics`` route every serving
+  server exposes (pull-model metrics, Prometheus-style).
+* :class:`EventJournal` — a bounded, thread-safe event ring (optionally
+  mirrored to a JSONL file): span begin/end, shed/expired/salvage,
+  checkpoint save/resume/discard, peer_lost.  ``tools/trace_report.py``
+  reconstructs per-request and per-fit timelines from it
+  (Dapper-style correlated tracing, minus the distributed collector).
+* Trace identity — :func:`new_trace_id` mints ids; a scoring request's
+  trace id is the ``_trace_id`` its client sent, else the request id
+  minted at admission (so every request is traceable without opt-in).
+  A fit's span id is process-global (:func:`current_fit_span`) so the
+  checkpoint writer and the heartbeat lease can stamp it without
+  threading an argument through the whole engine.
+
+Metric naming scheme (see docs/observability.md):
+
+==============================================  =======  ==================
+family                                          type     labels
+==============================================  =======  ==================
+``mmlspark_tpu_rows_total``                     counter  ``ns``
+``mmlspark_tpu_rows_per_second``                gauge    ``ns``
+``mmlspark_tpu_events_total``                   counter  ``ns``, ``event``
+``mmlspark_tpu_gauge``                          gauge    ``ns``, ``name``
+``mmlspark_tpu_stage_latency_seconds``          summary  ``ns``, ``stage``
+==============================================  =======  ==================
+
+``ns`` is the registry namespace (``scoring``, ``train``, ``elastic``,
+``serving_exchange``, ``worker<N>``/``workers`` for the multiprocess
+topology's per-worker and aggregated blocks).
+
+Everything here is stdlib-only and import-light: the serving hot path
+and the training loop both call into it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional
+
+PREFIX = "mmlspark_tpu"
+
+# -- Prometheus text exposition ---------------------------------------------
+
+#: family -> (type, help); summaries additionally emit _sum/_count rows
+_FAMILIES = (
+    ("rows_total", "counter", "Rows processed by this source."),
+    ("rows_per_second", "gauge",
+     "Rows/s over the source's active window."),
+    ("events_total", "counter",
+     "Named event counters (shed/expired/salvaged/restarted, "
+     "ckpt_saved/ckpt_resumed/..., heartbeat_stalls/peer_lost, ...)."),
+    ("gauge", "gauge",
+     "Point-in-time levels (heartbeat_age_ms, ms_per_tree, ...)."),
+    ("stage_latency_seconds", "summary",
+     "Per-stage wall-clock latency (quantiles over the recent window)."),
+)
+
+
+def _esc(v: Any) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(v: Any) -> str:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "0"
+    if f != f:                       # NaN
+        return "NaN"
+    if f == float("inf"):            # before int(f): int(inf) raises,
+        return "+Inf"                # and one inf gauge must not 503
+    if f == float("-inf"):           # the whole scrape
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels(d: Dict[str, Any]) -> str:
+    return "{" + ",".join(f'{k}="{_esc(v)}"'
+                          for k, v in sorted(d.items())) + "}"
+
+
+def render_prometheus(snapshots: Dict[str, dict],
+                      prefix: str = PREFIX) -> str:
+    """Render ``{namespace: StageStats.snapshot()-shaped dict}`` as
+    Prometheus text exposition (format 0.0.4).  Unknown/missing snapshot
+    keys are skipped, never fatal — a scrape must not 500 because one
+    source misbehaved."""
+    rows: Dict[str, List[str]] = {fam: [] for fam, _, _ in _FAMILIES}
+    for ns in sorted(snapshots):
+        snap = snapshots[ns]
+        if not isinstance(snap, dict):
+            continue
+        lab = {"ns": ns}
+        if "rows" in snap:
+            rows["rows_total"].append(
+                f"{prefix}_rows_total{_labels(lab)} "
+                f"{_fmt(snap.get('rows', 0))}")
+            rows["rows_per_second"].append(
+                f"{prefix}_rows_per_second{_labels(lab)} "
+                f"{_fmt(snap.get('rows_per_s', 0.0))}")
+        for name in sorted(snap.get("counters") or {}):
+            rows["events_total"].append(
+                f"{prefix}_events_total"
+                f"{_labels({**lab, 'event': name})} "
+                f"{_fmt(snap['counters'][name])}")
+        for name in sorted(snap.get("gauges") or {}):
+            rows["gauge"].append(
+                f"{prefix}_gauge{_labels({**lab, 'name': name})} "
+                f"{_fmt(snap['gauges'][name])}")
+        for stage in sorted(snap.get("stages") or {}):
+            s = snap["stages"][stage]
+            if not isinstance(s, dict):
+                continue
+            slab = {**lab, "stage": stage}
+            base = f"{prefix}_stage_latency_seconds"
+            for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
+                rows["stage_latency_seconds"].append(
+                    f"{base}{_labels({**slab, 'quantile': q})} "
+                    f"{_fmt(s.get(key, 0.0) / 1e3)}")
+            rows["stage_latency_seconds"].append(
+                f"{base}_sum{_labels(slab)} {_fmt(s.get('total_s', 0.0))}")
+            rows["stage_latency_seconds"].append(
+                f"{base}_count{_labels(slab)} {_fmt(s.get('count', 0))}")
+    out: List[str] = []
+    for fam, typ, help_ in _FAMILIES:
+        if not rows[fam]:
+            continue
+        out.append(f"# HELP {prefix}_{fam} {help_}")
+        out.append(f"# TYPE {prefix}_{fam} {typ}")
+        out.extend(rows[fam])
+    return "\n".join(out) + "\n" if out else "# no metrics registered\n"
+
+
+def merge_snapshots(snaps: Iterable[dict]) -> dict:
+    """Merge several StageStats snapshots into one aggregate (the
+    "workers" total block of a multiprocess scrape): rows and counters
+    SUM, rows/s sums (concurrent sources), gauges take the WORST value
+    — max for age/level-style gauges, MIN for up-style gauges (``*_up``
+    health booleans, where 1 is healthy and one degraded member must
+    show in the aggregate) — stage count/total sum (mean recomputed)
+    and percentiles take the max across sources: percentile sketches
+    don't merge, and the conservative bound is the honest one for an
+    SLO readout."""
+    out: dict = {"rows": 0, "rows_per_s": 0.0, "counters": {},
+                 "gauges": {}, "stages": {}}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        out["rows"] += int(snap.get("rows", 0) or 0)
+        out["rows_per_s"] = round(
+            out["rows_per_s"] + float(snap.get("rows_per_s", 0.0) or 0.0),
+            2)
+        for k, v in (snap.get("counters") or {}).items():
+            out["counters"][k] = out["counters"].get(k, 0) + v
+        for k, v in (snap.get("gauges") or {}).items():
+            if k.endswith("_up"):
+                out["gauges"][k] = min(
+                    out["gauges"].get(k, float("inf")), v)
+            else:
+                out["gauges"][k] = max(
+                    out["gauges"].get(k, float("-inf")), v)
+        for stage, s in (snap.get("stages") or {}).items():
+            if not isinstance(s, dict):
+                continue
+            agg = out["stages"].setdefault(
+                stage, {"count": 0, "total_s": 0.0, "mean_ms": 0.0,
+                        "p50_ms": 0.0, "p99_ms": 0.0})
+            agg["count"] += int(s.get("count", 0) or 0)
+            agg["total_s"] = round(
+                agg["total_s"] + float(s.get("total_s", 0.0) or 0.0), 6)
+            agg["p50_ms"] = max(agg["p50_ms"], s.get("p50_ms", 0.0))
+            agg["p99_ms"] = max(agg["p99_ms"], s.get("p99_ms", 0.0))
+            if agg["count"]:
+                agg["mean_ms"] = round(
+                    agg["total_s"] / agg["count"] * 1e3, 4)
+    return out
+
+
+class MetricsRegistry:
+    """Process-wide federation of named stats sources.
+
+    A source is anything exposing ``snapshot() -> dict`` in the
+    :class:`~mmlspark_tpu.core.profiling.StageStats` shape (a plain
+    pre-built snapshot dict also works).  ``register`` REPLACES an
+    existing namespace — the newest engine/watchdog instance wins, which
+    is what a scrape of a restarted component should see."""
+
+    def __init__(self, prefix: str = PREFIX):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._sources: Dict[str, Any] = {}
+
+    def register(self, namespace: str, source: Any) -> Any:
+        with self._lock:
+            self._sources[namespace] = source
+        return source
+
+    def unregister(self, namespace: str) -> None:
+        with self._lock:
+            self._sources.pop(namespace, None)
+
+    def namespaces(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sources)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._sources.items())
+        out: Dict[str, dict] = {}
+        for ns, src in items:
+            try:
+                out[ns] = (src.snapshot() if hasattr(src, "snapshot")
+                           else dict(src))
+            except Exception:  # noqa: BLE001 - one bad source must not
+                continue       # fail the whole scrape
+        return out
+
+    def render_prometheus(self,
+                          extra: Optional[Dict[str, dict]] = None) -> str:
+        """Render every registered source (plus ``extra`` pre-built
+        snapshot blocks — the multiprocess driver passes its workers'
+        reported stats here) as Prometheus text."""
+        snaps = self.snapshot()
+        if extra:
+            snaps.update(extra)
+        return render_prometheus(snaps, self.prefix)
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry every ``/metrics`` route renders."""
+    return _registry
+
+
+# -- event journal -----------------------------------------------------------
+
+
+class EventJournal:
+    """Bounded, thread-safe event ring with optional JSONL mirroring.
+
+    ``emit`` stamps each record with a wall-clock ``ts`` and a
+    process-monotonic ``seq`` (total order within one process; readers
+    merging journals from several processes sort by ``(ts, seq)``).
+    The in-memory ring is bounded (``capacity``), so an always-on
+    journal can never grow without bound; :meth:`configure` additionally
+    appends every record to a JSONL file for post-mortem reads."""
+
+    def __init__(self, capacity: int = 8192, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=int(capacity))
+        self._seq = 0
+        self._fh = None
+        if path:
+            self.configure(path)
+
+    def configure(self, path: Optional[str]) -> None:
+        """Mirror subsequent events to ``path`` (append mode); ``None``
+        stops mirroring.  Ring behavior is unchanged either way."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+            if path:
+                self._fh = open(path, "a", encoding="utf-8")
+
+    def emit(self, ev: str, **fields) -> dict:
+        rec: dict = {"ts": round(time.time(), 6), "ev": ev}
+        rec.update(fields)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(rec, default=str) + "\n")
+                    self._fh.flush()
+                except (OSError, ValueError):
+                    pass   # a full disk must not kill the hot path
+        return rec
+
+    @contextmanager
+    def span(self, name: str, **fields):
+        """Emit ``<name>_begin`` / ``<name>_end`` (with ``dur_ms``)
+        around the wrapped region."""
+        t0 = time.perf_counter()
+        self.emit(f"{name}_begin", **fields)
+        try:
+            yield
+        finally:
+            self.emit(f"{name}_end",
+                      dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                      **fields)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def tail(self, n: int = 50) -> List[dict]:
+        with self._lock:
+            return list(self._ring)[-int(n):]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def dump(self, path: str) -> int:
+        """Write the current ring to ``path`` as JSONL; returns the
+        number of records written."""
+        events = self.events()
+        with open(path, "w", encoding="utf-8") as fh:
+            for rec in events:
+                fh.write(json.dumps(rec, default=str) + "\n")
+        return len(events)
+
+
+def read_journal(path: str) -> List[dict]:
+    """Read a JSONL journal; malformed lines (torn tail after a crash)
+    are skipped, not fatal — a post-mortem reader must read what's
+    there."""
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+_journal = EventJournal()
+
+
+def get_journal() -> EventJournal:
+    """The process-global journal the engines emit into."""
+    return _journal
+
+
+# -- trace identity ----------------------------------------------------------
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace/span id."""
+    return uuid.uuid4().hex[:16]
+
+
+#: process-global (NOT thread-local) on purpose: the heartbeat watchdog
+#: thread and the checkpoint writer both stamp the span of the fit the
+#: process is running, which is a process-level fact (``train_stats`` is
+#: process-global for the same reason).  Concurrent fits in one process
+#: would interleave stamps — as they already interleave counters.
+_current_fit = {"span": None}
+
+
+def set_current_fit_span(span: Optional[str]) -> None:
+    _current_fit["span"] = span
+
+
+def current_fit_span() -> Optional[str]:
+    return _current_fit["span"]

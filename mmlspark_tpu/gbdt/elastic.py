@@ -49,6 +49,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..core import telemetry as _tm
 from ..core.profiling import StageStats
 
 log = logging.getLogger("mmlspark_tpu.gbdt.elastic")
@@ -134,8 +135,12 @@ class HeartbeatWatchdog:
 
     def _touch(self) -> None:
         path = self.path_for(self.cfg.process_id)
+        # the lease carries the CURRENT fit span (liveness itself is
+        # mtime-based — peers never parse this): a post-mortem can tie
+        # "whose lease went stale" to "which fit was running", and a
+        # resumed gang's fresh span shows in the lease immediately
         with open(path, "w") as fh:
-            fh.write(f"{time.time()}\n")
+            fh.write(f"{time.time()} {_tm.current_fit_span() or ''}\n")
 
     def peer_ages(self) -> Dict[int, float]:
         """Seconds since this watchdog last OBSERVED each peer's lease
@@ -160,6 +165,14 @@ class HeartbeatWatchdog:
 
     def start(self) -> "HeartbeatWatchdog":
         os.makedirs(self.cfg.heartbeat_dir, exist_ok=True)
+        # explicit zero at START (matching the incr(_k, 0) seeding of
+        # the resilience counters): "no stalls observed yet" is a
+        # reading, not a missing key — even if the loop below never
+        # completes a tick before the first snapshot
+        self.stats.set_gauge("heartbeat_age_ms", 0.0)
+        # federate under the process registry so a controller's
+        # /metrics (or stats dump) carries the watchdog gauges
+        _tm.get_registry().register("elastic", self.stats)
         self._t0 = time.time()
         self._touch()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -188,6 +201,10 @@ class HeartbeatWatchdog:
             stalled = age > cfg.straggler_age_s
             if stalled and not self._stalled.get(p):
                 self.stats.incr("heartbeat_stalls")
+                _tm.get_journal().emit(
+                    "peer_stalled", fit=_tm.current_fit_span(), peer=p,
+                    age_s=round(age, 3) if age != float("inf")
+                    else "inf")
                 log.warning("peer %d heartbeat is %.2fs stale "
                             "(straggler threshold %.2fs)", p, age,
                             cfg.straggler_age_s)
@@ -195,6 +212,10 @@ class HeartbeatWatchdog:
             if age > cfg.lease_timeout_s and not self._lost.get(p):
                 self._lost[p] = True
                 self.stats.incr("peer_lost")
+                _tm.get_journal().emit(
+                    "peer_lost", fit=_tm.current_fit_span(), peer=p,
+                    age_s=round(age, 3) if age != float("inf")
+                    else "inf")
                 self._handle_lost(p, age)
         self.stats.set_gauge("heartbeat_age_ms",
                              round(worst * 1e3, 3))
@@ -383,7 +404,12 @@ def run_worker(args) -> int:
         snap = {"process_id": args.process_id,
                 "rendezvous_retries": retry_used,
                 "train": train_stats.snapshot(),
-                "watchdog": wd_stats.snapshot()}
+                "watchdog": wd_stats.snapshot(),
+                # the controller's journal tail (fit span, boost_chunk,
+                # ckpt_*, peer_* events) rides the stats dump so the
+                # chaos drill's artifact carries a trace excerpt and
+                # trace_report can rebuild the fit timeline post-mortem
+                "journal_tail": _tm.get_journal().tail(80)}
         # tmp + atomic replace, per-thread tmp name: the watchdog's
         # on_lost dump (followed by os._exit) can race the main
         # thread's end-of-fit dump to the same path — a direct

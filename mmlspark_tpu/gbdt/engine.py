@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -21,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import debug as _debug
+from ..core import telemetry as _tm
 from ..core.profiling import StageStats
 from .binning import BinMapper, fit_bin_mapper
 from .booster import Booster, HostTree, host_tree_from_arrays
@@ -213,9 +215,78 @@ _CKPT_MESH_STATE = _CKPT_MESH_PREFIX + "{:06d}.npz"
 #: chaos drill snapshot before/after a fit and assert deltas.
 train_stats = StageStats()
 for _k in ("chunks_replayed", "ckpt_saved", "ckpt_resumed",
-           "ckpt_discarded"):
+           "ckpt_discarded", "boost_chunks"):
     train_stats.incr(_k, 0)
 del _k
+# federate under the process registry: a serving process that also
+# trains (or a training controller with a debug HTTP server) exposes
+# these on /metrics next to the scoring stats (ISSUE 5)
+_tm.get_registry().register("train", train_stats)
+
+
+def _ckpt_event(name: str, **fields) -> None:
+    """Journal a checkpoint lifecycle event, stamped with the current
+    fit span so ``tools/trace_report.py`` can place it on the fit's
+    timeline."""
+    _tm.get_journal().emit(name, fit=_tm.current_fit_span(), **fields)
+
+
+#: cap on rows fetched to the host per chunk boundary for the telemetry
+#: train-loss gauge; larger fits are sampled with a stride (a gauge
+#: needs a stable estimate, not the exact sum)
+_MONITOR_LOSS_MAX_ROWS = 65536
+
+
+def _monitor_chunk(it0: int, it1: int, dt_s: float, n_rows: int, K: int,
+                   hist_method: str, objective=None, scores=None,
+                   labels=None, weights=None) -> None:
+    """Per-boost-chunk live training telemetry: ms/tree, rows/s,
+    last-iteration and (when the objective can compute it cheaply)
+    train-loss gauges on ``train_stats``, plus one ``boost_chunk``
+    journal event — the numbers ``tools/chaos_training.py`` and the
+    serving bench read from telemetry instead of ad-hoc prints.
+
+    ``scores`` may be a device array; it is only fetched when the
+    objective implements ``train_loss`` and the array is fully
+    addressable (a multi-controller mesh shard is not — loss is skipped
+    there rather than gathering the gang's scores).  The fetch is
+    bounded: beyond ``_MONITOR_LOSS_MAX_ROWS`` rows the loss is
+    computed on a strided sample, sliced ON DEVICE first, so a
+    Criteo-scale fit pays a bounded D2H per boundary for the gauge, not
+    an O(n) transfer the training loop never needed before."""
+    iters = max(1, it1 - it0)
+    trees = iters * max(1, K)
+    ms_per_tree = dt_s * 1e3 / trees
+    rows_per_s = n_rows * iters / dt_s if dt_s > 0 else 0.0
+    train_stats.set_gauge("ms_per_tree", round(ms_per_tree, 3))
+    train_stats.set_gauge("train_rows_per_s", round(rows_per_s, 1))
+    train_stats.set_gauge("last_iteration", float(it1))
+    train_stats.incr("boost_chunks")
+    loss = None
+    if (objective is not None and scores is not None
+            and labels is not None
+            and getattr(scores, "is_fully_addressable", True)):
+        try:
+            labels_np = np.asarray(labels)
+            stride = max(1, len(labels_np) // _MONITOR_LOSS_MAX_ROWS)
+            if stride > 1:
+                scores = scores[::stride]    # device-side slice: the
+                labels_np = labels_np[::stride]   # D2H stays bounded
+                weights = (None if weights is None
+                           else np.asarray(weights)[::stride])
+            loss = objective.train_loss(np.asarray(scores), labels_np,
+                                        weights)
+        except Exception:  # noqa: BLE001 - telemetry must never kill
+            loss = None    # the fit it observes
+    if loss is not None:
+        train_stats.set_gauge("train_loss", round(float(loss), 6))
+    ev = {"fit": _tm.current_fit_span(), "it_start": int(it0),
+          "it_end": int(it1), "ms_per_tree": round(ms_per_tree, 3),
+          "rows_per_s": round(rows_per_s, 1),
+          "hist_method": hist_method}
+    if loss is not None:
+        ev["train_loss"] = round(float(loss), 6)
+    _tm.get_journal().emit("boost_chunk", **ev)
 
 
 def _ckpt_glob(template: str) -> str:
@@ -294,8 +365,10 @@ def _ckpt_save(ckpt_dir, fp, it, trees_chunks, scores, val_scores,
         arrays={"scores": np.asarray(scores),
                 "val_scores": np.asarray(val_scores),
                 "cur_bag": np.asarray(cur_bag)},
-        extra_meta={"n_trees": _ckpt_tree_count(trees_chunks)})
+        extra_meta={"n_trees": _ckpt_tree_count(trees_chunks),
+                    "fit_span": _tm.current_fit_span()})
     train_stats.incr("ckpt_saved")
+    _ckpt_event("ckpt_saved", it=int(it), n_chunks=len(trees_chunks))
 
 
 def _ckpt_tree_count(trees_chunks) -> int:
@@ -399,6 +472,8 @@ def _ckpt_load(ckpt_dir, fp):
                             "fit (data or params changed); starting "
                             "fresh", path)
                 train_stats.incr("ckpt_discarded")
+                _ckpt_event("ckpt_discarded",
+                            reason="fingerprint_mismatch")
                 return None
             arrays = {k: z[k] for k in ("scores", "val_scores",
                                         "cur_bag")}
@@ -422,6 +497,7 @@ def _ckpt_load(ckpt_dir, fp):
         log.warning("checkpoint at %s is unreadable (%s: %s); "
                     "starting fresh", path, type(e).__name__, e)
         train_stats.incr("ckpt_discarded")
+        _ckpt_event("ckpt_discarded", reason=type(e).__name__)
         return None
 
 
@@ -585,7 +661,9 @@ def _ckpt_save_mesh(ckpt_dir, fp, it, trees_chunks, scores, val_scores,
                          bag_rng, best_metric, best_iter, arrays={},
                          extra_meta={"nproc": nproc, "mesh": True,
                                      "n_trees": _ckpt_tree_count(
-                                         trees_chunks)})
+                                         trees_chunks),
+                                     "fit_span":
+                                         _tm.current_fit_span()})
     if nproc > 1:
         # second barrier: no peer may GC its PREVIOUS generation until
         # the meta naming the new one is durable — otherwise a gang
@@ -605,6 +683,8 @@ def _ckpt_save_mesh(ckpt_dir, fp, it, trees_chunks, scores, val_scores,
             except OSError:
                 pass
     train_stats.incr("ckpt_saved")
+    _ckpt_event("ckpt_saved", it=int(it), n_chunks=len(trees_chunks),
+                pid=pid, mesh=True)
 
 
 def _ckpt_load_mesh(ckpt_dir, fp, scores_like, val_scores_like,
@@ -636,6 +716,8 @@ def _ckpt_load_mesh(ckpt_dir, fp, scores_like, val_scores_like,
                         "fit (data, params or topology changed); "
                         "starting fresh", path)
             train_stats.incr("ckpt_discarded")
+            _ckpt_event("ckpt_discarded",
+                        reason="fingerprint_mismatch", mesh=True)
             return None
         it = meta["it"]
         nproc = meta.get("nproc", 1)
@@ -664,6 +746,8 @@ def _ckpt_load_mesh(ckpt_dir, fp, scores_like, val_scores_like,
                         "written against different local feature data; "
                         "starting fresh", pid)
             train_stats.incr("ckpt_discarded")
+            _ckpt_event("ckpt_discarded", reason="local_digest",
+                        mesh=True)
             return None
         chunks = _ckpt_read_chunks(ckpt_dir, meta["n_chunks"],
                                    meta.get("n_trees"))
@@ -693,6 +777,8 @@ def _ckpt_load_mesh(ckpt_dir, fp, scores_like, val_scores_like,
         log.warning("mesh checkpoint at %s is unusable (%s: %s); "
                     "starting fresh", path, type(e).__name__, e)
         train_stats.incr("ckpt_discarded")
+        _ckpt_event("ckpt_discarded", reason=type(e).__name__,
+                    mesh=True)
         return None
 
 
@@ -1097,7 +1183,44 @@ def _efb_dev_from_host(efb_host):
         default_of=jnp.asarray(efb_host[5]))
 
 
-def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
+def train(*args, **kwargs) -> Booster:
+    """Train a forest — the public entrypoint (see :func:`_train_impl`
+    for the full parameter contract).
+
+    Wraps the fit in a telemetry *fit span* (ISSUE 5): a span id is
+    minted per fit and published process-globally
+    (:func:`mmlspark_tpu.core.telemetry.current_fit_span`) so the
+    checkpoint writer stamps it into snapshot meta and the elastic
+    heartbeat stamps it into lease files; ``fit_begin`` / ``fit_end``
+    (or ``fit_failed``) journal events bracket every ``boost_chunk`` /
+    ``ckpt_*`` event emitted in between, which is what
+    ``tools/trace_report.py`` reconstructs into a fit timeline.  A
+    nested call (the sharded trainer's small-fit serial fallback) joins
+    the enclosing span instead of minting its own."""
+    nested = _tm.current_fit_span() is not None
+    if nested:
+        return _train_impl(*args, **kwargs)
+    span = _tm.new_trace_id()
+    _tm.set_current_fit_span(span)
+    t0 = time.perf_counter()
+    _tm.get_journal().emit("fit_begin", fit=span)
+    try:
+        booster = _train_impl(*args, **kwargs)
+    except BaseException as e:
+        _tm.get_journal().emit("fit_failed", fit=span,
+                               error=type(e).__name__)
+        _tm.set_current_fit_span(None)
+        raise
+    _tm.get_journal().emit(
+        "fit_end", fit=span,
+        dur_s=round(time.perf_counter() - t0, 3),
+        trees=len(booster.trees))
+    _tm.set_current_fit_span(None)
+    return booster
+
+
+def _train_impl(bins: np.ndarray, labels: np.ndarray,
+                weights: Optional[np.ndarray],
           mapper: BinMapper, objective: Objective, params: TrainParams,
           feature_names: Optional[List[str]] = None,
           val_bins: Optional[np.ndarray] = None,
@@ -1393,6 +1516,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
         binsT_d = jnp.transpose(bins_d)   # fit-invariant, once per fit
         trees_list: List[TreeArrays] = []
         for it in range(T):
+            t_iter = time.perf_counter()
             if use_bag and it % params.bagging_freq == 0:
                 cur_bag = (bag_rng.random(n) < params.bagging_fraction
                            ).astype(np.float32)
@@ -1426,6 +1550,11 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                         tree.leaf_value[row_leaf]
                     tree = apply_shrinkage(tree, params.learning_rate)
                 trees_list.append(tree)
+            # per-iteration telemetry (custom-gradient host loop):
+            # objective=None — the override replaces the objective's
+            # gradient, so its train_loss would not describe this fit
+            _monitor_chunk(it, it + 1, time.perf_counter() - t_iter,
+                           n, K, cfg.hist_method)
             if has_val:
                 # trees are already shrunk, so val scores add at lr=1.0
                 val_scores = val_scores + predict_tree_binned(
@@ -1564,6 +1693,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                 _ckpt_clear(ckpt)
             else:
                 train_stats.incr("ckpt_resumed")
+                _ckpt_event("ckpt_resumed", it=int(snap["it"]))
                 it = snap["it"]
                 trees_chunks = list(snap["trees_chunks"])
                 scores = jnp.asarray(snap["scores"])
@@ -1613,6 +1743,7 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                     bins_d, scores, labels_d, weights_d, bag_masks,
                     fi_stack, val_bins_d, val_scores)
 
+            t_chunk = time.perf_counter()
             ftr = params.fault_tolerant_retries
             if ftr > 0:
                 # chunk-boundary snapshots + replay (SURVEY.md §5.3): a
@@ -1656,6 +1787,8 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
                                         K=K, has_val=has_val,
                                         efb=efb_dev, rf=use_rf))
                         train_stats.incr("chunks_replayed")
+                        _ckpt_event("chunk_replayed", it=int(it),
+                                    attempt=attempt + 1)
                         log.warning(
                             "chunk at iteration %d failed (attempt %d/%d);"
                             " re-uploading state and replaying",
@@ -1677,7 +1810,14 @@ def train(bins: np.ndarray, labels: np.ndarray, weights: Optional[np.ndarray],
             else:
                 trees_st, scores, val_scores, val_hist = run_chunk(
                     scores, val_scores)
+                # sync for honest chunk timing; the host needs these
+                # results before the next chunk (or the final fetch)
+                # anyway, so this moves a wait, it does not add one
+                jax.block_until_ready(trees_st)
             trees_chunks.append(trees_st)
+            _monitor_chunk(it, it + C, time.perf_counter() - t_chunk,
+                           n, K, cfg.hist_method, objective, scores,
+                           labels, w)
             stop = False
             if has_val:
                 vh = np.asarray(val_hist)        # (C, n_val[, K])
@@ -2594,6 +2734,8 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                 log.warning("a peer controller rejected the mesh "
                             "checkpoint; starting fresh gang-wide")
                 train_stats.incr("ckpt_discarded")
+                _ckpt_event("ckpt_discarded", reason="peer_rejected",
+                            mesh=True)
                 snap = None
         if snap is None:
             # purge stale generations: write-once chunk files of an
@@ -2609,6 +2751,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                 multihost_utils.sync_global_devices("ckpt_stale_clear")
         else:
             train_stats.incr("ckpt_resumed")
+            _ckpt_event("ckpt_resumed", it=int(snap["it"]), mesh=True)
             it = snap["it"]
             chunks = list(snap["trees_chunks"])
             scores = snap["scores"]
@@ -2661,6 +2804,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                 bins_d, scores_in, labels_d, w_d, real, bags, fi_stack,
                 val_bins_d, val_scores_in)
 
+        t_chunk = time.perf_counter()
         if ftr > 0:
             # one D2H snapshot per chunk buys replay; the happy path
             # reuses the LIVE device buffers (donation is safe — the
@@ -2687,6 +2831,8 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                     if attempt >= ftr:
                         raise
                     train_stats.incr("chunks_replayed")
+                    _ckpt_event("chunk_replayed", it=int(it),
+                                attempt=attempt + 1, mesh=True)
                     log.warning(
                         "mesh chunk at iteration %d failed (attempt "
                         "%d/%d); re-uploading the gang's inputs and "
@@ -2729,7 +2875,13 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         else:
             trees_st, scores, val_scores, val_hist = run_step(
                 scores, val_scores)
+            jax.block_until_ready(trees_st)
         chunks.append(trees_st)
+        # objective=None: the gang's score vector is sharded (not fully
+        # addressable on any one controller), so train loss is skipped
+        # rather than gathered
+        _monitor_chunk(it, it + C, time.perf_counter() - t_chunk, n, K,
+                       cfg.hist_method)
         stop = False
         if has_val:
             vh = np.asarray(val_hist)[:, :nv]    # drop val pad rows

@@ -72,6 +72,16 @@ class Objective:
         """Raw margin → output space (e.g. sigmoid for binary)."""
         return scores
 
+    def train_loss(self, scores: np.ndarray, labels: np.ndarray,
+                   weights: Optional[np.ndarray] = None
+                   ) -> Optional[float]:
+        """Cheap host-side training loss for live telemetry (the
+        ``train_loss`` gauge / ``boost_chunk`` journal field) —
+        objectives without a closed form return ``None`` and the
+        monitor skips the gauge.  Pure numpy on HOST copies: called at
+        chunk boundaries, never inside the jitted step."""
+        return None
+
 
 class BinaryObjective(Objective):
     name = "binary"
@@ -116,6 +126,18 @@ class BinaryObjective(Objective):
     def transform_prediction(self, scores):
         return sigmoid(self.sigma * scores)
 
+    def train_loss(self, scores, labels, weights=None):
+        """Weighted logloss (numpy, clipped for stability)."""
+        y = (np.asarray(labels) > 0).astype(np.float64)
+        p = 1.0 / (1.0 + np.exp(-self.sigma * np.asarray(
+            scores, np.float64)))
+        p = np.clip(p, 1e-12, 1.0 - 1e-12)
+        ll = -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+        w = (np.ones_like(ll) if weights is None
+             else np.asarray(weights, np.float64))
+        s = float(w.sum())
+        return float((ll * w).sum() / s) if s > 0 else None
+
 
 class RegressionL2(Objective):
     name = "regression"
@@ -127,6 +149,15 @@ class RegressionL2(Objective):
 
     def grad_hess(self, scores, labels, weights):
         return (scores - labels) * weights, weights
+
+    def train_loss(self, scores, labels, weights=None):
+        """Weighted mean squared error (numpy)."""
+        err = (np.asarray(scores, np.float64)
+               - np.asarray(labels, np.float64)) ** 2
+        w = (np.ones_like(err) if weights is None
+             else np.asarray(weights, np.float64))
+        s = float(w.sum())
+        return float((err * w).sum() / s) if s > 0 else None
 
 
 class RegressionL1(Objective):
@@ -369,6 +400,18 @@ class MulticlassObjective(Objective):
 
     def transform_prediction(self, scores):
         return jax.nn.softmax(scores, axis=-1)
+
+    def train_loss(self, scores, labels, weights=None):
+        """Weighted softmax cross-entropy (numpy, log-sum-exp)."""
+        s = np.asarray(scores, np.float64)
+        s = s - s.max(axis=-1, keepdims=True)
+        logp = s - np.log(np.exp(s).sum(axis=-1, keepdims=True))
+        y = np.asarray(labels).astype(np.int64)
+        nll = -logp[np.arange(len(y)), y]
+        w = (np.ones_like(nll) if weights is None
+             else np.asarray(weights, np.float64))
+        tot = float(w.sum())
+        return float((nll * w).sum() / tot) if tot > 0 else None
 
 
 class _LambdarankStub(Objective):

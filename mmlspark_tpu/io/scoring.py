@@ -87,6 +87,7 @@ import numpy as np
 
 from ..core.profiling import StageStats
 from ..core.schema import DataTable
+from ..core.telemetry import get_journal, get_registry
 
 log = logging.getLogger(__name__)
 
@@ -296,6 +297,7 @@ class ScoringEngine:
         self.stats = stats or StageStats()
         for name in self.RESILIENCE_COUNTERS:
             self.stats.incr(name, 0)     # observable zeros
+        self._journal = get_journal()
         self._reply_q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._stop = threading.Event()
         self._draining = threading.Event()
@@ -322,6 +324,30 @@ class ScoringEngine:
                 raise TypeError(
                     "server must expose request_queue, _exchange.queue, "
                     "or the legacy get_batch() contract")
+
+    # -- tracing -------------------------------------------------------------
+
+    @staticmethod
+    def _tid(entry) -> str:
+        """A request's trace id: the ``_trace_id`` its client sent in
+        the payload, else the request id (minted at admission by the
+        exchange) — every request is traceable without client opt-in,
+        and a client-chosen id survives the worker hop because it rides
+        the payload."""
+        payload = entry[1]
+        if isinstance(payload, dict):
+            tid = payload.get("_trace_id")
+            if tid:
+                return str(tid)
+        return str(entry[0])
+
+    def _trace(self, ev: str, batch, **fields) -> None:
+        """Journal one per-batch pipeline event carrying the batch's
+        request ids and trace ids — ``tools/trace_report.py`` stitches
+        these into per-request form→decode→score→reply timelines."""
+        self._journal.emit(ev, rids=[str(e[0]) for e in batch],
+                           trace_ids=[self._tid(e) for e in batch],
+                           **fields)
 
     # -- batch forming -------------------------------------------------------
 
@@ -488,9 +514,11 @@ class ScoringEngine:
         errors = []
         if shed:
             self.stats.incr("shed", len(shed))
+            self._trace("shed", shed)
             errors += [(e[0], {"error": "shed"}, 503) for e in shed]
         if expired:
             self.stats.incr("expired", len(expired))
+            self._trace("expired", expired)
             errors += [(e[0], {"error": "expired"}, 504)
                        for e in expired]
         return live, errors
@@ -527,8 +555,10 @@ class ScoringEngine:
                 self._reply_errors(errors)
             if not batch:
                 continue     # everything formed was shed/expired
-            self.stats.timer("batch_form").record(
-                time.perf_counter() - t_first)
+            form_s = time.perf_counter() - t_first
+            self.stats.timer("batch_form").record(form_s)
+            self._trace("form", batch, rows=len(batch),
+                        dur_ms=round(form_s * 1e3, 3))
             self._current[slot] = (batch, t_first)
             with self._inflight_lock:
                 self._inflight += 1
@@ -620,6 +650,7 @@ class ScoringEngine:
             pairs.extend(row_pairs)
         if rescued:
             self.stats.incr("salvaged", rescued)
+        self._trace("salvage", batch, rescued=rescued)
         return pairs
 
     def _supervisor(self) -> None:
@@ -667,14 +698,21 @@ class ScoringEngine:
 
     def _score_predictor(self, batch):
         payloads = [e[1] for e in batch]
+        t0 = time.perf_counter()
         with self.stats.time("decode"):
             try:
                 X = self._plan.decode(payloads)
             except Exception:  # noqa: BLE001 - malformed row(s) aboard
                 X = None
+        self._trace("decode", batch,
+                    dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                    **({"fallback": "per_row"} if X is None else {}))
         if X is None:
             return self._score_predictor_salvage(batch)
+        t1 = time.perf_counter()
         vals = self._score_matrix(X, X.shape[0])
+        self._trace("score", batch, rows=X.shape[0],
+                    dur_ms=round((time.perf_counter() - t1) * 1e3, 3))
         return [(e[0], vals[i]) for i, e in enumerate(batch)]
 
     def _score_predictor_salvage(self, batch):
@@ -682,7 +720,7 @@ class ScoringEngine:
         payload gets its own 400 instead of failing every co-batched
         request (a single misbehaving client must not error out up to
         ``max_rows`` innocent neighbors)."""
-        rows, order, bad = [], [], []
+        rows, order, good, bad = [], [], [], []
         width = self._plan.num_features
         for entry in batch:
             rid, p = entry[0], entry[1]
@@ -698,19 +736,29 @@ class ScoringEngine:
                 continue
             rows.append(r[0])
             order.append(rid)
+            good.append(entry)
         out = [(rid, {"error": "bad request"}, 400) for rid in bad]
         if rows:
             X = np.ascontiguousarray(np.stack(rows))
+            t0 = time.perf_counter()
             vals = self._score_matrix(X, len(rows))
+            self._trace("score", good, rows=len(rows), dur_ms=round(
+                (time.perf_counter() - t0) * 1e3, 3))
             out += [(rid, vals[i]) for i, rid in enumerate(order)]
         return out
 
     def _score_transform(self, batch):
         from .serving import request_table
+        t0 = time.perf_counter()
         with self.stats.time("decode"):
             table = request_table(batch)
+        self._trace("decode", batch,
+                    dur_ms=round((time.perf_counter() - t0) * 1e3, 3))
+        t1 = time.perf_counter()
         with self.stats.time("score"):
             out = self._transform(table)
+        self._trace("score", batch, rows=len(batch),
+                    dur_ms=round((time.perf_counter() - t1) * 1e3, 3))
         ids = out["id"]
         vals = out[self._reply_col]
         return [(str(rid), _json_value(v)) for rid, v in zip(ids, vals)]
@@ -718,6 +766,7 @@ class ScoringEngine:
     # -- replies -------------------------------------------------------------
 
     def _deliver(self, pairs, t_first: float) -> None:
+        t0 = time.perf_counter()
         with self.stats.time("reply"):
             if self._reply_many is not None:
                 self._reply_many(
@@ -728,6 +777,12 @@ class ScoringEngine:
                     rid, val = entry[0], entry[1]
                     status = entry[2] if len(entry) > 2 else 200
                     self._server.reply(rid, val, status)
+        # reply pairs carry no payload, so only rids ride this event;
+        # the reader recovers a client trace id from the form event
+        self._journal.emit(
+            "reply", rids=[str(e[0]) for e in pairs],
+            statuses=[e[2] if len(e) > 2 else 200 for e in pairs],
+            dur_ms=round((time.perf_counter() - t0) * 1e3, 3))
         self.stats.timer("e2e").record(time.perf_counter() - t_first)
         self.stats.add_rows(len(pairs))
 
@@ -781,6 +836,11 @@ class ScoringEngine:
                 self._server.ready_check = self.is_ready
             except AttributeError:
                 pass
+        # telemetry wiring: the newest live engine owns the "scoring"
+        # namespace — /metrics scrapes (and the multiprocess driver's
+        # render_metrics) see its stage latencies and resilience
+        # counters without any per-server plumbing
+        get_registry().register("scoring", self.stats)
         return self
 
     def is_ready(self) -> bool:

@@ -30,7 +30,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core.profiling import StageStats
 from ..core.schema import DataTable
+from ..core.telemetry import (get_registry, merge_snapshots,
+                              render_prometheus)
 
 log = logging.getLogger(__name__)
 
@@ -65,10 +68,12 @@ class _QuietThreadingHTTPServer(ThreadingHTTPServer):
 
 class _ServingHandler(BaseHTTPRequestHandler):
     """Shared plumbing for every serving HTTP handler: quiet logging,
-    HTTP/1.1 keep-alive, JSON replies, and the /healthz + /readyz
-    endpoints.  Subclasses define ``do_POST``, a ``timeout`` (the
-    slow-client read deadline — http.server applies it as the socket
-    timeout and closes the connection on expiry), and ``_ready()``."""
+    HTTP/1.1 keep-alive, JSON replies, and the /healthz + /readyz +
+    /metrics endpoints.  Subclasses define ``do_POST``, a ``timeout``
+    (the slow-client read deadline — http.server applies it as the
+    socket timeout and closes the connection on expiry), ``_ready()``,
+    and optionally ``_metrics()`` (defaults to rendering this process's
+    global :class:`~mmlspark_tpu.core.telemetry.MetricsRegistry`)."""
 
     disable_nagle_algorithm = True   # ms-latency serving contract
     # HTTP/1.1 keep-alive: a closed-loop client reuses its connection
@@ -90,6 +95,12 @@ class _ServingHandler(BaseHTTPRequestHandler):
     def _ready(self) -> bool:
         return False
 
+    def _metrics(self) -> Optional[str]:
+        """Prometheus text for /metrics; ``None`` -> 503.  Default:
+        this process's global registry (scoring engine, train stats,
+        whatever else registered)."""
+        return get_registry().render_prometheus()
+
     def do_GET(self):
         if self.path == "/healthz":
             # liveness: the accept loop is running
@@ -100,6 +111,22 @@ class _ServingHandler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001
                 ready = False
             self._send_json(200 if ready else 503, {"ready": ready})
+        elif self.path == "/metrics":
+            try:
+                text = self._metrics()
+            except Exception:  # noqa: BLE001 - a scrape must degrade,
+                log.exception("serving: /metrics render failed")
+                text = None
+            if text is None:
+                self.send_error(503, "metrics unavailable")
+                return
+            body = text.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self.send_error(404)
 
@@ -275,6 +302,9 @@ class HTTPServer:
         # /readyz hook: the scoring engine installs its liveness check
         # here at start(); None means "no engine attached yet" → 503
         self.ready_check: Optional[Callable[[], bool]] = None
+        # /metrics hook: None -> the process-global MetricsRegistry;
+        # a custom provider returns the full exposition text itself
+        self.metrics_provider: Optional[Callable[[], str]] = None
         outer = self
 
         class Handler(_ServingHandler):
@@ -286,6 +316,12 @@ class HTTPServer:
             def _ready(self):
                 check = outer.ready_check
                 return check is not None and bool(check())
+
+            def _metrics(self):
+                provider = outer.metrics_provider
+                if provider is not None:
+                    return provider()
+                return super()._metrics()
 
             def do_POST(self):
                 if api_path not in ("/", self.path):
@@ -386,6 +422,17 @@ class DistributedHTTPServer:
             w.ready_check = check
 
     @property
+    def metrics_provider(self) -> Optional[Callable[[], str]]:
+        """/metrics hook, fanned out to every worker server."""
+        return self.workers[0].metrics_provider if self.workers else None
+
+    @metrics_provider.setter
+    def metrics_provider(self,
+                         provider: Optional[Callable[[], str]]) -> None:
+        for w in self.workers:
+            w.metrics_provider = provider
+
+    @property
     def request_queue(self) -> "queue.Queue[Tuple[str, Any, float]]":
         return self._exchange.queue
 
@@ -478,6 +525,16 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     pending: Dict[str, _Pending] = {}
     payloads: Dict[str, Any] = {}   # rid -> payload, kept for re-park
     plock = threading.Lock()
+    # worker-local telemetry: what THIS process did with its sockets.
+    # Reported to the driver (periodically + on every scrape) so the
+    # driver's exposition shows the whole multiprocess topology.
+    wstats = StageStats()
+    wstats.incr("parked", 0)
+    wstats.incr("replied", 0)
+    wstats.set_gauge("exchange_link_up", 1.0)
+    # /metrics scrape waiters: nonce -> _Pending holding the driver's
+    # rendered exposition text
+    mwaiters: Dict[str, _Pending] = {}
 
     def connect():
         c = _socket.create_connection((driver_host, driver_port))
@@ -505,6 +562,31 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             return (link["conn"] is not None
                     and link["engine_ready"] is not False)
 
+        def _metrics(self):
+            # the engine (and its StageStats) lives in the DRIVER
+            # process — a scrape of this worker asks the driver for the
+            # whole-topology exposition over the exchange link, carrying
+            # this worker's local stats along so the driver's view is
+            # fresh.  Link down / driver silent -> degrade to a
+            # worker-local render rather than a 503 (a half-scrape
+            # beats none during an exchange blip).
+            nonce = uuid.uuid4().hex
+            waiter = _Pending()
+            with plock:
+                mwaiters[nonce] = waiter
+            try:
+                send({"op": "metrics_req", "req": nonce,
+                      "stats": wstats.snapshot()})
+            except OSError:
+                with plock:
+                    mwaiters.pop(nonce, None)
+                return _local_metrics()
+            if not waiter.event.wait(5.0):
+                with plock:
+                    mwaiters.pop(nonce, None)
+                return _local_metrics()
+            return waiter.response
+
         def do_POST(self):
             if api_path not in ("/", self.path):
                 self.send_error(404)
@@ -521,6 +603,7 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             with plock:
                 pending[rid] = p
                 payloads[rid] = payload
+            wstats.incr("parked")
             try:
                 send({"op": "park", "rid": rid, "payload": payload})
             except OSError:
@@ -550,6 +633,12 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             self.end_headers()
             self.wfile.write(body)
 
+    def _local_metrics():
+        # degraded scrape: this worker's own stats only, flagged so a
+        # dashboard can tell a partial exposition from a healthy one
+        return (render_prometheus({"worker_local": wstats.snapshot()})
+                + "# driver unreachable: worker-local metrics only\n")
+
     httpd = _QuietThreadingHTTPServer((http_host, 0), Handler)
     # a wildcard bind must not advertise 0.0.0.0: report the interface
     # this worker reaches the exchange through — the address a client on
@@ -565,6 +654,23 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     hello()
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
+    def stats_beacon():
+        # periodic worker-stats report: keeps the driver's per-worker
+        # blocks fresh so a scrape against ANY server (or the driver's
+        # own render_metrics()) sees every worker, not just the one
+        # being scraped.  Best-effort: a down link skips the tick.
+        while not beacon_stop.wait(1.0):
+            wstats.set_gauge("exchange_link_up",
+                             1.0 if link["conn"] is not None else 0.0)
+            try:
+                send({"op": "stats", "snapshot": wstats.snapshot()})
+            except OSError:
+                pass
+
+    beacon_stop = threading.Event()
+    threading.Thread(target=stats_beacon, name="worker-stats-beacon",
+                     daemon=True).start()
+
     base, cap = reconnect_backoff
     stopped = False
     while not stopped:
@@ -579,6 +685,14 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                     # driver readiness beacon → worker /readyz truth
                     link["engine_ready"] = bool(msg.get("value"))
                     continue
+                if msg["op"] == "metrics_txt":
+                    # driver's answer to a /metrics scrape round-trip
+                    with plock:
+                        mw = mwaiters.pop(msg.get("req"), None)
+                    if mw is not None:
+                        mw.response = msg.get("text")
+                        mw.event.set()
+                    continue
                 if msg["op"] == "reply":
                     rid = msg["rid"]
                     with plock:
@@ -587,6 +701,8 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                             p.response = msg["response"]
                             p.status = msg.get("status", 200)
                             p.event.set()
+                    if p is not None:
+                        wstats.incr("replied")
                     send({"op": "ack", "rid": rid,
                           "delivered": p is not None})
         except (OSError, ValueError):
@@ -625,6 +741,7 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                 send({"op": "park", "rid": rid, "payload": payload})
         except OSError:
             continue   # new link died instantly — loop re-enters
+    beacon_stop.set()
     httpd.shutdown()
     httpd.server_close()
     with wlock:
@@ -710,6 +827,14 @@ class MultiprocessHTTPServer:
         self._conn_worker: Dict[int, int] = {}  # conn idx -> worker slot
         self.addresses: List[str] = [""] * num_workers
         self.counters = {"worker_deaths": 0, "worker_respawns": 0}
+        # telemetry: the exchange's own StageStats mirror of `counters`
+        # (registered under "serving_exchange" at start()) plus the
+        # per-worker snapshots the worker processes beacon over the
+        # link — render_metrics() turns all of it into one exposition
+        self.stats = StageStats()
+        for _k in ("worker_deaths", "worker_respawns"):
+            self.stats.incr(_k, 0)
+        self.worker_stats: Dict[int, dict] = {}
         # the scoring engine installs its liveness check here; the
         # beacon thread broadcasts it to worker processes so their
         # /readyz reflects ENGINE readiness, not just link liveness
@@ -839,7 +964,25 @@ class MultiprocessHTTPServer:
         self._ready_beacon = threading.Thread(
             target=self._beacon_loop, name="ready-beacon", daemon=True)
         self._ready_beacon.start()
+        get_registry().register("serving_exchange", self.stats)
         return self
+
+    def render_metrics(self) -> str:
+        """One Prometheus exposition for the whole multiprocess
+        topology: the driver's registry (scoring engine, train stats,
+        this exchange's own counters) plus each worker's last-reported
+        stats under ``ns="worker<N>"`` and their aggregate under
+        ``ns="workers"`` — what the worker-side ``/metrics`` route
+        serves after its exchange round-trip, so a single scrape of any
+        worker sees everything."""
+        with self._lock:
+            per_worker = {w: dict(s)
+                          for w, s in self.worker_stats.items()}
+        extra = {f"worker{w}": snap
+                 for w, snap in sorted(per_worker.items())}
+        if per_worker:
+            extra["workers"] = merge_snapshots(per_worker.values())
+        return get_registry().render_prometheus(extra=extra)
 
     def _beacon_loop(self) -> None:
         """Broadcast the installed ``ready_check`` verdict to every
@@ -894,6 +1037,7 @@ class MultiprocessHTTPServer:
                 log.warning("serving: worker process %d died "
                             "(exitcode %s); respawning", i, p.exitcode)
                 self.counters["worker_respawns"] += 1
+                self.stats.incr("worker_respawns")
                 newp = self._make_proc(i)
                 self._procs[i] = newp
                 newp.start()
@@ -960,6 +1104,7 @@ class MultiprocessHTTPServer:
                 # takeover's stale link lands here too: its slot entry
                 # was already moved to the new conn, so no death)
                 self.counters["worker_deaths"] += 1
+                self.stats.incr("worker_deaths")
         # close the link so a still-alive (but protocol-broken) worker
         # notices, and later _send()s fail fast instead of queueing
         try:
@@ -1073,6 +1218,36 @@ class MultiprocessHTTPServer:
             elif op == "expire":
                 with self._lock:
                     self._route.pop(msg["rid"], None)
+            elif op == "stats":
+                # periodic worker-stats beacon: keep the last-known
+                # snapshot per WORKER SLOT (not conn index) so the
+                # whole-topology exposition names stable workers
+                with self._lock:
+                    w = self._conn_worker.get(idx)
+                    if w is not None and isinstance(msg.get("snapshot"),
+                                                    dict):
+                        self.worker_stats[w] = msg["snapshot"]
+            elif op == "metrics_req":
+                # a /metrics scrape hit this worker: fold its
+                # piggybacked stats in, render the WHOLE topology
+                # (driver registry + every worker's last report +
+                # aggregated totals), and answer the round-trip
+                with self._lock:
+                    w = self._conn_worker.get(idx)
+                    if w is not None and isinstance(msg.get("stats"),
+                                                    dict):
+                        self.worker_stats[w] = msg["stats"]
+                try:
+                    text = self.render_metrics()
+                except Exception:  # noqa: BLE001 - scrape must degrade
+                    log.exception("serving: metrics render failed")
+                    text = "# metrics render failed\n"
+                try:
+                    self._send(idx, {"op": "metrics_txt",
+                                     "req": msg.get("req"),
+                                     "text": text})
+                except (OSError, IndexError):
+                    pass   # dying link: its reader handles the purge
             elif op == "ack":
                 with self._lock:
                     entry = self._acks.pop(msg["rid"], None)

@@ -1,0 +1,712 @@
+"""Unified telemetry (ISSUE 5): MetricsRegistry + Prometheus text
+exposition on /metrics (single- and multi-process topologies),
+correlated trace spans in the EventJournal with trace_report timeline
+reconstruction, live training telemetry, and the observability
+satellite fixes (StageStats snapshot consistency, summarize_trace mtime
+selection, heartbeat gauge seeding, tool artifact schema)."""
+
+import gzip
+import importlib.util
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import telemetry
+from mmlspark_tpu.core.profiling import StageStats
+from mmlspark_tpu.core.telemetry import (EventJournal, MetricsRegistry,
+                                         merge_snapshots, read_journal,
+                                         render_prometheus)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    """Import a tools/ script as a module (tools/ is not a package)."""
+    spec = importlib.util.spec_from_file_location(
+        f"_tool_{name}", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------- parser
+
+_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{([^}]*)\})?"                      # optional label set
+    r" (-?(?:[0-9]*\.)?[0-9]+(?:[eE][+-]?[0-9]+)?|NaN|[+-]Inf)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text):
+    """Minimal Prometheus text-format parser: every non-comment line
+    must be `name{labels} value`; raises on anything else.  Returns
+    {(name, frozenset(label items)): float}."""
+    out = {}
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        assert m, f"invalid exposition line: {line!r}"
+        name, labels_raw, value = m.groups()
+        labels = {}
+        if labels_raw:
+            consumed = _LABEL.findall(labels_raw)
+            # every byte of the label block must parse as k="v" pairs
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in consumed)
+            assert rebuilt == labels_raw, \
+                f"invalid label block: {labels_raw!r}"
+            labels = dict(consumed)
+        out[(name, frozenset(labels.items()))] = float(value)
+    return out
+
+
+def _samples(parsed, name):
+    return {lab: v for (n, lab), v in parsed.items() if n == name}
+
+
+def _scrape(addr, timeout=15.0):
+    with urllib.request.urlopen(f"{addr}/metrics",
+                                timeout=timeout) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        return resp.read().decode("utf-8")
+
+
+def _post(addr, payload, timeout=15.0):
+    req = urllib.request.Request(
+        addr, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------- registry
+
+
+class TestMetricsRegistry:
+    def test_render_and_parse_round_trip(self):
+        reg = MetricsRegistry()
+        s = StageStats()
+        s.incr("shed", 0)
+        s.incr("salvaged", 3)
+        s.set_gauge("depth", 7.5)
+        s.timer("decode").record(0.002)
+        s.add_rows(128)
+        reg.register("scoring", s)
+        parsed = parse_prometheus(reg.render_prometheus())
+        key = frozenset({"ns": "scoring"}.items())
+        assert parsed[("mmlspark_tpu_rows_total", key)] == 128
+        assert parsed[("mmlspark_tpu_events_total",
+                       frozenset({"ns": "scoring",
+                                  "event": "salvaged"}.items()))] == 3
+        assert parsed[("mmlspark_tpu_events_total",
+                       frozenset({"ns": "scoring",
+                                  "event": "shed"}.items()))] == 0
+        assert parsed[("mmlspark_tpu_gauge",
+                       frozenset({"ns": "scoring",
+                                  "name": "depth"}.items()))] == 7.5
+        assert parsed[("mmlspark_tpu_stage_latency_seconds_count",
+                       frozenset({"ns": "scoring",
+                                  "stage": "decode"}.items()))] == 1
+
+    def test_register_replaces_and_unregister(self):
+        reg = MetricsRegistry()
+        a, b = StageStats(), StageStats()
+        a.incr("x", 1)
+        b.incr("x", 2)
+        reg.register("ns1", a)
+        reg.register("ns1", b)       # newest wins
+        assert reg.snapshot()["ns1"]["counters"]["x"] == 2
+        reg.unregister("ns1")
+        assert reg.snapshot() == {}
+
+    def test_label_escaping_stays_parseable(self):
+        text = render_prometheus(
+            {'we"ird\\ns': {"counters": {'e"v': 1}}})
+        parsed = parse_prometheus(text)
+        assert any(n == "mmlspark_tpu_events_total"
+                   for n, _ in parsed)
+
+    def test_bad_source_skipped_not_fatal(self):
+        class Bad:
+            def snapshot(self):
+                raise RuntimeError("broken source")
+        reg = MetricsRegistry()
+        reg.register("bad", Bad())
+        reg.register("ok", StageStats())
+        assert "ok" in reg.snapshot() and "bad" not in reg.snapshot()
+
+    def test_inf_gauge_renders_not_503(self):
+        """One inf gauge must render as '+Inf', not kill the scrape
+        with OverflowError (review finding)."""
+        text = render_prometheus(
+            {"ns1": {"gauges": {"worst_age": float("inf"),
+                                "neg": float("-inf")}}})
+        parsed = parse_prometheus(text)
+        assert parsed[("mmlspark_tpu_gauge",
+                       frozenset({"ns": "ns1",
+                                  "name": "worst_age"}.items()))] \
+            == float("inf")
+
+    def test_merge_up_gauges_take_min(self):
+        """Up-style health gauges aggregate with MIN: one degraded
+        worker must show in the workers block (review finding)."""
+        m = merge_snapshots([
+            {"gauges": {"exchange_link_up": 0.0, "age_ms": 5.0}},
+            {"gauges": {"exchange_link_up": 1.0, "age_ms": 9.0}}])
+        assert m["gauges"]["exchange_link_up"] == 0.0
+        assert m["gauges"]["age_ms"] == 9.0
+
+    def test_merge_snapshots_aggregates(self):
+        a = {"rows": 10, "rows_per_s": 5.0, "counters": {"shed": 1},
+             "gauges": {"age": 3.0},
+             "stages": {"score": {"count": 2, "total_s": 0.2,
+                                  "p50_ms": 10.0, "p99_ms": 20.0}}}
+        b = {"rows": 5, "rows_per_s": 2.5, "counters": {"shed": 2},
+             "gauges": {"age": 9.0},
+             "stages": {"score": {"count": 1, "total_s": 0.1,
+                                  "p50_ms": 50.0, "p99_ms": 60.0}}}
+        m = merge_snapshots([a, b])
+        assert m["rows"] == 15 and m["counters"]["shed"] == 3
+        assert m["gauges"]["age"] == 9.0          # worst-of
+        assert m["stages"]["score"]["count"] == 3
+        assert m["stages"]["score"]["p99_ms"] == 60.0
+
+
+# ---------------------------------------------------------------- satellites
+
+
+class TestStageStatsSnapshotConsistency:
+    def test_snapshot_under_contention(self):
+        """rows and rows_per_s are read under ONE lock acquisition —
+        hammer add_rows from threads while snapshotting; every snapshot
+        must be internally coherent (never rows>0 with a window that
+        another thread already advanced past it)."""
+        s = StageStats()
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                s.add_rows(1)
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                snap = s.snapshot()
+                assert snap["rows"] >= 0
+                assert snap["rows_per_s"] >= 0.0
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5)
+        final = s.snapshot()
+        assert final["rows"] == s.rows
+
+    def test_heartbeat_age_gauge_seeded_at_start(self, tmp_path):
+        from mmlspark_tpu.gbdt.elastic import (ElasticConfig,
+                                               HeartbeatWatchdog)
+        cfg = ElasticConfig(heartbeat_dir=str(tmp_path), process_id=0,
+                            num_processes=1,
+                            heartbeat_interval_s=10.0)
+        wd = HeartbeatWatchdog(cfg).start()
+        try:
+            # BEFORE any tick completes: explicit zero, not missing
+            snap = wd.stats.snapshot()
+            assert snap["gauges"]["heartbeat_age_ms"] == 0.0
+            assert snap["counters"]["heartbeat_stalls"] == 0
+            assert snap["counters"]["peer_lost"] == 0
+        finally:
+            wd.stop()
+
+    def test_lease_file_carries_fit_span(self, tmp_path):
+        from mmlspark_tpu.gbdt.elastic import (ElasticConfig,
+                                               HeartbeatWatchdog)
+        cfg = ElasticConfig(heartbeat_dir=str(tmp_path), process_id=0,
+                            num_processes=1)
+        wd = HeartbeatWatchdog(cfg)
+        os.makedirs(cfg.heartbeat_dir, exist_ok=True)
+        telemetry.set_current_fit_span("feedface00000000")
+        try:
+            wd._touch()
+        finally:
+            telemetry.set_current_fit_span(None)
+        content = open(wd.path_for(0)).read()
+        assert "feedface00000000" in content
+
+
+def _write_trace(dir_path, fname, ops, mtime):
+    os.makedirs(dir_path, exist_ok=True)
+    events = [{"ph": "M", "name": "process_name", "pid": 1,
+               "args": {"name": "TPU:0 /device"}}]
+    events += [{"ph": "X", "pid": 1, "name": name, "dur": dur_us,
+                "ts": 0} for name, dur_us in ops]
+    path = os.path.join(dir_path, fname)
+    with gzip.open(path, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    os.utime(path, (mtime, mtime))
+    return path
+
+
+class TestSummarizeTrace:
+    def test_selects_by_mtime_not_name_and_totals(self, tmp_path):
+        from mmlspark_tpu.core.profiling import summarize_trace
+        now = time.time()
+        # lexicographically LAST but OLD — the pre-fix code picked this
+        _write_trace(str(tmp_path), "zzz_old.trace.json.gz",
+                     [("stale_op", 9_000_000)], now - 3600)
+        # lexicographically first but NEWEST — must win
+        _write_trace(str(tmp_path), "aaa_new.trace.json.gz",
+                     [("fresh_op", 2000), ("other_op", 1000)], now)
+        rows = summarize_trace(str(tmp_path))
+        names = [n for _, n in rows]
+        assert "fresh_op" in names and "stale_op" not in names
+        # total_device_ms summary row alongside the per-op rows
+        assert names[-1] == "total_device_ms"
+        total = dict((n, ms) for ms, n in rows)["total_device_ms"]
+        assert total == pytest.approx(3.0)
+
+    def test_empty_dir_returns_empty(self, tmp_path):
+        from mmlspark_tpu.core.profiling import summarize_trace
+        assert summarize_trace(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------- journal
+
+
+class TestEventJournal:
+    def test_contended_emits_and_file_round_trip(self, tmp_path):
+        j = EventJournal(capacity=10000)
+        n_threads, per = 8, 250
+
+        def writer(k):
+            for i in range(per):
+                j.emit("ev", thread=k, i=i)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15)
+        events = j.events()
+        assert len(events) == n_threads * per
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        path = str(tmp_path / "journal.jsonl")
+        assert j.dump(path) == len(events)
+        assert read_journal(path) == events
+
+    def test_ring_is_bounded(self):
+        j = EventJournal(capacity=16)
+        for i in range(100):
+            j.emit("ev", i=i)
+        events = j.events()
+        assert len(events) == 16
+        assert events[-1]["i"] == 99
+
+    def test_configure_mirrors_and_survives_torn_tail(self, tmp_path):
+        path = str(tmp_path / "mirror.jsonl")
+        j = EventJournal(capacity=8, path=path)
+        j.emit("a", x=1)
+        j.emit("b", x=2)
+        j.configure(None)
+        with open(path, "a") as fh:
+            fh.write('{"ev": "torn...')     # crash mid-write
+        back = read_journal(path)
+        assert [e["ev"] for e in back] == ["a", "b"]
+
+    def test_span_context_manager(self):
+        j = EventJournal()
+        with j.span("work", fit="f1"):
+            pass
+        kinds = [e["ev"] for e in j.events()]
+        assert kinds == ["work_begin", "work_end"]
+        assert j.events()[-1]["dur_ms"] >= 0
+
+
+# ---------------------------------------------------------------- request trace
+
+
+class TestRequestTracing:
+    def _run_engine_burst(self, trace_payloads):
+        import queue
+
+        from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+
+        class Srv:
+            def __init__(self):
+                self.request_queue = queue.Queue()
+                self.replies = []
+                self._lock = threading.Lock()
+
+            def reply(self, rid, val, status=200):
+                with self._lock:
+                    self.replies.append((rid, val, status))
+                return True
+
+        srv = Srv()
+        eng = ScoringEngine(srv,
+                            predictor=lambda X: X.sum(axis=1),
+                            plan=ColumnPlan("features", 3),
+                            num_scorers=1, num_repliers=0,
+                            latency_budget_ms=2.0)
+        for rid, payload in trace_payloads:
+            srv.request_queue.put((rid, payload, time.perf_counter()))
+        eng.start()
+        try:
+            deadline = time.time() + 10
+            while len(srv.replies) < len(trace_payloads) \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            eng.stop()
+        return srv
+
+    def test_form_decode_score_reply_timeline(self):
+        trace_report = _load_tool("trace_report")
+        tid = telemetry.new_trace_id()
+        payloads = [("r%d" % i, {"features": [1.0, 2.0, float(i)]})
+                    for i in range(4)]
+        payloads.append(("rT", {"features": [9.0, 9.0, 9.0],
+                                "_trace_id": tid}))
+        srv = self._run_engine_burst(payloads)
+        assert len(srv.replies) == 5
+        events = telemetry.get_journal().events()
+        report = trace_report.request_timeline(events, tid)
+        assert report["rid"] == "rT"
+        assert report["complete"], report["stages"]
+        order = [s for s in report["stages"]
+                 if s in trace_report.REQUEST_STAGES]
+        assert order == list(trace_report.REQUEST_STAGES)
+        # minted-at-admission contract: the rid is a trace id too
+        report2 = trace_report.request_timeline(events, "r1")
+        assert report2["complete"]
+
+    def test_shed_request_journaled(self):
+        import queue
+
+        from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+
+        class Srv:
+            def __init__(self):
+                self.request_queue = queue.Queue()
+                self.replies = []
+
+            def reply(self, rid, val, status=200):
+                self.replies.append((rid, val, status))
+                return True
+
+        srv = Srv()
+        eng = ScoringEngine(srv, predictor=lambda X: X.sum(axis=1),
+                            plan=ColumnPlan("features", 3),
+                            num_scorers=1, num_repliers=0,
+                            shed_wait_ms=0.0)
+        old = time.perf_counter() - 10.0   # waited "10s" already
+        srv.request_queue.put(("shed-me", {"features": [1, 2, 3]}, old))
+        eng.start()
+        try:
+            deadline = time.time() + 10
+            while not srv.replies and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            eng.stop()
+        assert srv.replies and srv.replies[0][2] == 503
+        shed = [e for e in telemetry.get_journal().events()
+                if e["ev"] == "shed"
+                and "shed-me" in (e.get("rids") or [])]
+        assert shed and "shed-me" in shed[0]["trace_ids"]
+
+
+# ---------------------------------------------------------------- fit trace
+
+
+class TestFitTelemetry:
+    def test_fit_timeline_with_checkpoint_events(self, tmp_path):
+        from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+        from mmlspark_tpu.gbdt.engine import (TrainParams, train,
+                                              train_stats)
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        trace_report = _load_tool("trace_report")
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 5)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+        mapper = fit_bin_mapper(X, max_bin=15)
+        bins = mapper.transform_packed(X)
+        before = train_stats.snapshot()["counters"]
+        params = TrainParams(num_iterations=6, num_leaves=7,
+                             verbosity=0,
+                             checkpoint_dir=str(tmp_path / "ck"),
+                             checkpoint_chunk=2)
+        b = train(bins, y, None, mapper, get_objective("binary"),
+                  params)
+        assert len(b.trees) == 6
+
+        events = telemetry.get_journal().events()
+        report = trace_report.fit_timeline(events)   # newest fit
+        assert report["complete"], report["kinds"]
+        kinds = report["kinds"]
+        assert kinds[0] == "fit_begin" and kinds[-1] == "fit_end"
+        assert "boost_chunk" in kinds and "ckpt_saved" in kinds
+        # every event of the timeline carries the SAME span id
+        assert len({e["fit"] for e in report["events"]}) == 1
+        # fit_end reports the forest it produced
+        assert report["events"][-1]["trees"] == 6
+
+        # live gauges moved
+        snap = train_stats.snapshot()
+        assert snap["gauges"]["ms_per_tree"] > 0
+        assert snap["gauges"]["train_rows_per_s"] > 0
+        assert snap["gauges"]["last_iteration"] == 6.0
+        assert 0 < snap["gauges"]["train_loss"] < 1.0   # binary logloss
+        after = snap["counters"]
+        assert after["ckpt_saved"] - before["ckpt_saved"] == 2
+        assert after["boost_chunks"] - before["boost_chunks"] == 3
+
+        # boost_chunk fields: the histogram method is named
+        bc = [e for e in report["events"] if e["ev"] == "boost_chunk"]
+        assert all("hist_method" in e and e["ms_per_tree"] > 0
+                   for e in bc)
+
+    def test_fit_span_stamped_into_checkpoint_meta(self, tmp_path):
+        from mmlspark_tpu.gbdt.engine import (_CKPT_FILE, TrainParams,
+                                              train)
+        from mmlspark_tpu.gbdt.binning import fit_bin_mapper
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        mapper = fit_bin_mapper(X, max_bin=15)
+        bins = mapper.transform_packed(X)
+        ck = str(tmp_path / "ck")
+        meta_seen = {}
+        orig_save = None
+
+        # capture the meta mid-fit (the fit clears its checkpoint on
+        # success, so read it through the save hook)
+        import mmlspark_tpu.gbdt.engine as eng_mod
+        orig_save = eng_mod._ckpt_save
+
+        def spy(*a, **kw):
+            orig_save(*a, **kw)
+            with np.load(os.path.join(ck, _CKPT_FILE)) as z:
+                meta_seen.update(json.loads(
+                    bytes(z["__meta__"]).decode("utf-8")))
+
+        eng_mod._ckpt_save = spy
+        try:
+            train(bins, y, None, mapper, get_objective("binary"),
+                  TrainParams(num_iterations=4, num_leaves=7,
+                              verbosity=0, checkpoint_dir=ck,
+                              checkpoint_chunk=2))
+        finally:
+            eng_mod._ckpt_save = orig_save
+        assert re.fullmatch(r"[0-9a-f]{16}", meta_seen.get("fit_span"))
+
+    def test_monitor_loss_sampled_on_large_fits(self):
+        """Beyond the row cap the train-loss gauge is computed on a
+        strided sample — bounded D2H per boundary, not O(n) (review
+        finding)."""
+        from mmlspark_tpu.gbdt import engine as eng
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        n = eng._MONITOR_LOSS_MAX_ROWS * 3
+        rng = np.random.default_rng(0)
+        scores = rng.normal(size=n).astype(np.float32)
+        labels = (scores + rng.normal(size=n) > 0).astype(np.float64)
+        eng._monitor_chunk(0, 2, 0.1, n, 1, "auto",
+                           get_objective("binary"), scores, labels,
+                           None)
+        sampled = eng.train_stats.snapshot()["gauges"]["train_loss"]
+        exact = get_objective("binary").train_loss(scores, labels)
+        assert 0 < sampled < 1.5
+        assert sampled == pytest.approx(exact, rel=0.1)
+
+    def test_train_loss_objectives(self):
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        binary = get_objective("binary")
+        y = np.array([0.0, 1.0, 1.0, 0.0])
+        perfect = np.array([-20.0, 20.0, 20.0, -20.0])
+        awful = -perfect
+        assert binary.train_loss(perfect, y) < 1e-6
+        assert binary.train_loss(awful, y) > 5.0
+        l2 = get_objective("regression")
+        assert l2.train_loss(np.array([1.0, 2.0]),
+                             np.array([1.0, 4.0])) == pytest.approx(2.0)
+        # objectives without a closed form opt out, not crash
+        assert get_objective("quantile").train_loss(perfect, y) is None
+
+
+# ---------------------------------------------------------------- /metrics
+
+
+class TestMetricsHTTPSingleProcess:
+    def test_scrape_and_counter_monotonicity(self):
+        from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+        from mmlspark_tpu.io.serving import HTTPServer
+        srv = HTTPServer().start()
+        eng = ScoringEngine(srv, predictor=lambda X: X.sum(axis=1),
+                            plan=ColumnPlan("features", 4),
+                            num_scorers=1, num_repliers=0).start()
+        try:
+            for i in range(3):
+                _post(srv.address, {"features": [1.0, 2.0, 3.0,
+                                                 float(i)]})
+            first = parse_prometheus(_scrape(srv.address))
+            key = frozenset({"ns": "scoring"}.items())
+            assert first[("mmlspark_tpu_rows_total", key)] >= 3
+            # load burst, then re-scrape: every counter is monotonic
+            for i in range(8):
+                _post(srv.address, {"features": [0.0, 0.0, 0.0,
+                                                 float(i)]})
+            second = parse_prometheus(_scrape(srv.address))
+            for (name, lab), v in first.items():
+                if name.endswith(("_total", "_count")):
+                    assert second.get((name, lab), 0.0) >= v, \
+                        f"counter went backwards: {name} {dict(lab)}"
+            assert second[("mmlspark_tpu_rows_total", key)] >= 11
+            # resilience counters are present as explicit zeros
+            for ev in ("shed", "expired", "salvaged", "restarted"):
+                assert (("mmlspark_tpu_events_total",
+                         frozenset({"ns": "scoring",
+                                    "event": ev}.items())) in second)
+            # serving stage latencies are exposed
+            stages = {dict(lab).get("stage")
+                      for (n, lab) in second
+                      if n == "mmlspark_tpu_stage_latency_seconds"}
+            assert {"decode", "score", "reply", "e2e"} <= stages
+        finally:
+            eng.stop()
+            srv.stop()
+
+
+class TestMetricsHTTPMultiprocess:
+    def test_single_scrape_sees_whole_topology(self):
+        """Acceptance (ISSUE 5): one GET /metrics against the 2-process
+        MultiprocessHTTPServer returns valid exposition with serving
+        stage latencies, resilience counters, and worker-aggregated
+        totals."""
+        from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+        from mmlspark_tpu.io.serving import MultiprocessHTTPServer
+        srv = MultiprocessHTTPServer(num_workers=2).start()
+        eng = ScoringEngine(srv, predictor=lambda X: X.sum(axis=1),
+                            plan=ColumnPlan("features", 3),
+                            num_scorers=1, num_repliers=1).start()
+        try:
+            for i, addr in enumerate(srv.addresses * 2):
+                got = _post(addr, {"features": [1.0, 1.0, float(i)]})
+                assert got == pytest.approx(2.0 + i)
+            text = _scrape(srv.addresses[0])
+            parsed = parse_prometheus(text)     # valid exposition
+            # driver-side scoring stats with stage latencies
+            key = frozenset({"ns": "scoring"}.items())
+            assert parsed[("mmlspark_tpu_rows_total", key)] >= 4
+            stages = {dict(lab).get("stage")
+                      for (n, lab) in parsed
+                      if n == "mmlspark_tpu_stage_latency_seconds"}
+            assert {"decode", "score", "reply"} <= stages
+            # resilience counters (seeded zeros still present)
+            for ev in ("shed", "expired", "salvaged", "restarted"):
+                assert (("mmlspark_tpu_events_total",
+                         frozenset({"ns": "scoring",
+                                    "event": ev}.items())) in parsed)
+            # exchange counters
+            assert (("mmlspark_tpu_events_total",
+                     frozenset({"ns": "serving_exchange",
+                                "event": "worker_deaths"}.items()))
+                    in parsed)
+            # worker-aggregated totals: the scraped worker reported its
+            # stats on the scrape round-trip, so ns="workers" exists
+            # and its parked count covers that worker's requests
+            wkey = frozenset({"ns": "workers",
+                              "event": "parked"}.items())
+            assert parsed[("mmlspark_tpu_events_total", wkey)] >= 2
+            per_worker = {dict(lab)["ns"]
+                          for (n, lab) in parsed
+                          if n == "mmlspark_tpu_events_total"
+                          and dict(lab)["ns"].startswith("worker")}
+            assert any(ns.startswith("worker")
+                       and ns not in ("workers",) for ns in per_worker)
+        finally:
+            eng.stop()
+            srv.stop()
+
+
+# ---------------------------------------------------------------- artifacts
+
+
+class TestToolArtifactSchema:
+    def _assert_block(self, block):
+        assert set(block) == {"metrics_exposition", "journal_excerpt"}
+        assert isinstance(block["metrics_exposition"], str)
+        parse_prometheus(block["metrics_exposition"])   # must be valid
+        assert isinstance(block["journal_excerpt"], list)
+        for rec in block["journal_excerpt"]:
+            assert isinstance(rec, dict) and "ev" in rec and "ts" in rec
+
+    def test_bench_serving_telemetry_block(self):
+        bench = _load_tool("bench_serving")
+        telemetry.get_journal().emit("artifact_probe")  # non-empty tail
+        block = bench.telemetry_block()
+        self._assert_block(block)
+        # the exposition carries the train namespace at minimum (the
+        # registry registers it at gbdt.engine import)
+        assert 'ns="train"' in block["metrics_exposition"]
+
+    def test_chaos_training_telemetry_block(self):
+        chaos = _load_tool("chaos_training")
+        stats_by_pid = {
+            "0": {"train": {"rows": 0, "rows_per_s": 0.0,
+                            "counters": {"ckpt_saved": 2,
+                                         "ckpt_resumed": 1},
+                            "gauges": {"ms_per_tree": 4.2},
+                            "stages": {}},
+                  "watchdog": {"rows": 0, "rows_per_s": 0.0,
+                               "counters": {"heartbeat_stalls": 1,
+                                            "peer_lost": 0},
+                               "gauges": {"heartbeat_age_ms": 12.0},
+                               "stages": {}},
+                  "journal_tail": [{"ts": 2.0, "seq": 2,
+                                    "ev": "ckpt_saved", "fit": "f0"}]},
+            "1": {"train": {"rows": 0, "rows_per_s": 0.0,
+                            "counters": {"ckpt_saved": 2,
+                                         "ckpt_resumed": 0},
+                            "gauges": {}, "stages": {}},
+                  "watchdog": {"rows": 0, "rows_per_s": 0.0,
+                               "counters": {}, "gauges": {},
+                               "stages": {}},
+                  "journal_tail": [{"ts": 1.0, "seq": 1,
+                                    "ev": "fit_begin", "fit": "f0"}]},
+        }
+        block = chaos.telemetry_block(stats_by_pid)
+        self._assert_block(block)
+        parsed = parse_prometheus(block["metrics_exposition"])
+        # gang-aggregated totals sum across controllers
+        assert parsed[("mmlspark_tpu_events_total",
+                       frozenset({"ns": "train_gang",
+                                  "event": "ckpt_saved"}.items()))] == 4
+        # journal excerpt is (ts, seq)-ordered across processes
+        assert [e["ev"] for e in block["journal_excerpt"]] == \
+            ["fit_begin", "ckpt_saved"]
+
+    def test_trace_report_cli(self, tmp_path, capsys):
+        trace_report = _load_tool("trace_report")
+        j = EventJournal()
+        j.emit("fit_begin", fit="abc")
+        j.emit("boost_chunk", fit="abc", it_start=0, it_end=2,
+               ms_per_tree=1.0, rows_per_s=10.0, hist_method="auto")
+        j.emit("fit_end", fit="abc", dur_s=0.1, trees=2)
+        path = str(tmp_path / "j.jsonl")
+        j.dump(path)
+        rc = trace_report.main([path, "--fit", "latest"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fit span=abc complete=True" in out

@@ -372,6 +372,21 @@ def scenario_http_threads(b, X, args):
 
 # ---------------------------------------------------------------- main
 
+def telemetry_block(journal_tail=40):
+    """The artifact's telemetry section (ISSUE 5): the exact Prometheus
+    exposition a ``/metrics`` scrape of this process would return
+    (the last engine's stage latencies and resilience counters are
+    registered under ``ns="scoring"``) plus a journal excerpt — so a
+    perf regression review can read the claimed numbers straight from
+    telemetry instead of ad-hoc prints.  Schema is pinned by
+    tests/test_telemetry.py."""
+    from mmlspark_tpu.core.telemetry import get_journal, get_registry
+    return {
+        "metrics_exposition": get_registry().render_prometheus(),
+        "journal_excerpt": get_journal().tail(journal_tail),
+    }
+
+
 def check_correctness(b, X):
     """Bit-exact margins across every scored path, pinned BEFORE timing."""
     import numpy as np
@@ -465,6 +480,7 @@ def main():
         "unit": "rows/s",
         "vs_baseline": detail["open_jit"]["ratio_slo_goodput"],
         "accept_ratio_ge_3": detail["open_jit"]["ratio_slo_goodput"] >= 3.0,
+        "telemetry": telemetry_block(),
         "detail": detail,
     }
     print(json.dumps({k: v for k, v in result.items() if k != "detail"}),

@@ -151,6 +151,34 @@ def run_phase(phase, workdir, args, *, kill=False, corrupt="",
             "exit_codes": exit_codes, "ckpt_leftover": ckpt_leftover}
 
 
+def telemetry_block(stats_by_pid, journal_tail=60):
+    """The artifact's telemetry section (ISSUE 5): render the workers'
+    dumped StageStats snapshots (``train`` + ``watchdog`` per
+    controller, plus gang-aggregated totals) in the same Prometheus
+    exposition a ``/metrics`` scrape would return, and merge their
+    journal tails into one ``(ts, seq)``-ordered excerpt — the recovery
+    story (ckpt_saved/ckpt_resumed, peer_stalled, fit spans) read from
+    telemetry instead of ad-hoc prints.  Schema is pinned by
+    tests/test_telemetry.py."""
+    from mmlspark_tpu.core.telemetry import (merge_snapshots,
+                                             render_prometheus)
+    snaps, journal = {}, []
+    for pid in sorted(stats_by_pid):
+        s = stats_by_pid[pid]
+        for group in ("train", "watchdog"):
+            if isinstance(s.get(group), dict):
+                snaps[f"{group}_p{pid}"] = s[group]
+        journal.extend(s.get("journal_tail") or [])
+    for group in ("train", "watchdog"):
+        members = [s[group] for s in stats_by_pid.values()
+                   if isinstance(s.get(group), dict)]
+        if members:
+            snaps[f"{group}_gang"] = merge_snapshots(members)
+    journal.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    return {"metrics_exposition": render_prometheus(snaps),
+            "journal_excerpt": journal[-journal_tail:]}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None, help="artifact JSON path")
@@ -257,6 +285,9 @@ def main():
         "value": int(all(verdicts.values())),
         "unit": "pass",
         "verdicts": verdicts,
+        # the kill phase's final round carries the richest recovery
+        # telemetry (resume counters, fit spans, ckpt events)
+        "telemetry": telemetry_block(kill_last),
         "detail": detail,
     }
     print(json.dumps({"verdicts": verdicts,

@@ -1,0 +1,191 @@
+"""Trace-report reader: reconstruct per-request and per-fit timelines
+from an :class:`mmlspark_tpu.core.telemetry.EventJournal` JSONL dump
+(ISSUE 5).
+
+The serving engine journals per-BATCH pipeline events
+(``form``/``decode``/``score``/``reply``, plus
+``shed``/``expired``/``salvage``) carrying the batch's request ids and
+trace ids; the training engine journals per-FIT events (``fit_begin``,
+``boost_chunk``, ``ckpt_saved``/``ckpt_resumed``/``ckpt_discarded``,
+``chunk_replayed``, ``peer_stalled``/``peer_lost``, ``fit_end``) stamped
+with a fit span id.  This tool stitches either kind back into a
+timeline:
+
+* :func:`request_timeline` — given a trace id (the client's
+  ``_trace_id`` payload key, or the request id minted at admission),
+  find the request's batch events and order them: a complete scored
+  request shows ``form → decode → score → reply``.
+* :func:`fit_timeline` — given a fit span id (or the newest fit in the
+  journal), order everything stamped with it.
+
+CLI::
+
+    python tools/trace_report.py JOURNAL.jsonl [more.jsonl ...] \
+        [--trace-id TID] [--fit SPAN | --fit latest]
+
+Multiple journal files (e.g. one per controller of a gang) are merged
+and ordered by ``(ts, seq)`` — ``seq`` is process-monotonic, ``ts`` is
+wall clock, so cross-process order is as honest as the hosts' clocks.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the serving pipeline stages a fully-served request passes through
+REQUEST_STAGES = ("form", "decode", "score", "reply")
+
+
+def load_events(paths) -> List[dict]:
+    """Load and merge one or more JSONL journals (or pass event dicts
+    through), ordered by ``(ts, seq)``."""
+    from mmlspark_tpu.core.telemetry import read_journal
+    events: List[dict] = []
+    for p in ([paths] if isinstance(paths, str) else list(paths)):
+        if isinstance(p, dict):
+            events.append(p)
+        else:
+            events.extend(read_journal(p))
+    events.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    return events
+
+
+def _resolve_rid(events: Iterable[dict], trace_id: str) -> str:
+    """Map a trace id to its request id via any batch event that
+    carries both aligned lists; a trace id that never appears is
+    assumed to BE the rid (the minted-at-admission default, where the
+    two are the same string)."""
+    for e in events:
+        tids = e.get("trace_ids") or []
+        if trace_id in tids:
+            rids = e.get("rids") or []
+            i = tids.index(trace_id)
+            if i < len(rids):
+                return str(rids[i])
+    return trace_id
+
+
+def request_timeline(events: Iterable[dict], trace_id: str) -> dict:
+    """Reconstruct one request's pipeline timeline.
+
+    Returns ``{"trace_id", "rid", "events": [...], "stages": [...],
+    "complete": bool}`` — ``complete`` means the full
+    form→decode→score→reply chain was observed (a shed/expired request
+    is legitimately incomplete and shows its degradation event
+    instead)."""
+    events = list(events)
+    rid = _resolve_rid(events, trace_id)
+    mine: List[dict] = []
+    for e in events:
+        if rid in (e.get("rids") or []) \
+                or trace_id in (e.get("trace_ids") or []):
+            mine.append(e)
+    mine.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    stages = [e.get("ev") for e in mine]
+    return {
+        "trace_id": trace_id,
+        "rid": rid,
+        "events": mine,
+        "stages": stages,
+        "complete": all(s in stages for s in REQUEST_STAGES),
+    }
+
+
+def list_fits(events: Iterable[dict]) -> List[str]:
+    """Fit span ids in first-seen order."""
+    out: List[str] = []
+    for e in events:
+        span = e.get("fit")
+        if span and span not in out:
+            out.append(span)
+    return out
+
+
+def fit_timeline(events: Iterable[dict],
+                 fit_span: Optional[str] = None) -> dict:
+    """Reconstruct one fit's timeline (``fit_span=None`` picks the
+    NEWEST fit that has a ``fit_begin`` — the one a post-mortem usually
+    wants).  ``complete`` means both ``fit_begin`` and ``fit_end`` were
+    observed; a crashed fit shows ``fit_failed`` or simply no end."""
+    events = list(events)
+    if fit_span is None:
+        begins = [e.get("fit") for e in events
+                  if e.get("ev") == "fit_begin" and e.get("fit")]
+        fit_span = begins[-1] if begins else None
+    mine = [e for e in events if e.get("fit") == fit_span]
+    mine.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    kinds = [e.get("ev") for e in mine]
+    return {
+        "fit": fit_span,
+        "events": mine,
+        "kinds": kinds,
+        "complete": "fit_begin" in kinds and "fit_end" in kinds,
+    }
+
+
+def _fmt_event(e: dict, t0: float) -> str:
+    extras = {k: v for k, v in e.items()
+              if k not in ("ts", "seq", "ev", "rids", "trace_ids")}
+    nrows = len(e.get("rids") or [])
+    if nrows:
+        extras["batch"] = nrows
+    tail = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+    return f"  +{e.get('ts', t0) - t0:9.3f}s  {e.get('ev', '?'):14s} {tail}"
+
+
+def print_request(report: dict) -> None:
+    print(f"request trace_id={report['trace_id']} rid={report['rid']} "
+          f"complete={report['complete']}")
+    evs = report["events"]
+    t0 = evs[0].get("ts", 0.0) if evs else 0.0
+    for e in evs:
+        print(_fmt_event(e, t0))
+
+
+def print_fit(report: dict) -> None:
+    print(f"fit span={report['fit']} complete={report['complete']} "
+          f"({len(report['events'])} events)")
+    evs = report["events"]
+    t0 = evs[0].get("ts", 0.0) if evs else 0.0
+    for e in evs:
+        print(_fmt_event(e, t0))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="reconstruct request/fit timelines from telemetry "
+                    "journals")
+    ap.add_argument("journals", nargs="+", help="JSONL journal file(s)")
+    ap.add_argument("--trace-id", default=None,
+                    help="report this request's pipeline timeline")
+    ap.add_argument("--fit", default=None,
+                    help="fit span id to report ('latest' for the "
+                         "newest fit in the journal)")
+    args = ap.parse_args(argv)
+    events = load_events(args.journals)
+    print(f"{len(events)} events from {len(args.journals)} journal(s)")
+    did = False
+    if args.trace_id:
+        print_request(request_timeline(events, args.trace_id))
+        did = True
+    if args.fit:
+        span = None if args.fit == "latest" else args.fit
+        print_fit(fit_timeline(events, span))
+        did = True
+    if not did:
+        # no selector: summarize what's in there
+        kinds: Dict[str, int] = {}
+        for e in events:
+            kinds[e.get("ev", "?")] = kinds.get(e.get("ev", "?"), 0) + 1
+        print("event counts:", json.dumps(kinds, sort_keys=True))
+        fits = list_fits(events)
+        print(f"fits: {fits}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
