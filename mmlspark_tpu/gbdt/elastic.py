@@ -10,9 +10,14 @@ restarts from ``initModelPath``.  This module is the training-side
 resilience layer (the serving analog shipped in ``io/serving.py``'s
 worker supervision):
 
-* :class:`HeartbeatWatchdog` — a file-lease heartbeat each controller
-  writes into a shared directory and monitors for its peers.  A stale
-  peer beyond ``straggler_age_s`` is a *straggler* (counted, age
+* :class:`HeartbeatWatchdog` — a lease heartbeat each controller
+  advertises and monitors for its peers.  Two wire modes, one policy:
+  shared-directory lease FILES (the single-host default), or — with
+  ``ElasticConfig.transport_address`` set — lease BEACONS over the
+  unified :mod:`mmlspark_tpu.io.transport` to a :class:`HeartbeatHub`
+  relay (the multi-host mode; a link blip is absorbed by the session
+  resume, so the beacon channel itself cannot fake a dead peer).  A
+  stale peer beyond ``straggler_age_s`` is a *straggler* (counted, age
   surfaced as a :class:`~mmlspark_tpu.core.profiling.StageStats`
   gauge); beyond ``lease_timeout_s`` the peer is declared lost and the
   watchdog abandons the wedged process with
@@ -68,6 +73,16 @@ class ElasticConfig:
     heartbeat_dir: str
     process_id: int
     num_processes: int
+    #: when set (``host:port``), lease beacons ride the unified
+    #: :mod:`mmlspark_tpu.io.transport` session to a
+    #: :class:`HeartbeatHub` instead of the shared-filesystem lease
+    #: files — the multi-host topology, where no shared directory
+    #: exists.  A link blip is absorbed by the transport's resume
+    #: (reconnect + replay), so a healthy gang never sees a false
+    #: ``peer_lost`` from the beacon channel itself.
+    transport_address: str = ""
+    #: shared secret for the hub's transport handshake
+    transport_token: str = ""
     #: how often each controller touches its lease file
     heartbeat_interval_s: float = 0.25
     #: peer heartbeat age beyond which the peer counts as a STRAGGLER
@@ -129,28 +144,63 @@ class HeartbeatWatchdog:
         # 5s-skewed mount would expire every lease on a healthy gang
         self._peer_mtime: Dict[int, float] = {}
         self._peer_seen: Dict[int, float] = {}
+        # transport mode: hub-relayed lease beacons, aged by the LOCAL
+        # monotonic receipt time (same skew-immunity argument)
+        self._client = None
 
     def path_for(self, pid: int) -> str:
         return os.path.join(self.cfg.heartbeat_dir, _HB_FILE.format(pid))
 
     def _touch(self) -> None:
-        path = self.path_for(self.cfg.process_id)
         # the lease carries the CURRENT fit span (liveness itself is
-        # mtime-based — peers never parse this): a post-mortem can tie
-        # "whose lease went stale" to "which fit was running", and a
+        # observation-based — peers never parse this): a post-mortem can
+        # tie "whose lease went stale" to "which fit was running", and a
         # resumed gang's fresh span shows in the lease immediately
+        if self._client is not None:
+            try:
+                from ..io.transport import CH_ELASTIC
+                if self._client.closed:
+                    # the reconnect budget ran out (hub outage longer
+                    # than the backoff ladder): the liveness channel
+                    # must not stay dead forever — stand up a fresh
+                    # session each tick until the hub answers, so a
+                    # recovered hub sees beacons again immediately.
+                    # (While the hub is TRULY down, peer ages grow and
+                    # the lease policy applies — same as an unreachable
+                    # shared directory in file mode; the bug this
+                    # guards against is staying dark AFTER recovery.)
+                    self._client = self._make_client().connect(
+                        retries=0)
+                # short send timeout: during a hub outage the queue
+                # fills, and a beacon blocked on backpressure must not
+                # stall _check_peers (the loop's real job)
+                self._client.send(
+                    CH_ELASTIC,
+                    {"op": "lease", "pid": self.cfg.process_id,
+                     "fit": _tm.current_fit_span() or ""},
+                    timeout=min(1.0, self.cfg.heartbeat_interval_s))
+            except OSError:
+                pass   # blip: the transport reconnects and replays
+            return
+        path = self.path_for(self.cfg.process_id)
         with open(path, "w") as fh:
             fh.write(f"{time.time()} {_tm.current_fit_span() or ''}\n")
 
     def peer_ages(self) -> Dict[int, float]:
         """Seconds since this watchdog last OBSERVED each peer's lease
-        advance (inf = file missing): a peer is as old as the local
-        monotonic time since its mtime last changed, never a cross-host
-        clock comparison."""
+        advance (inf = never seen): a peer is as old as the local
+        monotonic time since its lease was last observed to move — a
+        file mtime change in lease-file mode, a hub-relayed beacon in
+        transport mode — never a cross-host clock comparison."""
         now = time.monotonic()
-        ages = {}
+        ages: Dict[int, float] = {}
         for p in range(self.cfg.num_processes):
             if p == self.cfg.process_id:
+                continue
+            if self._client is not None:
+                seen = self._peer_seen.get(p)
+                ages[p] = (now - seen) if seen is not None \
+                    else float("inf")
                 continue
             try:
                 mt = os.path.getmtime(self.path_for(p))
@@ -163,8 +213,28 @@ class HeartbeatWatchdog:
             ages[p] = now - self._peer_seen[p]
         return ages
 
+    def _on_transport_msg(self, session, channel, obj, deadline_ms):
+        from ..io.transport import CH_ELASTIC
+        if channel != CH_ELASTIC or obj.get("op") != "lease":
+            return
+        p = obj.get("pid")
+        if isinstance(p, int) and p != self.cfg.process_id:
+            self._peer_seen[p] = time.monotonic()
+
+    def _make_client(self):
+        from ..io.transport import TransportClient
+        return TransportClient(
+            self.cfg.transport_address,
+            token=self.cfg.transport_token,
+            on_message=self._on_transport_msg,
+            name=f"heartbeat-p{self.cfg.process_id}")
+
     def start(self) -> "HeartbeatWatchdog":
-        os.makedirs(self.cfg.heartbeat_dir, exist_ok=True)
+        if self.cfg.transport_address:
+            self._client = self._make_client()
+            self._client.connect()
+        else:
+            os.makedirs(self.cfg.heartbeat_dir, exist_ok=True)
         # explicit zero at START (matching the incr(_k, 0) seeding of
         # the resilience counters): "no stalls observed yet" is a
         # reading, not a missing key — even if the loop below never
@@ -184,6 +254,8 @@ class HeartbeatWatchdog:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        if self._client is not None:
+            self._client.close()
 
     def _check_peers(self) -> None:
         cfg = self.cfg
@@ -241,6 +313,54 @@ class HeartbeatWatchdog:
                 # transient filesystem hiccups; a dead watchdog would
                 # silently disable the liveness layer
                 log.exception("heartbeat tick failed; continuing")
+
+
+class HeartbeatHub:
+    """Lease-beacon relay for the transport heartbeat mode: controllers
+    dial in over :mod:`mmlspark_tpu.io.transport` resumable sessions
+    and every ``lease`` beacon on the elastic channel fans out to every
+    OTHER connected controller.  The hub never interprets leases — it
+    is a dumb, authenticated relay (typically run by the gang
+    supervisor or controller 0's host), so liveness judgement stays
+    where it was: each watchdog ages peers by its own local
+    observations.  A controller link blip is absorbed by the session
+    resume; only a peer that truly stops beaconing ages out."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 token: str = "", cfg=None):
+        from ..io.transport import TransportServer
+        self._ts = TransportServer(host, port, token=token, cfg=cfg,
+                                   on_message=self._relay,
+                                   name="heartbeat-hub")
+
+    @property
+    def address(self) -> str:
+        h, p = self._ts.address
+        return f"{h}:{p}"
+
+    def start(self) -> "HeartbeatHub":
+        self._ts.start()
+        return self
+
+    def stop(self) -> None:
+        self._ts.stop()
+
+    def _relay(self, session, channel, obj, deadline_ms) -> None:
+        from ..io.transport import CH_ELASTIC
+        if channel != CH_ELASTIC or obj.get("op") != "lease":
+            return
+        for s in list(self._ts.sessions.values()):
+            if s.sid == session.sid or not s.connected:
+                continue
+            try:
+                # near-zero timeout: the relay runs ON the beaconing
+                # controller's read pump, so ONE wedged (non-draining)
+                # peer must not delay lease delivery to the healthy
+                # ones — beacons are periodic and lossy by design,
+                # dropping beats blocking
+                s.send(CH_ELASTIC, obj, timeout=0.02)
+            except OSError:
+                pass   # that peer's link is dying; its resume catches up
 
 
 def initialize_with_retry(coordinator_address: str, num_processes: int,
@@ -377,7 +497,9 @@ def run_worker(args) -> int:
         heartbeat_interval_s=args.heartbeat_interval,
         straggler_age_s=args.straggler_age,
         lease_timeout_s=args.lease_timeout,
-        init_retries=args.init_retries, init_backoff_s=args.init_backoff)
+        init_retries=args.init_retries, init_backoff_s=args.init_backoff,
+        transport_address=getattr(args, "heartbeat_transport", ""),
+        transport_token=getattr(args, "heartbeat_token", ""))
 
     retry_used = initialize_with_retry(
         args.coordinator, args.num_processes, args.process_id,
@@ -474,6 +596,12 @@ def main(argv=None) -> int:
     ap.add_argument("--num-processes", type=int, required=True)
     ap.add_argument("--process-id", type=int, required=True)
     ap.add_argument("--heartbeat-dir", required=True)
+    ap.add_argument("--heartbeat-transport", default="",
+                    help="HOST:PORT of a HeartbeatHub — lease beacons "
+                         "ride the unified transport instead of "
+                         "shared-filesystem lease files (multi-host)")
+    ap.add_argument("--heartbeat-token", default="",
+                    help="shared secret for the heartbeat hub")
     ap.add_argument("--checkpoint-dir", default="")
     ap.add_argument("--out", default="",
                     help="native model text written by process 0")

@@ -17,7 +17,10 @@ from .serving import (DistributedHTTPServer, HTTPServer,
                       request_table, reply_from_table, serve_forever)
 from .scoring import ColumnPlan, ScoringEngine, WorkerKilled
 from .chaos import (ChaosChannel, ChaosPlan, ChaosPredictor, ChaosQueue,
-                    ChaosSocket, kill_process)
+                    ChaosSocket, ChaosTransport, kill_process)
+from .transport import (Backpressure, ChecksumError, FrameTooLarge,
+                        HandshakeError, TransportClient, TransportConfig,
+                        TransportError, TransportServer, parse_address)
 from .binary import BinaryFileReader, read_binary_files
 from .powerbi import PowerBIWriter
 
@@ -29,7 +32,10 @@ __all__ = [
     "join_exchange", "request_table", "reply_from_table",
     "serve_forever", "ColumnPlan", "ScoringEngine", "WorkerKilled",
     "ChaosChannel", "ChaosPlan", "ChaosPredictor", "ChaosQueue",
-    "ChaosSocket", "kill_process",
+    "ChaosSocket", "ChaosTransport", "kill_process",
+    "Backpressure", "ChecksumError", "FrameTooLarge", "HandshakeError",
+    "TransportClient", "TransportConfig", "TransportError",
+    "TransportServer", "parse_address",
     "BinaryFileReader", "read_binary_files",
     "PowerBIWriter",
 ]

@@ -61,12 +61,13 @@ import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 from .scoring import WorkerKilled
+from .transport import T_ACK as _T_ACK
 
 __all__ = [
     "ChaosBoostStep", "ChaosChannel", "ChaosControllerKill",
     "ChaosHeartbeat", "ChaosPlan", "ChaosPredictor", "ChaosQueue",
-    "ChaosSocket", "WorkerKilled", "corrupt_file", "kill_process",
-    "read_ckpt_boundary",
+    "ChaosSocket", "ChaosTransport", "WorkerKilled", "corrupt_file",
+    "kill_process", "read_ckpt_boundary",
 ]
 
 
@@ -269,6 +270,116 @@ class ChaosSocket:
     def recv(self, bufsize: int, *flags):
         if self._chan.fire(self._slow_rate):
             time.sleep(self._slow_s)
+        return self._sock.recv(bufsize, *flags)
+
+    def __getattr__(self, attr):
+        return getattr(self._sock, attr)
+
+
+class ChaosTransport:
+    """Frame-aware fault injection for :mod:`mmlspark_tpu.io.transport`
+    links — plug an instance factory into ``TransportConfig.socket_wrap``
+    (one wrapper per accepted/dialed socket) so the chaos drills
+    exercise the transport ITSELF, not just the app on top of it.
+
+    The transport writes exactly one frame per ``sendall``, which is
+    what makes frame-level injection possible from a socket wrapper:
+
+    * ``bitflip_rate`` — flip one byte at a deterministic offset past
+      the length prefix; the frame-wide CRC32C must catch it, the
+      receiver kills the poisoned link, and the session resume must
+      replay with zero loss and zero duplication.
+    * ``ack_drop_rate`` — silently swallow outbound ACK frames, so the
+      peer's replay buffer stays fat and a later resume replays frames
+      the receiver already delivered — the sequence-dedup path.
+    * ``kill_on_sends`` — exact send indices (1-based) that transmit
+      roughly HALF the frame and then hard-reset (``SO_LINGER 0`` →
+      RST): the seeded mid-frame link kill the resume contract is
+      verified against.
+    * ``reset_rate`` — per-send Bernoulli version of the same reset.
+    * ``half_open_after`` — after N sends this side goes silent
+      WITHOUT closing: writes are swallowed (reads still flow), which
+      is exactly what a peer's keepalive timeout must detect as a
+      half-open link.
+
+    Counters: ``bitflips`` / ``ack_drops`` / ``resets`` /
+    ``blackholed``.  Everything else delegates to the wrapped socket.
+    """
+
+    #: byte offset of the frame-type field (after the u32 length)
+    _TYPE_OFF = 4
+
+    def __init__(self, sock, plan: ChaosPlan, *,
+                 bitflip_rate: float = 0.0, ack_drop_rate: float = 0.0,
+                 reset_rate: float = 0.0,
+                 kill_on_sends: Iterable[int] = (),
+                 half_open_after: int = 0,
+                 name: str = "transport"):
+        self._sock = sock
+        self._bitflip_rate = float(bitflip_rate)
+        self._ack_drop_rate = float(ack_drop_rate)
+        self._reset_rate = float(reset_rate)
+        self._kill_on = frozenset(int(k) for k in kill_on_sends)
+        self._half_open_after = int(half_open_after)
+        self._chan = plan.channel(name)
+        self._lock = threading.Lock()
+        self.sends = 0
+        self.bitflips = 0
+        self.ack_drops = 0
+        self.resets = 0
+        self.blackholed = 0
+
+    def _reset(self):
+        import socket as _socket
+        self.resets += 1
+        try:
+            self._sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                                  struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        raise ConnectionResetError("chaos: injected transport reset")
+
+    def sendall(self, data: bytes):
+        with self._lock:
+            self.sends += 1
+            n = self.sends
+        if self._half_open_after and n > self._half_open_after:
+            # half-open: swallow silently, keep the socket "alive"
+            self.blackholed += 1
+            return None
+        if n in self._kill_on:
+            # mid-frame kill: the peer reads a torn frame, then RST
+            try:
+                self._sock.sendall(data[:max(1, len(data) // 2)])
+            except OSError:
+                pass
+            self._reset()
+        if self._chan.fire(self._reset_rate):
+            self._reset()
+        if (self._ack_drop_rate > 0 and len(data) > self._TYPE_OFF
+                and data[self._TYPE_OFF] == _T_ACK
+                and self._chan.fire(self._ack_drop_rate)):
+            self.ack_drops += 1
+            return None
+        if self._chan.fire(self._bitflip_rate) and len(data) > 5:
+            off = int(self._chan.uniform(self._TYPE_OFF,
+                                         len(data) - 1))
+            off = min(max(off, self._TYPE_OFF), len(data) - 1)
+            self.bitflips += 1
+            data = (data[:off] + bytes([data[off] ^ 0x40])
+                    + data[off + 1:])
+        return self._sock.sendall(data)
+
+    def recv(self, bufsize: int, *flags):
+        if self._half_open_after and self.sends > self._half_open_after:
+            # the silent side also stops answering reads it would have
+            # served — but must NOT close (that would be a clean FIN,
+            # not a half-open link)
+            time.sleep(0.05)
         return self._sock.recv(bufsize, *flags)
 
     def __getattr__(self, attr):

@@ -18,7 +18,7 @@ instead of per-request forwards.
 
 from __future__ import annotations
 
-import hmac
+
 import json
 import logging
 import queue
@@ -34,6 +34,8 @@ from ..core.profiling import StageStats
 from ..core.schema import DataTable
 from ..core.telemetry import (get_registry, merge_snapshots,
                               render_prometheus)
+from .transport import (CH_CONTROL, CH_METRICS, CH_SCORING, CH_STATS,
+                        parse_address)
 
 log = logging.getLogger(__name__)
 
@@ -468,22 +470,23 @@ def join_exchange(exchange: str, worker_id: int,
     entrypoint (each machine runs this next to its accelerator; the
     reference's per-executor DistributedHTTPSource server,
     SURVEY.md §3.4).  Blocks until the exchange sends ``stop`` or the
-    connection drops beyond repair: a dropped exchange link is re-dialed
-    with bounded exponential backoff (``reconnect_tries`` attempts,
-    delays clamped to ``reconnect_backoff=(base, cap)`` seconds) and the
-    worker's still-parked requests are re-queued onto the restored
-    exchange, so an exchange blip does not kill the in-flight requests
-    this worker holds sockets for.  ``exchange`` is the driver's
-    ``MultiprocessHTTPServer(spawn_workers=False).exchange_address``;
+    transport session drops beyond repair: the exchange link is an
+    :mod:`mmlspark_tpu.io.transport` resumable session, so a link blip
+    is re-dialed with bounded, jittered exponential backoff
+    (``reconnect_tries`` attempts, delays from
+    ``reconnect_backoff=(base, cap)`` seconds), unacked frames are
+    replayed, and this worker's still-parked requests survive.
+    ``exchange`` is the driver's
+    ``MultiprocessHTTPServer(spawn_workers=False).exchange_address``
+    (``host:port``, or ``[v6]:port`` for IPv6 — validated up front with
+    a clear error instead of failing deep in ``create_connection``);
     ``worker_id`` must be the unique slot index in [0, num_workers);
     ``token`` is the driver's ``MultiprocessHTTPServer.token`` shared
-    secret — the exchange drops any connection that does not present it
-    (the worker-id/duplicate checks guard mistakes; the token guards
-    adversaries).  The exchange port should additionally be firewalled
-    to cluster hosts — the token authenticates joiners, it does not
-    encrypt the line protocol."""
-    host, _, port = exchange.rpartition(":")
-    _mp_worker_main(host, int(port), int(worker_id), http_host, api_path,
+    secret, checked by the transport handshake.  Security posture
+    (what the token does and does NOT protect): docs/transport.md
+    §Security."""
+    host, port = parse_address(exchange)
+    _mp_worker_main(host, port, int(worker_id), http_host, api_path,
                     reply_timeout, token, request_read_timeout,
                     reconnect_tries, reconnect_backoff)
 
@@ -498,30 +501,32 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     """Worker-process entrypoint (module-level for spawn-pickling).
 
     Owns REAL client sockets in its own process: parks each HTTP request
-    locally, forwards (rid, payload) to the driver over one TCP line
-    stream, and delivers driver replies to the parked socket.  Delivery
-    is decided ATOMICALLY here (the process that holds the socket), and
-    reported back as an ack — that keeps ``reply()``'s delivered/
+    locally, forwards (rid, payload) to the driver over ONE
+    :class:`~mmlspark_tpu.io.transport.TransportClient` session, and
+    delivers driver replies to the parked socket.  Delivery is decided
+    ATOMICALLY here (the process that holds the socket), and reported
+    back as an app-level ack — that keeps ``reply()``'s delivered/
     undelivered contract exact across process boundaries, matching the
     reference where HTTPSink's reply lands on whichever executor parked
-    the socket (expected path io/http/DistributedHTTPSource.scala,
-    UNVERIFIED; SURVEY.md §3.4).
+    the socket (SURVEY.md §3.4).
 
-    Resilience: the exchange link is held in a mutable slot; when the
-    read pump sees the link die it reconnects with bounded backoff,
-    re-hellos, and re-parks every request still pending here (the
-    requeue half of the executor-loss story — the driver purged those
-    routes when the old link died, so without the re-park the parked
-    clients could only ever time out).  ``/healthz`` reports process
-    liveness; ``/readyz`` reports whether the exchange link is up.
+    Resilience now lives in the transport: a link blip reconnects with
+    bounded, jittered backoff, resumes the session and replays unacked
+    frames in both directions — no park or reply is lost to the blip
+    and none is duplicated (sequence dedup).  On every (re)connect the
+    worker re-hellos and re-parks its still-pending requests: a no-op
+    on a clean resume (the driver's ``put_unique`` dedups), and exactly
+    the rebuild required after a session RESET (driver restarted or
+    resume grace expired).  ``/healthz`` reports process liveness;
+    ``/readyz`` reports whether the exchange session is up.
     """
-    import socket as _socket
+    from .transport import TransportClient, TransportConfig
 
     # "engine_ready" mirrors the driver's ready beacon (None until the
     # first beacon arrives — treated as ready so a beacon-less driver
     # degrades to link-up readiness, the pre-beacon contract)
-    link: Dict[str, Any] = {"conn": None, "engine_ready": None}
-    wlock = threading.Lock()
+    link: Dict[str, Any] = {"engine_ready": None}
+    stop_evt = threading.Event()
     pending: Dict[str, _Pending] = {}
     payloads: Dict[str, Any] = {}   # rid -> payload, kept for re-park
     plock = threading.Lock()
@@ -536,47 +541,99 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     # rendered exposition text
     mwaiters: Dict[str, _Pending] = {}
 
-    def connect():
-        c = _socket.create_connection((driver_host, driver_port))
-        # the exchange is a request/reply line protocol: without
-        # TCP_NODELAY, Nagle + delayed-ACK quantizes replies at ~40 ms
-        c.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-        return c
+    def on_message(session, channel, msg, deadline_ms):
+        op = msg.get("op")
+        if channel == CH_CONTROL:
+            if op == "stop":
+                stop_evt.set()
+            elif op == "ready":
+                # driver readiness beacon → worker /readyz truth
+                link["engine_ready"] = bool(msg.get("value"))
+        elif channel == CH_SCORING and op == "reply":
+            rid = msg["rid"]
+            with plock:
+                p = pending.get(rid)
+                if p is not None:
+                    p.response = msg["response"]
+                    p.status = msg.get("status", 200)
+                    p.event.set()
+            if p is not None:
+                wstats.incr("replied")
+            try:
+                # short timeout: this runs ON the read pump — blocking
+                # on credits here would also block the inbound CREDIT
+                # frames that could unblock it.  A dropped ack degrades
+                # to reply() reporting undelivered, which is bounded.
+                client.send(CH_SCORING, {"op": "ack", "rid": rid,
+                                         "delivered": p is not None},
+                            timeout=2.0)
+            except OSError:
+                pass
+        elif channel == CH_METRICS and op == "metrics_txt":
+            # driver's answer to a /metrics scrape round-trip
+            with plock:
+                mw = mwaiters.pop(msg.get("req"), None)
+            if mw is not None:
+                mw.response = msg.get("text")
+                mw.event.set()
 
-    def send(obj):
-        data = (json.dumps(obj) + "\n").encode("utf-8")
-        with wlock:
-            c = link["conn"]
-            if c is None:
-                raise OSError("exchange link down")
-            c.sendall(data)
+    adv = {"host": ""}
 
-    link["conn"] = connect()
+    def on_connect(resumed):
+        # app hello on EVERY (re)connect: the driver keys the slot on
+        # the session, so a duplicate hello is idempotent — and after a
+        # session reset it is the required re-introduction.  Then
+        # re-park everything still waiting here: ``put_unique`` on the
+        # driver dedups rids already queued, the route-restore half is
+        # what un-strands requests whose reply failed during the blip.
+        try:
+            if adv["host"] in ("0.0.0.0", "", "::"):
+                # a wildcard bind must not advertise 0.0.0.0: report
+                # the interface this worker reaches the exchange
+                # through (multi-host dial-ability contract)
+                sock = client.session._sock
+                if sock is not None:
+                    adv["host"] = sock.getsockname()[0]
+            client.send(CH_CONTROL, {
+                "op": "hello", "worker": worker_id,
+                "host": adv["host"], "port": httpd.server_address[1]})
+            with plock:
+                requeue = [(r, payloads[r]) for r in pending
+                           if r in payloads]
+            for rid, payload in requeue:
+                client.send(CH_SCORING, {"op": "park", "rid": rid,
+                                         "payload": payload})
+        except OSError:
+            pass   # link died instantly — the next reconnect retries
 
     class Handler(_ServingHandler):
         timeout = request_read_timeout   # slow-client read deadline
 
         def _ready(self):
-            # link up AND the driver's engine (if it beacons readiness
-            # over the exchange) has not declared itself down
-            return (link["conn"] is not None
+            # session up AND the driver's engine (if it beacons
+            # readiness over the exchange) has not declared itself down
+            return (client.connected
                     and link["engine_ready"] is not False)
 
         def _metrics(self):
             # the engine (and its StageStats) lives in the DRIVER
             # process — a scrape of this worker asks the driver for the
-            # whole-topology exposition over the exchange link, carrying
-            # this worker's local stats along so the driver's view is
-            # fresh.  Link down / driver silent -> degrade to a
+            # whole-topology exposition over the exchange session,
+            # carrying this worker's local stats along so the driver's
+            # view is fresh.  Link down / driver silent -> degrade to a
             # worker-local render rather than a 503 (a half-scrape
             # beats none during an exchange blip).
+            if not client.connected:
+                return _local_metrics()
             nonce = uuid.uuid4().hex
             waiter = _Pending()
             with plock:
                 mwaiters[nonce] = waiter
             try:
-                send({"op": "metrics_req", "req": nonce,
-                      "stats": wstats.snapshot()})
+                client.send(CH_METRICS,
+                            {"op": "metrics_req", "req": nonce,
+                             "stats": wstats.snapshot()},
+                            deadline_ms=5000)
             except OSError:
                 with plock:
                     mwaiters.pop(nonce, None)
@@ -604,12 +661,20 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                 pending[rid] = p
                 payloads[rid] = payload
             wstats.incr("parked")
+            # deadline propagation: a client-declared budget rides the
+            # frame header so the driver can 504 dead work unscored
+            dl = payload.get("_deadline_ms") \
+                if isinstance(payload, dict) else None
             try:
-                send({"op": "park", "rid": rid, "payload": payload})
+                client.send(CH_SCORING,
+                            {"op": "park", "rid": rid,
+                             "payload": payload},
+                            deadline_ms=dl if isinstance(
+                                dl, (int, float)) and dl > 0 else None)
             except OSError:
-                # link down RIGHT NOW: stay parked — the reconnect pump
-                # re-parks everything in ``pending`` once the link is
-                # back, and the wait below bounds the client's exposure
+                # session closed for good; the wait below bounds the
+                # client's exposure (a mere blip queues the frame for
+                # replay instead of landing here)
                 pass
             ok = p.event.wait(reply_timeout)
             with plock:
@@ -621,9 +686,10 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             delivered = p2 is not None and p2.event.is_set()
             if not delivered and not ok:
                 try:
-                    send({"op": "expire", "rid": rid})
+                    client.send(CH_SCORING, {"op": "expire",
+                                             "rid": rid})
                 except OSError:
-                    pass   # link down — driver purged the route anyway
+                    pass   # session gone — the route dies with it
                 self.send_error(504, "pipeline timeout")
                 return
             body = json.dumps(p.response).encode("utf-8")
@@ -640,117 +706,46 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                 + "# driver unreachable: worker-local metrics only\n")
 
     httpd = _QuietThreadingHTTPServer((http_host, 0), Handler)
-    # a wildcard bind must not advertise 0.0.0.0: report the interface
-    # this worker reaches the exchange through — the address a client on
-    # another machine can actually dial (multi-host contract)
-    adv_host = httpd.server_address[0]
-    if adv_host in ("0.0.0.0", "", "::"):
-        adv_host = link["conn"].getsockname()[0]
-
-    def hello():
-        send({"op": "hello", "worker": worker_id, "token": token,
-              "host": adv_host, "port": httpd.server_address[1]})
-
-    hello()
+    adv["host"] = httpd.server_address[0]
+    base, cap = reconnect_backoff
+    client = TransportClient(
+        (driver_host, driver_port), token=token,
+        cfg=TransportConfig(reconnect_tries=reconnect_tries,
+                            reconnect_backoff=(base, cap)),
+        on_message=on_message, on_connect=on_connect,
+        on_down=lambda: stop_evt.set(),   # budget exhausted: shut down
+        name=f"exchange-worker{worker_id}")
+    try:
+        client.connect()
+    except OSError:
+        httpd.server_close()
+        raise
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
     def stats_beacon():
         # periodic worker-stats report: keeps the driver's per-worker
         # blocks fresh so a scrape against ANY server (or the driver's
         # own render_metrics()) sees every worker, not just the one
-        # being scraped.  Best-effort: a down link skips the tick.
-        while not beacon_stop.wait(1.0):
+        # being scraped.  Best-effort, and only while the session is
+        # up — beacons must not burn replay credits during an outage.
+        while not stop_evt.wait(1.0):
             wstats.set_gauge("exchange_link_up",
-                             1.0 if link["conn"] is not None else 0.0)
+                             1.0 if client.connected else 0.0)
+            if not client.connected:
+                continue
             try:
-                send({"op": "stats", "snapshot": wstats.snapshot()})
+                client.send(CH_STATS, {"op": "stats",
+                                       "snapshot": wstats.snapshot()})
             except OSError:
                 pass
 
-    beacon_stop = threading.Event()
     threading.Thread(target=stats_beacon, name="worker-stats-beacon",
                      daemon=True).start()
 
-    base, cap = reconnect_backoff
-    stopped = False
-    while not stopped:
-        rfile = link["conn"].makefile("r", encoding="utf-8")
-        try:
-            for line in rfile:
-                msg = json.loads(line)
-                if msg["op"] == "stop":
-                    stopped = True
-                    break
-                if msg["op"] == "ready":
-                    # driver readiness beacon → worker /readyz truth
-                    link["engine_ready"] = bool(msg.get("value"))
-                    continue
-                if msg["op"] == "metrics_txt":
-                    # driver's answer to a /metrics scrape round-trip
-                    with plock:
-                        mw = mwaiters.pop(msg.get("req"), None)
-                    if mw is not None:
-                        mw.response = msg.get("text")
-                        mw.event.set()
-                    continue
-                if msg["op"] == "reply":
-                    rid = msg["rid"]
-                    with plock:
-                        p = pending.get(rid)
-                        if p is not None:
-                            p.response = msg["response"]
-                            p.status = msg.get("status", 200)
-                            p.event.set()
-                    if p is not None:
-                        wstats.incr("replied")
-                    send({"op": "ack", "rid": rid,
-                          "delivered": p is not None})
-        except (OSError, ValueError):
-            pass   # link died mid-line — fall through to reconnect
-        if stopped:
-            break
-        # link dropped: mark down (readyz flips, new parks queue up
-        # locally), then bounded-backoff reconnect
-        with wlock:
-            old, link["conn"] = link["conn"], None
-        try:
-            old.close()   # actively notify the driver's reader
-        except OSError:
-            pass
-        newc = None
-        for attempt in range(max(0, int(reconnect_tries))):
-            time.sleep(min(base * (2 ** attempt), cap))
-            try:
-                newc = connect()
-                break
-            except OSError:
-                continue
-        if newc is None:
-            break   # reconnect budget exhausted: shut down
-        with wlock:
-            link["conn"] = newc
-        try:
-            hello()
-            # REQUEUE: re-park every request still waiting here — the
-            # driver purged this worker's routes when the old link
-            # died, so these rids are unknown to it until re-parked
-            with plock:
-                requeue = [(r, payloads[r]) for r in pending
-                           if r in payloads]
-            for rid, payload in requeue:
-                send({"op": "park", "rid": rid, "payload": payload})
-        except OSError:
-            continue   # new link died instantly — loop re-enters
-    beacon_stop.set()
+    stop_evt.wait()
     httpd.shutdown()
     httpd.server_close()
-    with wlock:
-        c, link["conn"] = link["conn"], None
-    if c is not None:
-        try:
-            c.close()
-        except OSError:
-            pass
+    client.close()
 
 
 class MultiprocessHTTPServer:
@@ -770,29 +765,37 @@ class MultiprocessHTTPServer:
     workers can reach the exchange; ``exchange_address`` is the
     ``host:port`` to hand them, along with the ``token`` shared secret
     each ``join_exchange`` must present (auto-generated unless given).
-    The exchange rejects any connection whose first message is not a
-    correctly-tokened hello; still firewall the exchange port to
-    cluster hosts — the token authenticates joiners, the line protocol
-    itself is plaintext.
+
+    The exchange runs on :mod:`mmlspark_tpu.io.transport` — ONE framed,
+    CRC-checked, flow-controlled, resumable transport multiplexing the
+    scoring channel (park/reply/expire/ack), the worker stats beacons,
+    the ``/metrics`` scrape round-trips and session control.  The
+    transport handshake enforces the token before any state is touched
+    (non-protocol and wrong-token peers are dropped at the preamble;
+    security posture: docs/transport.md §Security).
 
     Failure handling (the reference's executor-loss story applied to
-    serving): a dead worker link is detected by its reader thread,
-    which purges the worker's routes (so replies report undelivered
-    immediately instead of hanging), releases its ack waiters, and
-    REOPENS its worker slot — the exchange keeps accepting after
-    ``start()``, so a respawned or reconnecting worker re-hellos into
-    the freed slot.  With ``supervise_workers=True`` (spawned topology)
-    a dead worker PROCESS is respawned automatically; its parked client
+    serving): a link BLIP is invisible above the transport — the worker
+    reconnects with jittered backoff, the session resumes, and unacked
+    frames replay with sequence dedup (no lost, no duplicated
+    messages).  A session that dies for good (worker crash, resume
+    grace expired, respawn takeover) purges the worker's reply routes
+    (so replies report undelivered immediately instead of hanging),
+    releases its ack waiters, and reopens its worker slot for a fresh
+    hello.  With ``supervise_workers=True`` (spawned topology) a dead
+    worker PROCESS is respawned automatically; its parked client
     sockets died with it (those clients see a reset and retry), but
     capacity and readiness recover without operator action.
     ``self.counters`` tracks ``worker_deaths`` / ``worker_respawns``.
 
     Every timeout is constructor-level config so drills and tests can
     tighten them: ``request_read_timeout`` (worker HTTP slow-client
-    deadline), ``preauth_timeout`` (exchange reader pre-auth),
+    deadline), ``preauth_timeout`` (transport handshake deadline),
     ``ack_grace`` (reply-ack wait beyond ``reply_timeout``),
-    ``reconnect_tries``/``reconnect_backoff`` (worker link re-dial),
-    ``sweep_grace`` (orphaned route/pending sweep slack).
+    ``reconnect_tries``/``reconnect_backoff`` (worker session re-dial),
+    ``sweep_grace`` (orphaned route sweep slack), and
+    ``transport_config`` (frame/flow/keepalive/resume knobs, including
+    the chaos ``socket_wrap`` hook).
     """
 
     _SWEEP_EVERY = 512
@@ -807,24 +810,32 @@ class MultiprocessHTTPServer:
                  reconnect_tries: int = 5,
                  reconnect_backoff: Tuple[float, float] = (0.1, 2.0),
                  supervise_workers: bool = True,
-                 sweep_grace: float = 10.0):
+                 sweep_grace: float = 10.0,
+                 transport_config: Optional[Any] = None):
+        import dataclasses
         import secrets
-        import socket as _socket
+
+        from .transport import TransportConfig, TransportServer
 
         self.token = secrets.token_hex(16) if token is None else token
-        self._listener = _socket.socket()
-        self._listener.bind((host, 0))
-        self._listener.listen(num_workers)
+        tcfg = transport_config or TransportConfig()
+        # exchange-level timeouts override the transport defaults so
+        # ONE knob set governs the whole topology
+        tcfg = dataclasses.replace(
+            tcfg, preauth_timeout_s=preauth_timeout,
+            reconnect_tries=reconnect_tries,
+            reconnect_backoff=reconnect_backoff)
+        self._ts = TransportServer(
+            host, 0, token=self.token, cfg=tcfg,
+            on_message=self._on_transport_msg,
+            on_session_lost=self._on_session_lost, name="exchange")
         self.queue: _TrackedQueue = _TrackedQueue()
-        # rid -> (worker conn index, monotonic park time); the stamp
-        # bounds how long an orphaned route can leak (see _sweep_routes)
-        self._route: Dict[str, Tuple[int, float]] = {}
-        self._acks: Dict[str, Tuple[_Pending, int]] = {}  # rid -> waiter
+        # rid -> (session id, monotonic park time); the stamp bounds
+        # how long an orphaned route can leak (see _sweep_routes)
+        self._route: Dict[str, Tuple[str, float]] = {}
+        self._acks: Dict[str, Tuple[_Pending, str]] = {}  # rid -> waiter
         self._lock = threading.Lock()
-        self._conns: List[Any] = []
-        self._wlocks: List[threading.Lock] = []
-        self._free_slots: List[int] = []   # reusable dead _conns slots
-        self._conn_worker: Dict[int, int] = {}  # conn idx -> worker slot
+        self._slot_sid: Dict[int, str] = {}   # worker slot -> session id
         self.addresses: List[str] = [""] * num_workers
         self.counters = {"worker_deaths": 0, "worker_respawns": 0}
         # telemetry: the exchange's own StageStats mirror of `counters`
@@ -852,7 +863,6 @@ class MultiprocessHTTPServer:
         self._host = host
         self._api_path = api_path
         self._closing = threading.Event()
-        self._accept_thread: Optional[threading.Thread] = None
         self._proc_supervisor: Optional[threading.Thread] = None
         self._ready_beacon: Optional[threading.Thread] = None
 
@@ -865,7 +875,7 @@ class MultiprocessHTTPServer:
     def _make_proc(self, worker_id: int):
         import multiprocessing as mp
         ctx = mp.get_context("spawn")  # no inherited jax/thread state
-        dh, dp = self._listener.getsockname()
+        dh, dp = self._ts.address
         return ctx.Process(
             target=_mp_worker_main,
             args=(dh, dp, worker_id, self._host, self._api_path,
@@ -881,7 +891,7 @@ class MultiprocessHTTPServer:
         interface, not ``0.0.0.0`` — the same dial-ability rule the
         workers follow for their own hello addresses."""
         import socket as _socket
-        h, p = self._listener.getsockname()
+        h, p = self._ts.address
         if h in ("0.0.0.0", "", "::"):
             probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
             try:
@@ -900,44 +910,27 @@ class MultiprocessHTTPServer:
     def start(self) -> "MultiprocessHTTPServer":
         for p in self._procs:
             p.start()
-        import socket as _socket
         import time
-        # Accept until every worker slot has said a (tokened) hello or
-        # the budget runs out — NOT exactly num_workers connections: a
-        # rejected or garbage peer must not consume a slot's accept and
-        # lock the legit worker out (a single adversarial connect would
-        # otherwise be a join DoS).  Budgets: 60 s for spawned workers
-        # (a loaded single-core host can take >20 s just to spawn and
-        # import N interpreters), join_timeout for external ones.
+        # The transport server authenticates and pumps every
+        # connection; this loop only waits for the APP-LEVEL hellos
+        # that fill the worker slots.  Garbage, wrong-token and
+        # invalid-id peers never consume a slot (the handshake drops
+        # them before any exchange state exists).  Budgets: 60 s for
+        # spawned workers (a loaded single-core host can take >20 s
+        # just to spawn and import N interpreters), join_timeout for
+        # external ones.
+        self._ts.start()
         budget = 60.0 if self._procs else self._join_timeout
         deadline = time.monotonic() + budget
-        self._listener.settimeout(0.2)
-        got_conn = False
         while (any(not a for a in self.addresses)
                and time.monotonic() < deadline):
-            try:
-                conn, _ = self._listener.accept()
-            except (TimeoutError, OSError):
-                continue
-            got_conn = True
-            conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-            # NOT registered yet: the reader claims a _conns/_wlocks slot
-            # only after a correctly-tokened hello, so rejected or
-            # garbage peers never occupy exchange state (ADVICE r5)
-            threading.Thread(target=self._reader, args=(conn,),
-                             daemon=True).start()
-        # hellos are parsed asynchronously by reader threads — a worker
-        # whose connection landed just before the deadline may not have
-        # its address recorded yet; grace-drain before declaring failure
-        grace = time.monotonic() + 2.0
-        while (any(not a for a in self.addresses)
-               and time.monotonic() < grace):
             time.sleep(0.05)
         if any(not a for a in self.addresses):
             missing = [i for i, a in enumerate(self.addresses) if not a]
             xaddr = self.exchange_address  # before stop() closes it
+            saw_peer = bool(self._ts.sessions)
             self.stop()
-            if self._procs and not got_conn:
+            if self._procs and not saw_peer:
                 raise RuntimeError(
                     "worker processes failed to connect; if this is "
                     "a script, MultiprocessHTTPServer must be "
@@ -950,12 +943,6 @@ class MultiprocessHTTPServer:
                 f"server's .token (invalid ids and missing or wrong "
                 f"tokens are dropped and land here; a duplicate id "
                 f"takes over its slot)")
-        # keep accepting AFTER the initial join: a worker that dies (or
-        # whose link drops) re-hellos into its freed slot — without this
-        # the topology could never heal (ISSUE 3)
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="exchange-accept", daemon=True)
-        self._accept_thread.start()
         if self._procs and self._supervise_workers:
             self._proc_supervisor = threading.Thread(
                 target=self._supervise_procs, name="worker-supervisor",
@@ -998,31 +985,24 @@ class MultiprocessHTTPServer:
                 r = bool(check())
             except Exception:  # noqa: BLE001
                 r = False
-            with self._lock:
-                idxs = list(self._conn_worker)
-            for i in idxs:
+            for session in self._worker_sessions():
                 try:
-                    self._send(i, {"op": "ready", "value": r})
-                except (OSError, IndexError):
-                    pass   # dying link: its reader handles the purge
+                    session.send(CH_CONTROL,
+                                 {"op": "ready", "value": r},
+                                 timeout=0.5)
+                except OSError:
+                    pass   # dying link: the transport handles it
 
-    def _accept_loop(self) -> None:
-        """Post-start accept pump: rejoining/respawned workers (and any
-        garbage peers — the reader auth handles those) keep landing
-        after the initial join window closes."""
-        import socket as _socket
-        while not self._closing.is_set():
-            try:
-                conn, _ = self._listener.accept()
-            except (TimeoutError, OSError):
-                continue   # 0.2 s listener timeout, or closing
-            try:
-                conn.setsockopt(_socket.IPPROTO_TCP,
-                                _socket.TCP_NODELAY, 1)
-            except OSError:
-                continue
-            threading.Thread(target=self._reader, args=(conn,),
-                             daemon=True).start()
+    def _worker_sessions(self) -> List[Any]:
+        """Connected sessions currently holding a worker slot."""
+        with self._lock:
+            sids = list(self._slot_sid.values())
+        out = []
+        for sid in sids:
+            s = self._ts.sessions.get(sid)
+            if s is not None and s.connected:
+                out.append(s)
+        return out
 
     def _supervise_procs(self) -> None:
         """Spawned-worker supervision: a dead worker PROCESS is
@@ -1042,212 +1022,37 @@ class MultiprocessHTTPServer:
                 self._procs[i] = newp
                 newp.start()
 
-    def _reader(self, conn) -> None:
-        # pre-auth read timeout: a silent non-protocol peer must not
-        # park a reader thread on the exchange forever
-        conn.settimeout(self._preauth_timeout)
-        rfile = conn.makefile("r", encoding="utf-8")
-        # registration is reported through a mutable slot so a socket
-        # error AFTER auth (worker crash mid-read) still reaches the
-        # purge below with the registered index
-        reg = [-1]   # _conns slot; claimed only after a tokened hello
-        try:
-            self._reader_loop(conn, rfile, reg)
-        except OSError:
-            pass   # pre-auth timeout, or peer reset mid-stream
-        except Exception:  # noqa: BLE001
-            # Anything else — UnicodeDecodeError from the utf-8
-            # makefile (binary/TLS peer), KeyError from a version-
-            # skewed worker's malformed park/hello — must not kill the
-            # reader with an unhandled traceback: the purge below is
-            # what unblocks reply() waiters for this worker's rids.
-            log.exception("serving: exchange reader failed; dropping "
-                          "connection")
-        idx = reg[0]
-        if idx < 0:
-            # never authed: nothing was registered for this conn, so
-            # there is no exchange state to purge — just drop it
-            try:
-                conn.close()
-            except OSError:
-                pass
-            return
-        # worker gone (crash/kill/link drop): purge its routes so
-        # replies report undelivered immediately, release any reply()
-        # calls waiting on acks FROM THIS WORKER (acks carry the worker
-        # index — routes and acks are disjoint because reply() pops the
-        # route before registering the ack), and REOPEN its worker slot
-        # so a respawned or reconnecting worker can hello back in — the
-        # surviving workers keep serving (the reference's executor loss
-        # story, SURVEY.md §5.3 applied to serving).  Requests from
-        # this worker still in ``self.queue`` score normally; their
-        # replies find no route and report undelivered (a killed
-        # worker's client sockets died with it — if the worker is alive
-        # and merely reconnecting, it re-parks them itself).
-        with self._lock:
-            for r in [r for r, (i, _) in self._route.items()
-                      if i == idx]:
-                self._route.pop(r, None)
-            dead_acks = [r for r, (_, i) in self._acks.items()
-                         if i == idx]
-            for r in dead_acks:
-                waiter, _ = self._acks.pop(r)
-                waiter.response = False
-                waiter.event.set()
-            w = self._conn_worker.pop(idx, None)
-            if w is not None and 0 <= w < len(self.addresses):
-                self.addresses[w] = ""   # slot freed for rejoin
-            if w is not None:
-                # only a conn that actually HELD a worker slot counts
-                # as a worker death — an authed peer with an invalid/
-                # superseded hello never represented capacity (a
-                # takeover's stale link lands here too: its slot entry
-                # was already moved to the new conn, so no death)
-                self.counters["worker_deaths"] += 1
-                self.stats.incr("worker_deaths")
-        # close the link so a still-alive (but protocol-broken) worker
-        # notices, and later _send()s fail fast instead of queueing
-        try:
-            conn.close()
-        except OSError:
-            pass
-        # free the slot for reuse LAST — only after every reference to
-        # idx above has been purged
-        with self._lock:
-            if 0 <= idx < len(self._conns) \
-                    and self._conns[idx] is conn:
-                self._conns[idx] = None
-                self._free_slots.append(idx)
-
-    def _reader_loop(self, conn, rfile, reg: List[int]) -> None:
-        """Line-protocol pump for one exchange connection.  Writes the
-        registered ``_conns`` index into ``reg[0]`` at auth time (stays
-        -1 when the peer is dropped before authenticating — nothing
-        registered)."""
-        idx = -1
-        for line in rfile:
-            try:
-                msg = json.loads(line)
-            except ValueError:
-                if idx < 0:
-                    # garbage before auth: a non-protocol peer must not
-                    # stay parked on the exchange
-                    try:
-                        conn.close()
-                    except OSError:
-                        pass
-                    return
-                continue
-            op = msg.get("op")
-            if idx < 0:
-                # first message MUST be a correctly-tokened hello: an
-                # unauthenticated peer never gets to claim a worker slot
-                # or route client traffic (ADVICE r4)
-                if op != "hello" or not hmac.compare_digest(
-                        str(msg.get("token", "")).encode("utf-8"),
-                        self.token.encode("utf-8")):
-                    log.warning("serving: dropping unauthenticated "
-                                "exchange connection (bad or missing "
-                                "token)")
-                    try:
-                        conn.close()
-                    except OSError:
-                        pass
-                    return  # nothing registered — no purge
-                # authed: only now claim exchange state (ADVICE r5 — a
-                # dropped peer must never consume a _conns slot).  Dead
-                # slots are reused so worker flapping cannot grow the
-                # conn table without bound.
-                conn.settimeout(None)
+    def _on_transport_msg(self, session, channel: int, msg: dict,
+                          deadline_ms) -> None:
+        """App-protocol dispatch for one authenticated exchange
+        session.  The transport already enforced magic/version/token,
+        framing, CRC and sequencing — by the time a message lands here
+        it is a well-formed JSON object from a tokened peer."""
+        op = msg.get("op")
+        if channel == CH_CONTROL and op == "hello":
+            self._on_worker_hello(session, msg)
+        elif channel == CH_SCORING:
+            if op == "park":
+                rid, payload = msg["rid"], msg["payload"]
+                # deadline propagation: a frame-header deadline becomes
+                # the engine's per-request budget unless the payload
+                # already carries an explicit one
+                if (deadline_ms and isinstance(payload, dict)
+                        and "_deadline_ms" not in payload):
+                    payload["_deadline_ms"] = deadline_ms
                 with self._lock:
-                    if self._free_slots:
-                        idx = self._free_slots.pop()
-                        self._conns[idx] = conn
-                    else:
-                        idx = len(self._conns)
-                        self._conns.append(conn)
-                        self._wlocks.append(threading.Lock())
-                reg[0] = idx
-            if op == "hello":
-                w = msg.get("worker")
-                if (not isinstance(w, int) or not
-                        0 <= w < len(self.addresses)):
-                    log.warning("serving: ignoring hello with invalid "
-                                "worker id %r (need 0..%d)", w,
-                                len(self.addresses) - 1)
-                    continue
-                # newest-wins slot claim: a tokened hello for an
-                # occupied slot means the worker reconnected before the
-                # old link's death was detected (asymmetric partition —
-                # ISSUE 3 review finding).  Take the slot over and
-                # close the stale link; dropping its _conn_worker entry
-                # FIRST means the stale reader's purge cannot wipe the
-                # live worker's address.  (Two genuinely distinct
-                # workers sharing an id will flap here — that operator
-                # error is loudly logged either way.)
-                stale = None
-                with self._lock:
-                    old_idx = next(
-                        (i for i, ww in self._conn_worker.items()
-                         if ww == w), None)
-                    if old_idx is not None and old_idx != idx:
-                        log.warning(
-                            "serving: worker slot %d re-helloed on a "
-                            "new connection; replacing the stale link",
-                            w)
-                        self._conn_worker.pop(old_idx, None)
-                        stale = self._conns[old_idx]
-                    self._conn_worker[idx] = w
-                self.addresses[w] = f"http://{msg['host']}:{msg['port']}"
-                if stale is not None:
-                    try:
-                        stale.close()   # force the old reader's purge
-                    except OSError:
-                        pass
-            elif op == "park":
-                with self._lock:
-                    self._route[msg["rid"]] = (idx, time.monotonic())
+                    self._route[rid] = (session.sid, time.monotonic())
                     self._parks += 1
                     if self._parks % self._SWEEP_EVERY == 0:
                         self._sweep_routes_locked()
                 # put_unique: a reconnect re-park whose first copy is
                 # still queued only restores the route (above) — it
                 # must not enqueue a second copy to be scored twice
-                self.queue.put_unique((msg["rid"], msg["payload"],
+                self.queue.put_unique((rid, payload,
                                        time.perf_counter()))
             elif op == "expire":
                 with self._lock:
                     self._route.pop(msg["rid"], None)
-            elif op == "stats":
-                # periodic worker-stats beacon: keep the last-known
-                # snapshot per WORKER SLOT (not conn index) so the
-                # whole-topology exposition names stable workers
-                with self._lock:
-                    w = self._conn_worker.get(idx)
-                    if w is not None and isinstance(msg.get("snapshot"),
-                                                    dict):
-                        self.worker_stats[w] = msg["snapshot"]
-            elif op == "metrics_req":
-                # a /metrics scrape hit this worker: fold its
-                # piggybacked stats in, render the WHOLE topology
-                # (driver registry + every worker's last report +
-                # aggregated totals), and answer the round-trip
-                with self._lock:
-                    w = self._conn_worker.get(idx)
-                    if w is not None and isinstance(msg.get("stats"),
-                                                    dict):
-                        self.worker_stats[w] = msg["stats"]
-                try:
-                    text = self.render_metrics()
-                except Exception:  # noqa: BLE001 - scrape must degrade
-                    log.exception("serving: metrics render failed")
-                    text = "# metrics render failed\n"
-                try:
-                    self._send(idx, {"op": "metrics_txt",
-                                     "req": msg.get("req"),
-                                     "text": text})
-                except (OSError, IndexError):
-                    pass   # dying link: its reader handles the purge
             elif op == "ack":
                 with self._lock:
                     entry = self._acks.pop(msg["rid"], None)
@@ -1255,14 +1060,110 @@ class MultiprocessHTTPServer:
                     waiter = entry[0]
                     waiter.response = msg["delivered"]
                     waiter.event.set()
+        elif channel == CH_STATS and op == "stats":
+            # periodic worker-stats beacon: keep the last-known
+            # snapshot per WORKER SLOT (not session) so the
+            # whole-topology exposition names stable workers
+            with self._lock:
+                w = session.meta.get("worker")
+                if w is not None and isinstance(msg.get("snapshot"),
+                                                dict):
+                    self.worker_stats[w] = msg["snapshot"]
+        elif channel == CH_METRICS and op == "metrics_req":
+            # a /metrics scrape hit this worker: fold its piggybacked
+            # stats in, render the WHOLE topology (driver registry +
+            # every worker's last report + aggregated totals), and
+            # answer the round-trip
+            with self._lock:
+                w = session.meta.get("worker")
+                if w is not None and isinstance(msg.get("stats"), dict):
+                    self.worker_stats[w] = msg["stats"]
+            try:
+                text = self.render_metrics()
+            except Exception:  # noqa: BLE001 - scrape must degrade
+                log.exception("serving: metrics render failed")
+                text = "# metrics render failed\n"
+            try:
+                # short timeout: this runs ON the read pump (see the
+                # worker-side ack send for the rationale); a dropped
+                # scrape answer degrades to the worker's local render
+                session.send(CH_METRICS, {"op": "metrics_txt",
+                                          "req": msg.get("req"),
+                                          "text": text}, timeout=2.0)
+            except OSError:
+                pass   # dying link: the transport handles the purge
 
-    def _send(self, idx: int, obj) -> None:
-        data = (json.dumps(obj) + "\n").encode("utf-8")
-        with self._wlocks[idx]:
-            c = self._conns[idx]
-            if c is None:
-                raise OSError("exchange link closed")
-            c.sendall(data)
+    def _on_worker_hello(self, session, msg: dict) -> None:
+        w = msg.get("worker")
+        if (not isinstance(w, int)
+                or not 0 <= w < len(self.addresses)):
+            log.warning("serving: ignoring hello with invalid "
+                        "worker id %r (need 0..%d)", w,
+                        len(self.addresses) - 1)
+            return
+        # newest-wins slot claim: a hello for an occupied slot from a
+        # DIFFERENT session means the worker process was respawned (or
+        # re-dialed before its old session's loss was declared).  The
+        # new session takes the slot; the old one is dropped and its
+        # routes purged WITHOUT counting a worker death twice —
+        # clearing its slot claim first means its teardown cannot wipe
+        # the live worker's address.  A re-hello on the SAME session
+        # (reconnect after a session reset, or the routine re-hello on
+        # every resume) is idempotent.
+        stale_sid = None
+        with self._lock:
+            old_sid = self._slot_sid.get(w)
+            if old_sid is not None and old_sid != session.sid:
+                log.warning("serving: worker slot %d re-helloed on a "
+                            "new session; replacing the stale one", w)
+                stale_sid = old_sid
+                old_sess = self._ts.sessions.get(old_sid)
+                if old_sess is not None:
+                    old_sess.meta.pop("worker", None)
+            self._slot_sid[w] = session.sid
+            session.meta["worker"] = w
+        self.addresses[w] = f"http://{msg['host']}:{msg['port']}"
+        if stale_sid is not None:
+            self._ts.drop_session(stale_sid, notify=False)
+            self._purge_session(stale_sid)
+
+    def _on_session_lost(self, session) -> None:
+        """A session died for good (resume grace expired, peer CLOSEd,
+        or an explicit drop): purge its routes so replies report
+        undelivered immediately, release its ack waiters, and reopen
+        its worker slot for a fresh hello — the surviving workers keep
+        serving (the reference's executor-loss story, SURVEY.md §5.3
+        applied to serving).  Requests from this worker still in
+        ``self.queue`` score normally; their replies find no route and
+        report undelivered."""
+        held_slot = False
+        with self._lock:
+            w = session.meta.get("worker")
+            if w is not None and self._slot_sid.get(w) == session.sid:
+                self._slot_sid.pop(w, None)
+                if 0 <= w < len(self.addresses):
+                    self.addresses[w] = ""   # slot freed for rejoin
+                held_slot = True
+        self._purge_session(session.sid)
+        if held_slot and not self._closing.is_set():
+            # only a session that actually HELD a worker slot counts as
+            # a worker death — an authed peer with an invalid or
+            # superseded hello never represented capacity
+            self.counters["worker_deaths"] += 1
+            self.stats.incr("worker_deaths")
+
+    def _purge_session(self, sid: str) -> None:
+        """Drop every route and ack waiter still pointing at ``sid``."""
+        with self._lock:
+            for r in [r for r, (s, _) in self._route.items()
+                      if s == sid]:
+                self._route.pop(r, None)
+            dead_acks = [r for r, (_, s) in self._acks.items()
+                         if s == sid]
+            waiters = [self._acks.pop(r)[0] for r in dead_acks]
+        for waiter in waiters:
+            waiter.response = False
+            waiter.event.set()
 
     def _sweep_routes_locked(self) -> None:
         """Drop routes whose worker-side handler must be gone: a live
@@ -1295,24 +1196,41 @@ class MultiprocessHTTPServer:
             pass
         return batch
 
+    def _reply_session(self, rid: str):
+        """Pop the route for ``rid`` and return its live session, or
+        None.  A session that is down RIGHT NOW reports undelivered
+        immediately (the old fail-fast contract): if the worker is
+        merely mid-blip it re-parks the request on resume and the
+        engine scores it again — at-least-once scoring, with
+        exactly-once CLIENT delivery still decided atomically by the
+        socket owner."""
+        with self._lock:
+            entry = self._route.pop(rid, None)
+        if entry is None:
+            return None
+        session = self._ts.sessions.get(entry[0])
+        if session is None or not session.connected:
+            return None
+        return session
+
     def reply(self, request_id: str, response: Any,
               status: int = 200) -> bool:
         """Route a reply to the worker PROCESS holding the socket; blocks
         on that worker's delivered/undelivered ack (the socket owner
         decides atomically, so a reply racing the worker-side timeout
         reports exactly what the client saw)."""
+        session = self._reply_session(request_id)
+        if session is None:
+            return False
+        waiter = _Pending()
         with self._lock:
-            entry = self._route.pop(request_id, None)
-            if entry is None:
-                return False
-            idx = entry[0]
-            waiter = _Pending()
-            self._acks[request_id] = (waiter, idx)
+            self._acks[request_id] = (waiter, session.sid)
         try:
-            self._send(idx, {"op": "reply", "rid": request_id,
-                             "response": response, "status": status})
+            session.send(CH_SCORING,
+                         {"op": "reply", "rid": request_id,
+                          "response": response, "status": status})
         except OSError:
-            # worker process died between park and reply: undelivered
+            # worker session closed between park and reply: undelivered
             with self._lock:
                 self._acks.pop(request_id, None)
             return False
@@ -1323,21 +1241,21 @@ class MultiprocessHTTPServer:
         return bool(waiter.response)
 
     def reply_many(self, entries: List[Tuple[str, Any, int]]) -> int:
-        """Pipelined batch reply: send every reply line first, then
+        """Pipelined batch reply: send every reply frame first, then
         collect the delivery acks — one exchange round-trip for the
         whole micro-batch instead of a blocking RTT per row."""
-        waiting: List[_Pending] = []
+        waiting: List[Tuple[str, _Pending]] = []
         for rid, response, status in entries:
+            session = self._reply_session(rid)
+            if session is None:
+                continue
+            waiter = _Pending()
             with self._lock:
-                entry = self._route.pop(rid, None)
-                if entry is None:
-                    continue
-                idx = entry[0]
-                waiter = _Pending()
-                self._acks[rid] = (waiter, idx)
+                self._acks[rid] = (waiter, session.sid)
             try:
-                self._send(idx, {"op": "reply", "rid": rid,
-                                 "response": response, "status": status})
+                session.send(CH_SCORING,
+                             {"op": "reply", "rid": rid,
+                              "response": response, "status": status})
             except OSError:
                 with self._lock:
                     self._acks.pop(rid, None)
@@ -1356,27 +1274,17 @@ class MultiprocessHTTPServer:
         return delivered
 
     def stop(self) -> None:
-        self._closing.set()    # accept loop + supervisor wind down
-        for i in range(len(self._conns)):
+        self._closing.set()    # supervisor + beacon wind down
+        for session in list(self._ts.sessions.values()):
             try:
-                self._send(i, {"op": "stop"})
+                session.send(CH_CONTROL, {"op": "stop"}, timeout=1.0)
             except OSError:
                 pass
         for p in self._procs:
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
-        for c in self._conns:
-            if c is None:
-                continue   # freed slot (dead worker link)
-            try:
-                c.close()
-            except OSError:
-                pass
-        self._listener.close()
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5)
-            self._accept_thread = None
+        self._ts.stop()
         if self._proc_supervisor is not None:
             self._proc_supervisor.join(timeout=5)
             self._proc_supervisor = None

@@ -532,9 +532,10 @@ class TestServingSmoke:
 
 class TestAcceptLoopRegistration:
     def test_garbage_peer_consumes_no_slot(self):
-        """ADVICE r5: a dropped pre-auth connection must not occupy
-        _conns/_wlocks; a legit worker joining afterwards still gets
-        slot 0 and serves."""
+        """ADVICE r5 (now enforced by the transport handshake): a
+        non-protocol peer is dropped at the magic preamble and must not
+        register a session; a legit worker joining afterwards still
+        gets slot 0 and serves."""
         import os
         import socket
         import subprocess
@@ -550,9 +551,9 @@ class TestAcceptLoopRegistration:
             time.sleep(0.5)
             s.close()
 
-        # one ASCII-garbage peer (json ValueError path) and one binary
-        # peer (UnicodeDecodeError from the utf-8 makefile) — neither
-        # may claim a slot or kill its reader thread
+        # one ASCII-garbage peer and one binary peer — neither speaks
+        # the transport magic, so neither may register a session or
+        # kill its handshake thread
         peers = [threading.Thread(target=garbage_peer, args=(d,),
                                   daemon=True)
                  for d in (b"NOT JSON AT ALL\n", b"\xff\xfe\x00binary")]
@@ -571,9 +572,8 @@ class TestAcceptLoopRegistration:
             srv.start()
             for g in peers:
                 g.join(5)
-            # only the AUTHED worker registered exchange state
-            assert len(srv._conns) == 1
-            assert len(srv._wlocks) == 1
+            # only the AUTHED worker registered a transport session
+            assert len(srv._ts.sessions) == 1
             assert srv.addresses[0]
             # and it actually serves
             done = threading.Event()

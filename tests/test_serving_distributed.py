@@ -269,6 +269,18 @@ class TestExternalWorkers:
             for p in procs:
                 p.wait(timeout=15)
 
+    def test_join_exchange_malformed_address_clear_error(self):
+        """ISSUE 6 satellite: a malformed or bare-IPv6 exchange address
+        fails up front with a clear ValueError instead of deep inside
+        create_connection."""
+        from mmlspark_tpu.io.serving import join_exchange
+        with pytest.raises(ValueError, match="host:port"):
+            join_exchange("not-an-address", 0)
+        with pytest.raises(ValueError, match=r"\[fe80::1\]"):
+            join_exchange("fe80::1:9000", 0)
+        with pytest.raises(ValueError, match="port"):
+            join_exchange("host:99999", 0)
+
     def test_join_timeout_fails_fast(self):
         srv = MultiprocessHTTPServer(num_workers=1, spawn_workers=False,
                                      join_timeout=1.0)

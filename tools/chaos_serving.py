@@ -14,9 +14,13 @@ multiprocess serving topology and verify the resilience contract:
 Topology: ``MultiprocessHTTPServer`` (2 spawned worker processes,
 supervised) + ``ScoringEngine`` over a real trained booster wrapped in
 ``ChaosPredictor``.  All injection draws from a seeded ``ChaosPlan`` —
-same seed, same fault schedule.
+same seed, same fault schedule.  The exchange itself rides the unified
+``io/transport.py`` sessions (ISSUE 6), and phase D drills the
+transport directly: frame bitflips, ack loss, mid-frame link kills and
+half-open stalls via ``ChaosTransport``, verifying zero lost / zero
+duplicated / bit-exact delivery across seeded link kills.
 
-Run: ``python tools/chaos_serving.py --out artifacts/chaos_serving_r03.json``
+Run: ``python tools/chaos_serving.py --out artifacts/chaos_serving_r06.json``
 (~2 min wall on a 2-core CPU box; worker spawns dominate).
 """
 
@@ -165,6 +169,83 @@ def clean_pass(srv, X, want, ledger, n_requests, timeout):
             ledger.record("conn_error")
 
 
+def transport_drill(seed, n_messages=120):
+    """Phase D (ISSUE 6): drill the exchange TRANSPORT itself — frame
+    bitflips, ack loss, seeded mid-frame link kills and a half-open
+    stall against an in-process echo session — and verify the resume
+    contract: zero lost, zero duplicated, bit-exact, every corruption
+    caught by the CRC, half-open links detected by keepalive."""
+    import time as _t
+
+    from mmlspark_tpu.io import transport as tp
+    from mmlspark_tpu.io.chaos import ChaosPlan, ChaosTransport
+    from mmlspark_tpu.io.transport import (CH_SCORING, TransportClient,
+                                           TransportConfig,
+                                           TransportServer)
+
+    plan = ChaosPlan(seed=seed)
+    conn_n = [0]
+
+    def wrap(sock):
+        conn_n[0] += 1
+        n = conn_n[0]
+        if n <= 2:        # poisoned links: bitflips + dropped acks
+            return ChaosTransport(sock, plan, bitflip_rate=0.05,
+                                  ack_drop_rate=0.3,
+                                  kill_on_sends={30},
+                                  name=f"poison{n}")
+        if n == 3:        # half-open link: goes silent without FIN
+            return ChaosTransport(sock, plan, half_open_after=10,
+                                  name="halfopen")
+        return sock
+
+    def on_msg(sess, ch, obj, dl):
+        if obj.get("op") == "echo":
+            sess.send(CH_SCORING, {"op": "reply", "v": obj["v"]})
+
+    c0 = dict(tp.transport_stats.snapshot()["counters"])
+    srv = TransportServer(token="drill",
+                          cfg=TransportConfig(socket_wrap=wrap),
+                          on_message=on_msg, name="drill-srv").start()
+    got = []
+    client = TransportClient(
+        srv.address, token="drill",
+        cfg=TransportConfig(keepalive_interval_s=0.2,
+                            keepalive_timeout_s=1.0, ack_every=4,
+                            reconnect_backoff=(0.05, 0.3)),
+        on_message=lambda s, ch, o, d: got.append(o),
+        name="drill-client").connect()
+    payloads = [[i, i * 0.25, f"row{i}"] for i in range(n_messages)]
+    try:
+        for pl in payloads:
+            client.send(CH_SCORING, {"op": "echo", "v": pl},
+                        timeout=15.0)
+            _t.sleep(0.002)
+        deadline = _t.time() + 30
+        while len(got) < n_messages and _t.time() < deadline:
+            _t.sleep(0.01)
+    finally:
+        client.close()
+        srv.stop()
+    c1 = tp.transport_stats.snapshot()["counters"]
+    delta = {k: c1[k] - c0.get(k, 0) for k in c1}
+    verdicts = {
+        "transport_zero_lost": len(got) >= n_messages,
+        "transport_zero_duplicated": len(got) <= n_messages,
+        "transport_bit_exact":
+            [o.get("v") for o in got] == payloads,
+        "transport_crc_detected": delta.get("crc_drops", 0) >= 1,
+        "transport_resumed": delta.get("resumes", 0) >= 1,
+        "transport_half_open_detected":
+            delta.get("keepalive_drops", 0) >= 1,
+        "transport_replayed": delta.get("retransmits", 0) >= 1,
+    }
+    detail = {"messages": n_messages, "received": len(got),
+              "links_dialed": conn_n[0], "counters_delta": delta,
+              "injected": plan.counts()}
+    return verdicts, detail
+
+
 def http_get_status(addr, path, timeout=5.0):
     host, port = addr.replace("http://", "").rsplit(":", 1)
     conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
@@ -309,6 +390,12 @@ def main():
         engine.stop()
         srv.stop()
 
+    # ---- phase D: transport-level chaos (ISSUE 6) ----------------
+    print("== transport drill ==", flush=True)
+    transport_verdicts, transport_detail = transport_drill(args.seed)
+    detail["transport"] = transport_detail
+    print(json.dumps(transport_verdicts), flush=True)
+
     ch, cl = detail["chaos"], detail["clean"]
     verdicts = {
         "zero_wrong_answers": ch["wrong"] == 0 and cl["wrong"] == 0,
@@ -331,6 +418,7 @@ def main():
         "counters_exposed": all(
             k in detail["engine_counters"]
             for k in ("shed", "expired", "salvaged", "restarted")),
+        **transport_verdicts,
     }
     result = {
         "metric": "chaos_serving_drill",

@@ -19,7 +19,12 @@ over localhost with gloo CPU collectives — the
 elastic runner (``python -m mmlspark_tpu.gbdt.elastic``) under the
 :func:`mmlspark_tpu.gbdt.elastic.supervise` gang supervisor.
 
-Run: ``python tools/chaos_training.py --out artifacts/chaos_training_r04.json``
+Phase 4 additionally drills the ISSUE 6 transport heartbeat mode: two
+watchdogs beaconing through a ``HeartbeatHub`` over resumable
+``io/transport.py`` sessions under seeded link kills and an injected
+beacon stall — a link blip must never fake a dead peer.
+
+Run: ``python tools/chaos_training.py --out artifacts/chaos_training_r06.json``
 (~2-3 min wall on a 2-core CPU box; jax process startups dominate).
 """
 
@@ -179,6 +184,76 @@ def telemetry_block(stats_by_pid, journal_tail=60):
             "journal_excerpt": journal[-journal_tail:]}
 
 
+def transport_heartbeat_drill(seed=17, runtime_s=4.0):
+    """Phase 4 (ISSUE 6): drill the TRANSPORT heartbeat mode — two
+    watchdogs beaconing leases through a ``HeartbeatHub`` over
+    resumable transport sessions while seeded link kills and an
+    injected beacon stall hit the wire.  Contract: a link blip NEVER
+    fakes a dead peer (session resume outruns the lease timeout), a
+    genuine beacon stall past the straggler threshold IS counted, and
+    the transport's reconnect/resume counters move."""
+    import threading
+    import time as _t
+
+    from mmlspark_tpu.core.profiling import StageStats
+    from mmlspark_tpu.gbdt.elastic import (ElasticConfig, HeartbeatHub,
+                                           HeartbeatWatchdog)
+    from mmlspark_tpu.io import transport as tp
+    from mmlspark_tpu.io.chaos import ChaosHeartbeat, ChaosPlan
+
+    c0 = dict(tp.transport_stats.snapshot()["counters"])
+    hub = HeartbeatHub(token="hb-drill").start()
+    lost = []
+    watchdogs = []
+    stats = {}
+    plan = ChaosPlan(seed=seed)
+    # controller 1's beacons stall once for 1.0 s (straggler range:
+    # above straggler_age_s=0.5, far below lease_timeout_s=3.0)
+    stall = ChaosHeartbeat(plan, after_s=1.0, stall_s=1.0)
+    for pid in range(2):
+        cfg = ElasticConfig(
+            heartbeat_dir="", process_id=pid, num_processes=2,
+            heartbeat_interval_s=0.1, straggler_age_s=0.5,
+            lease_timeout_s=3.0, transport_address=hub.address,
+            transport_token="hb-drill")
+        stats[pid] = StageStats()
+        wd = HeartbeatWatchdog(
+            cfg, stats=stats[pid],
+            on_peer_lost=lambda p, a: lost.append((p, round(a, 2))),
+            write_hook=stall if pid == 1 else None)
+        wd.start()
+        watchdogs.append(wd)
+    _t.sleep(1.0)
+    # seeded link kills: yank controller 0's hub link twice mid-run;
+    # the session must resume before any lease expires
+    kills = 0
+    for _ in range(2):
+        sock = watchdogs[0]._client.session._sock
+        if sock is not None:
+            sock.close()
+            kills += 1
+        _t.sleep(runtime_s / 2)
+    for wd in watchdogs:
+        wd.stop()
+    hub.stop()
+    c1 = tp.transport_stats.snapshot()["counters"]
+    delta = {k: c1[k] - c0.get(k, 0) for k in c1}
+    snap = {pid: stats[pid].snapshot() for pid in stats}
+    stalls = sum(s["counters"].get("heartbeat_stalls", 0)
+                 for s in snap.values())
+    verdicts = {
+        "transport_hb_no_false_peer_loss": not lost,
+        "transport_hb_link_resumed":
+            kills >= 1 and delta.get("resumes", 0) >= 1,
+        "transport_hb_straggler_counted": stalls >= 1,
+    }
+    detail = {"link_kills": kills, "peer_lost": lost,
+              "injected_stalls": stall.stalls,
+              "watchdog_stats": {str(k): v for k, v in snap.items()},
+              "counters_delta": delta}
+    return verdicts, detail
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None, help="artifact JSON path")
@@ -229,6 +304,12 @@ def main():
                       stall=args.heartbeat_stall)
     detail["stall"] = {k: stall[k] for k in
                        ("restarts", "wall_s", "exit_codes", "stats")}
+
+    print("== phase 4: transport heartbeat chaos (ISSUE 6) ==",
+          flush=True)
+    transport_verdicts, transport_detail = transport_heartbeat_drill()
+    detail["transport_heartbeats"] = transport_detail
+    print(json.dumps(transport_verdicts), flush=True)
     detail["total_wall_s"] = round(time.time() - t_all, 1)
 
     def last_round_stats(phase_result):
@@ -279,6 +360,7 @@ def main():
             for s in kill_last.values()
             for k in ("chunks_replayed", "ckpt_resumed",
                       "ckpt_discarded")),
+        **transport_verdicts,
     }
     result = {
         "metric": "chaos_training_drill",
