@@ -20,8 +20,13 @@ with exactly these aggregations.
 Serving adds a second, host-side need: per-stage wall-clock counters for
 the scoring hot path (queue wait / decode / score / reply), cheap enough
 to stay on in production.  :class:`LatencyStats` is a thread-safe
-streaming accumulator with ring-buffer percentiles; :class:`StageStats`
-groups named stages plus a rows counter so ``ScoringEngine.stats()`` can
+streaming accumulator over a FIXED log-bucketed histogram (ISSUE 8):
+counts per logarithmic latency bucket instead of the old 4096-sample
+ring, so two workers' snapshots MERGE exactly (bucket counts sum;
+percentiles recompute from the summed buckets) — averaging or
+max-ing per-worker p99s, the only option a sample ring allowed, is not
+a percentile of the combined population.  :class:`StageStats` groups
+named stages plus a rows counter so ``ScoringEngine.stats()`` can
 report rows/s and p50/p99 without a profiler attached.
 """
 
@@ -30,72 +35,178 @@ from __future__ import annotations
 import glob
 import gzip
 import json
+import math
 import os
 import threading
 import time
+from bisect import bisect_left
 from collections import defaultdict
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
+# -- log-bucket ladder -------------------------------------------------------
+
+#: multiplicative bucket growth: 2**0.25 bounds the relative error of a
+#: bucket-midpoint percentile estimate to ~±9% — tight enough for an SLO
+#: readout, coarse enough that a stage's occupied buckets stay few
+HIST_GROWTH = 2.0 ** 0.25
+#: lowest bucket upper bound (10 µs); the top finite bound is
+#: ``HIST_GROWTH**(HIST_BUCKETS-1)`` above it (~300 s) — everything
+#: slower lands in the +Inf overflow bucket
+HIST_FLOOR = 1e-5
+HIST_BUCKETS = 100
+
+#: upper (``le``) bounds of the finite buckets, ascending
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    HIST_FLOOR * HIST_GROWTH ** i for i in range(HIST_BUCKETS))
+#: stable string keys for the bucket bounds — the wire/snapshot
+#: representation (identical across processes because the ladder is a
+#: module constant, never computed from data)
+LE_STRS: Tuple[str, ...] = tuple(
+    format(b, ".6g") for b in BUCKET_BOUNDS) + ("+Inf",)
+_LE_INDEX = {s: i for i, s in enumerate(LE_STRS)}
+
+
+def bucket_index(seconds: float) -> int:
+    """Index into ``LE_STRS`` of the bucket holding ``seconds`` (the
+    first bound >= the value; the last index is the +Inf overflow)."""
+    return bisect_left(BUCKET_BOUNDS, seconds)
+
+
+def _bucket_mid(i: int) -> float:
+    """Representative value (geometric midpoint) for bucket ``i`` —
+    the percentile estimate returned for ranks landing in it."""
+    if i >= HIST_BUCKETS:                       # +Inf overflow
+        return BUCKET_BOUNDS[-1] * math.sqrt(HIST_GROWTH)
+    return BUCKET_BOUNDS[i] / math.sqrt(HIST_GROWTH)
+
+
+def percentile_from_buckets(buckets: Dict[str, int], q: float) -> float:
+    """q-th percentile (0-100), in seconds, of a sparse ``{le: count}``
+    bucket dict (the ``snapshot()["buckets"]`` shape).  Deterministic in
+    the bucket counts alone, so summing two sources' buckets and calling
+    this is EXACTLY the percentile of the combined population at the
+    ladder's resolution — the property ``merge_snapshots`` relies on."""
+    total = 0
+    per_idx: List[Tuple[int, int]] = []
+    for le, c in buckets.items():
+        i = _LE_INDEX.get(le)
+        if i is None or not c:
+            continue
+        per_idx.append((i, int(c)))
+        total += int(c)
+    if total <= 0:
+        return 0.0
+    rank = max(1, math.ceil(q / 100.0 * total))
+    cum = 0
+    for i, c in sorted(per_idx):
+        cum += c
+        if cum >= rank:
+            return _bucket_mid(i)
+    return _bucket_mid(per_idx[-1][0] if per_idx else 0)
+
 
 class LatencyStats:
-    """Thread-safe streaming latency accumulator.
+    """Thread-safe streaming latency accumulator over the fixed
+    log-bucket ladder.
 
-    Keeps exact count/total plus a ring buffer of the most recent
-    ``capacity`` samples for percentile estimates — O(1) per record, no
-    unbounded growth, good enough for serving dashboards (percentiles
-    reflect the recent window, which is what a latency SLO watches).
+    Keeps exact count/total plus one integer per occupied bucket —
+    O(1) per record, bounded memory, and (unlike the sample ring it
+    replaced) MERGEABLE: ``snapshot()["buckets"]`` from any number of
+    workers can be key-wise summed and the percentiles recomputed
+    exactly for the combined population.
+
+    Two views coexist: the CUMULATIVE buckets (the exposition's
+    ``_bucket`` rows and the merge representation — Prometheus
+    consumers ``rate()`` them for any window they like), and a
+    RECENT-WINDOW pair of bucket epochs rotated every
+    ``window_s`` seconds that the ``p50_ms``/``p99_ms`` snapshot keys
+    are estimated from — a latency SLO watches *current* tail latency,
+    and a lifetime-cumulative estimate would dilute a regression under
+    millions of historical fast samples (the property the old sample
+    ring had, kept).  ``capacity`` is accepted and ignored for
+    backward compatibility with the ring-buffer signature.
     """
 
-    __slots__ = ("_lock", "_count", "_total", "_ring", "_cap", "_pos")
+    #: half-window for the recent-percentile epochs: estimates span
+    #: the last 1-2 windows' samples
+    WINDOW_S = 60.0
+
+    __slots__ = ("_lock", "_count", "_total", "_buckets", "_recent",
+                 "_prev", "_epoch_t")
 
     def __init__(self, capacity: int = 4096):
+        del capacity                    # ring-era knob, no longer used
         self._lock = threading.Lock()
         self._count = 0
         self._total = 0.0
-        self._cap = capacity
-        self._ring: List[float] = []
-        self._pos = 0
+        self._buckets = [0] * len(LE_STRS)
+        self._recent = [0] * len(LE_STRS)
+        self._prev = [0] * len(LE_STRS)
+        self._epoch_t = time.monotonic()
+
+    def _roll_locked(self) -> None:
+        elapsed = time.monotonic() - self._epoch_t
+        if elapsed < self.WINDOW_S:
+            return
+        if elapsed >= 2 * self.WINDOW_S:
+            # a traffic gap longer than the whole window: BOTH epochs
+            # are stale — shifting would present the pre-gap epoch as
+            # "recent" for another window
+            self._prev = [0] * len(LE_STRS)
+        else:
+            self._prev = self._recent
+        self._recent = [0] * len(LE_STRS)
+        self._epoch_t = time.monotonic()
 
     def record(self, seconds: float) -> None:
+        i = bucket_index(seconds)
         with self._lock:
+            self._roll_locked()
             self._count += 1
             self._total += seconds
-            if len(self._ring) < self._cap:
-                self._ring.append(seconds)
-            else:
-                self._ring[self._pos] = seconds
-                self._pos = (self._pos + 1) % self._cap
+            self._buckets[i] += 1
+            self._recent[i] += 1
 
     @property
     def count(self) -> int:
         return self._count
 
-    @staticmethod
-    def _pct(window: List[float], q: float) -> float:
-        """Nearest-rank percentile of a pre-sorted window, in seconds."""
-        if not window:
-            return 0.0
-        i = min(len(window) - 1,
-                max(0, round(q / 100.0 * (len(window) - 1))))
-        return window[i]
+    def _window_counts_locked(self):
+        """Recent-window bucket counts (last 1-2 epochs), falling back
+        to the cumulative buckets when the window is empty (e.g. right
+        after a rotation with no fresh traffic) so percentiles degrade
+        to the lifetime estimate instead of reading 0."""
+        self._roll_locked()
+        window = [a + b for a, b in zip(self._recent, self._prev)]
+        return window if any(window) else list(self._buckets)
 
     def percentile(self, q: float) -> float:
-        """q-th percentile (0-100) over the recent window, in seconds."""
+        """q-th percentile (0-100) over the recent window, in seconds
+        (bucket-midpoint estimate, ~±9% relative; same estimator as
+        ``snapshot()`` — both delegate to
+        :func:`percentile_from_buckets`)."""
         with self._lock:
-            window = sorted(self._ring)
-        return self._pct(window, q)
+            counts = self._window_counts_locked()
+        return percentile_from_buckets(
+            {LE_STRS[i]: c for i, c in enumerate(counts) if c}, q)
 
-    def snapshot(self) -> Dict[str, float]:
+    def snapshot(self) -> Dict[str, object]:
         with self._lock:
             count, total = self._count, self._total
-            window = sorted(self._ring)
+            counts = list(self._buckets)
+            window = self._window_counts_locked()
+        sparse = {LE_STRS[i]: c for i, c in enumerate(counts) if c}
+        wsparse = {LE_STRS[i]: c for i, c in enumerate(window) if c}
         return {
             "count": count,
             "total_s": round(total, 6),
             "mean_ms": round(total / count * 1e3, 4) if count else 0.0,
-            "p50_ms": round(self._pct(window, 50) * 1e3, 4),
-            "p99_ms": round(self._pct(window, 99) * 1e3, 4),
+            "p50_ms": round(
+                percentile_from_buckets(wsparse, 50) * 1e3, 4),
+            "p99_ms": round(
+                percentile_from_buckets(wsparse, 99) * 1e3, 4),
+            "buckets": sparse,
         }
 
 

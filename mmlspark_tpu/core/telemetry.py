@@ -27,19 +27,36 @@ federates them (ISSUE 5):
 
 Metric naming scheme (see docs/observability.md):
 
-==============================================  =======  ==================
-family                                          type     labels
-==============================================  =======  ==================
-``mmlspark_tpu_rows_total``                     counter  ``ns``
-``mmlspark_tpu_rows_per_second``                gauge    ``ns``
-``mmlspark_tpu_events_total``                   counter  ``ns``, ``event``
-``mmlspark_tpu_gauge``                          gauge    ``ns``, ``name``
-``mmlspark_tpu_stage_latency_seconds``          summary  ``ns``, ``stage``
-==============================================  =======  ==================
+==============================================  =========  ==================
+family                                          type       labels
+==============================================  =========  ==================
+``mmlspark_tpu_rows_total``                     counter    ``ns``
+``mmlspark_tpu_rows_per_second``                gauge      ``ns``
+``mmlspark_tpu_events_total``                   counter    ``ns``, ``event``
+``mmlspark_tpu_gauge``                          gauge      ``ns``, ``name``
+``mmlspark_tpu_stage_latency_seconds``          histogram  ``ns``, ``stage``, ``le``
+==============================================  =========  ==================
 
-``ns`` is the registry namespace (``scoring``, ``train``, ``elastic``,
-``serving_exchange``, ``worker<N>``/``workers`` for the multiprocess
-topology's per-worker and aggregated blocks).
+(Plus the ``mmlspark_tpu_slo_*`` families rendered by
+:mod:`mmlspark_tpu.core.slo` through the registry's exposition-provider
+hook.)  ``ns`` is the registry namespace (``scoring``, ``train``,
+``elastic``, ``serving_exchange``, ``worker<N>``/``workers`` for the
+multiprocess topology's per-worker and aggregated blocks).
+
+Stage latencies are log-bucketed histograms
+(:class:`~mmlspark_tpu.core.profiling.LatencyStats`): the ``_bucket``
+rows carry cumulative counts with ``le`` upper bounds, which is what
+makes :func:`merge_snapshots` EXACT across workers — bucket counts sum,
+and the aggregate percentile is recomputed from the summed buckets
+instead of averaging per-worker estimates (ISSUE 8; "The Tail at
+Scale" aggregation discipline).
+
+This module additionally hosts the **crash flight recorder**
+(:func:`record_flight`): on a worker death, chaos verdict failure or
+unhandled engine exception, the journal tail + latest metrics
+exposition + per-thread stacks are dumped atomically to a bounded,
+rotated ``artifacts/flightrec_*.json`` set, so every post-mortem is
+self-contained.
 
 Everything here is stdlib-only and import-light: the serving hot path
 and the training loop both call into it.
@@ -48,18 +65,24 @@ and the training loop both call into it.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import threading
 import time
+import traceback
 import uuid
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from .profiling import percentile_from_buckets
 
 PREFIX = "mmlspark_tpu"
 
 # -- Prometheus text exposition ---------------------------------------------
 
-#: family -> (type, help); summaries additionally emit _sum/_count rows
+#: family -> (type, help); histograms additionally emit
+#: _bucket/_sum/_count rows
 _FAMILIES = (
     ("rows_total", "counter", "Rows processed by this source."),
     ("rows_per_second", "gauge",
@@ -69,8 +92,9 @@ _FAMILIES = (
      "ckpt_saved/ckpt_resumed/..., heartbeat_stalls/peer_lost, ...)."),
     ("gauge", "gauge",
      "Point-in-time levels (heartbeat_age_ms, ms_per_tree, ...)."),
-    ("stage_latency_seconds", "summary",
-     "Per-stage wall-clock latency (quantiles over the recent window)."),
+    ("stage_latency_seconds", "histogram",
+     "Per-stage wall-clock latency (log-bucketed, cross-worker "
+     "mergeable)."),
 )
 
 
@@ -135,14 +159,29 @@ def render_prometheus(snapshots: Dict[str, dict],
                 continue
             slab = {**lab, "stage": stage}
             base = f"{prefix}_stage_latency_seconds"
-            for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
+            count = s.get("count", 0)
+            # cumulative _bucket rows over the sparse occupied bounds
+            # (Prometheus histograms allow any bound subset as long as
+            # counts are cumulative and +Inf is present); snapshots
+            # without buckets (hand-built test dicts, version-skewed
+            # beacons) still render a valid +Inf-only histogram
+            buckets = s.get("buckets") or {}
+            cum = 0
+            for le, c in sorted(
+                    ((le, c) for le, c in buckets.items()
+                     if le != "+Inf"),
+                    key=lambda kv: float(kv[0])):
+                cum += int(c)
                 rows["stage_latency_seconds"].append(
-                    f"{base}{_labels({**slab, 'quantile': q})} "
-                    f"{_fmt(s.get(key, 0.0) / 1e3)}")
+                    f"{base}_bucket{_labels({**slab, 'le': le})} "
+                    f"{cum}")
+            rows["stage_latency_seconds"].append(
+                f"{base}_bucket{_labels({**slab, 'le': '+Inf'})} "
+                f"{_fmt(count)}")
             rows["stage_latency_seconds"].append(
                 f"{base}_sum{_labels(slab)} {_fmt(s.get('total_s', 0.0))}")
             rows["stage_latency_seconds"].append(
-                f"{base}_count{_labels(slab)} {_fmt(s.get('count', 0))}")
+                f"{base}_count{_labels(slab)} {_fmt(count)}")
     out: List[str] = []
     for fam, typ, help_ in _FAMILIES:
         if not rows[fam]:
@@ -159,12 +198,17 @@ def merge_snapshots(snaps: Iterable[dict]) -> dict:
     SUM, rows/s sums (concurrent sources), gauges take the WORST value
     — max for age/level-style gauges, MIN for up-style gauges (``*_up``
     health booleans, where 1 is healthy and one degraded member must
-    show in the aggregate) — stage count/total sum (mean recomputed)
-    and percentiles take the max across sources: percentile sketches
-    don't merge, and the conservative bound is the honest one for an
-    SLO readout."""
+    show in the aggregate).  Stage latencies merge EXACTLY: the
+    log-bucket counts every :class:`~mmlspark_tpu.core.profiling.
+    LatencyStats` snapshot carries are key-wise summed and the
+    aggregate p50/p99 recomputed from the combined buckets — the
+    percentile OF the combined population at ladder resolution, not an
+    average or max of per-worker estimates (ISSUE 8).  A source with no
+    ``buckets`` (hand-built dicts, version-skewed beacons) degrades
+    that stage to the old conservative max-of-percentiles bound."""
     out: dict = {"rows": 0, "rows_per_s": 0.0, "counters": {},
                  "gauges": {}, "stages": {}}
+    bucketless: Dict[str, bool] = {}
     for snap in snaps:
         if not isinstance(snap, dict):
             continue
@@ -186,15 +230,34 @@ def merge_snapshots(snaps: Iterable[dict]) -> dict:
                 continue
             agg = out["stages"].setdefault(
                 stage, {"count": 0, "total_s": 0.0, "mean_ms": 0.0,
-                        "p50_ms": 0.0, "p99_ms": 0.0})
+                        "p50_ms": 0.0, "p99_ms": 0.0, "buckets": {}})
             agg["count"] += int(s.get("count", 0) or 0)
             agg["total_s"] = round(
                 agg["total_s"] + float(s.get("total_s", 0.0) or 0.0), 6)
             agg["p50_ms"] = max(agg["p50_ms"], s.get("p50_ms", 0.0))
             agg["p99_ms"] = max(agg["p99_ms"], s.get("p99_ms", 0.0))
+            if isinstance(s.get("buckets"), dict):
+                for le, c in s["buckets"].items():
+                    agg["buckets"][le] = agg["buckets"].get(le, 0) \
+                        + int(c)
+            elif s.get("count"):
+                bucketless[stage] = True
             if agg["count"]:
                 agg["mean_ms"] = round(
                     agg["total_s"] / agg["count"] * 1e3, 4)
+    for stage, agg in out["stages"].items():
+        if bucketless.get(stage):
+            # mixed bucketed/bucketless sources: a partial bucket set
+            # under the full count would render every bucketless
+            # sample as a >300s +Inf outlier — drop the buckets so the
+            # stage degrades to a +Inf-only histogram consistently
+            # with its conservative max-of-percentiles bound
+            agg.pop("buckets", None)
+        elif agg["buckets"]:
+            agg["p50_ms"] = round(
+                percentile_from_buckets(agg["buckets"], 50) * 1e3, 4)
+            agg["p99_ms"] = round(
+                percentile_from_buckets(agg["buckets"], 99) * 1e3, 4)
     return out
 
 
@@ -211,6 +274,7 @@ class MetricsRegistry:
         self.prefix = prefix
         self._lock = threading.Lock()
         self._sources: Dict[str, Any] = {}
+        self._expositions: Dict[str, Callable[[], str]] = {}
 
     def register(self, namespace: str, source: Any) -> Any:
         with self._lock:
@@ -220,6 +284,20 @@ class MetricsRegistry:
     def unregister(self, namespace: str) -> None:
         with self._lock:
             self._sources.pop(namespace, None)
+
+    def register_exposition(self, name: str,
+                            provider: Callable[[], str]) -> None:
+        """Register a raw-exposition provider: ``provider()`` returns
+        Prometheus text appended verbatim to every render.  This is how
+        families OUTSIDE the StageStats shape (the SLO monitor's
+        ``mmlspark_tpu_slo_*``) join the scrape without forcing their
+        data through a snapshot dict."""
+        with self._lock:
+            self._expositions[name] = provider
+
+    def unregister_exposition(self, name: str) -> None:
+        with self._lock:
+            self._expositions.pop(name, None)
 
     def namespaces(self) -> List[str]:
         with self._lock:
@@ -241,11 +319,25 @@ class MetricsRegistry:
                           extra: Optional[Dict[str, dict]] = None) -> str:
         """Render every registered source (plus ``extra`` pre-built
         snapshot blocks — the multiprocess driver passes its workers'
-        reported stats here) as Prometheus text."""
+        reported stats here) as Prometheus text, then append every
+        registered exposition provider's families (one failing
+        provider is skipped, never fatal to the scrape)."""
         snaps = self.snapshot()
         if extra:
             snaps.update(extra)
-        return render_prometheus(snaps, self.prefix)
+        text = render_prometheus(snaps, self.prefix)
+        with self._lock:
+            providers = list(self._expositions.items())
+        for name, provider in providers:
+            try:
+                block = provider()
+            except Exception:  # noqa: BLE001 - scrape must not 500
+                continue
+            if block:
+                if not text.endswith("\n"):
+                    text += "\n"
+                text += block if block.endswith("\n") else block + "\n"
+        return text
 
 
 _registry = MetricsRegistry()
@@ -262,24 +354,36 @@ def get_registry() -> MetricsRegistry:
 class EventJournal:
     """Bounded, thread-safe event ring with optional JSONL mirroring.
 
-    ``emit`` stamps each record with a wall-clock ``ts`` and a
-    process-monotonic ``seq`` (total order within one process; readers
-    merging journals from several processes sort by ``(ts, seq)``).
-    The in-memory ring is bounded (``capacity``), so an always-on
-    journal can never grow without bound; :meth:`configure` additionally
-    appends every record to a JSONL file for post-mortem reads."""
+    ``emit`` stamps each record with a wall-clock ``ts``, the emitting
+    ``pid`` (so merged multi-process journals attribute every event to
+    its process) and a process-monotonic ``seq`` (total order within
+    one process; readers merging journals from several processes sort
+    by ``(ts, seq)``).  The in-memory ring is bounded (``capacity``),
+    so an always-on journal can never grow without bound;
+    :meth:`configure` additionally appends every record to a JSONL file
+    for post-mortem reads, with size-capped rotation — when the mirror
+    exceeds ``max_bytes`` it is renamed to ``<path>.1`` (replacing any
+    previous ``.1``) and a fresh file starts, so the on-disk footprint
+    is bounded by ~2x the cap (ISSUE 8 satellite)."""
 
-    def __init__(self, capacity: int = 8192, path: Optional[str] = None):
+    def __init__(self, capacity: int = 8192, path: Optional[str] = None,
+                 max_bytes: int = 8 << 20):
         self._lock = threading.Lock()
         self._ring: "deque[dict]" = deque(maxlen=int(capacity))
         self._seq = 0
         self._fh = None
+        self._path: Optional[str] = None
+        self._max_bytes = int(max_bytes)
+        self._written = 0
         if path:
-            self.configure(path)
+            self.configure(path, max_bytes=max_bytes)
 
-    def configure(self, path: Optional[str]) -> None:
+    def configure(self, path: Optional[str],
+                  max_bytes: Optional[int] = None) -> None:
         """Mirror subsequent events to ``path`` (append mode); ``None``
-        stops mirroring.  Ring behavior is unchanged either way."""
+        stops mirroring.  ``max_bytes`` caps the mirror file before it
+        rotates to ``<path>.1``.  Ring behavior is unchanged either
+        way."""
         with self._lock:
             if self._fh is not None:
                 try:
@@ -287,11 +391,36 @@ class EventJournal:
                 except OSError:
                     pass
                 self._fh = None
+            self._path = path or None
+            if max_bytes is not None:
+                self._max_bytes = int(max_bytes)
             if path:
                 self._fh = open(path, "a", encoding="utf-8")
+                try:
+                    self._written = os.path.getsize(path)
+                except OSError:
+                    self._written = 0
+
+    def _rotate_locked(self) -> None:
+        """Close the mirror, shift it to ``.1`` (dropping the previous
+        ``.1``), and reopen fresh.  Called under ``self._lock``."""
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError:
+            pass   # rotation is best-effort; keep appending regardless
+        try:
+            self._fh = open(self._path, "a", encoding="utf-8")
+        except OSError:
+            self._fh = None
+        self._written = 0
 
     def emit(self, ev: str, **fields) -> dict:
-        rec: dict = {"ts": round(time.time(), 6), "ev": ev}
+        rec: dict = {"ts": round(time.time(), 6), "ev": ev,
+                     "pid": os.getpid()}
         rec.update(fields)
         with self._lock:
             self._seq += 1
@@ -299,8 +428,12 @@ class EventJournal:
             self._ring.append(rec)
             if self._fh is not None:
                 try:
-                    self._fh.write(json.dumps(rec, default=str) + "\n")
+                    line = json.dumps(rec, default=str) + "\n"
+                    self._fh.write(line)
                     self._fh.flush()
+                    self._written += len(line)
+                    if self._path and self._written > self._max_bytes:
+                        self._rotate_locked()
                 except (OSError, ValueError):
                     pass   # a full disk must not kill the hot path
         return rec
@@ -331,12 +464,19 @@ class EventJournal:
             self._ring.clear()
 
     def dump(self, path: str) -> int:
-        """Write the current ring to ``path`` as JSONL; returns the
+        """Write the current ring to ``path`` as JSONL, fsync'd —
+        a dump is a post-mortem artifact, and a crash right after it
+        must not leave a torn or page-cache-only file; returns the
         number of records written."""
         events = self.events()
         with open(path, "w", encoding="utf-8") as fh:
             for rec in events:
                 fh.write(json.dumps(rec, default=str) + "\n")
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass
         return len(events)
 
 
@@ -365,6 +505,140 @@ _journal = EventJournal()
 def get_journal() -> EventJournal:
     """The process-global journal the engines emit into."""
     return _journal
+
+
+#: env var naming a directory every process (driver AND spawned
+#: workers, which inherit the environment) mirrors its journal into —
+#: the cross-process trace story depends on each side's journal being
+#: readable after the fact
+JOURNAL_DIR_ENV = "MMLSPARK_TPU_JOURNAL_DIR"
+
+
+def mirror_journal_from_env(tag: str = "") -> Optional[str]:
+    """If :data:`JOURNAL_DIR_ENV` is set, mirror this process's global
+    journal to ``<dir>/journal_<tag>_<pid>.jsonl`` and return the path
+    (``None`` when the env var is unset or the directory unusable).
+    Worker entrypoints call this at startup so a driver-side tool can
+    merge driver+worker journals into one cross-process timeline."""
+    jdir = os.environ.get(JOURNAL_DIR_ENV)
+    if not jdir:
+        return None
+    try:
+        os.makedirs(jdir, exist_ok=True)
+        name = f"journal_{tag}_{os.getpid()}.jsonl" if tag \
+            else f"journal_{os.getpid()}.jsonl"
+        path = os.path.join(jdir, name)
+        _journal.configure(path)
+        return path
+    except OSError:
+        return None
+
+
+# -- crash flight recorder ---------------------------------------------------
+
+
+FLIGHTREC_DIR_ENV = "MMLSPARK_TPU_FLIGHTREC_DIR"
+
+_flight_lock = threading.Lock()
+_flight_cfg = {"dir": None, "cap": 8, "min_interval_s": 5.0}
+_flight_last: Dict[str, float] = {}
+
+
+def configure_flight_recorder(directory: Optional[str] = None,
+                              cap: Optional[int] = None,
+                              min_interval_s: Optional[float] = None
+                              ) -> None:
+    """Set where flight records land (default: ``$MMLSPARK_TPU_
+    FLIGHTREC_DIR`` or ``artifacts/``), how many are kept before the
+    oldest rotate out, and the per-reason dump throttle."""
+    with _flight_lock:
+        if directory is not None:
+            _flight_cfg["dir"] = directory
+        if cap is not None:
+            _flight_cfg["cap"] = max(1, int(cap))
+        if min_interval_s is not None:
+            _flight_cfg["min_interval_s"] = float(min_interval_s)
+
+
+def _thread_stacks() -> Dict[str, str]:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}-{ident}"
+        out[label] = "".join(traceback.format_stack(frame))
+    return out
+
+
+def record_flight(reason: str, context: Optional[dict] = None,
+                  journal_tail: int = 400) -> Optional[str]:
+    """Crash flight recorder (ISSUE 8): atomically dump the journal
+    tail, the latest metrics exposition and every thread's stack to
+    ``<dir>/flightrec_<utc>_<reason>_<pid>.json`` so a post-mortem is
+    self-contained — no scrape to replay, no journal to hunt down.
+
+    Bounded on every axis: the journal tail is capped, dumps of the
+    same ``reason`` are throttled to one per ``min_interval_s``, and at
+    most ``cap`` records are kept (oldest rotated out).  Never raises —
+    a failing recorder must not worsen the crash it is recording.
+    Returns the path written, or ``None`` when throttled/failed."""
+    try:
+        now = time.time()
+        with _flight_lock:
+            last = _flight_last.get(reason, 0.0)
+            if now - last < _flight_cfg["min_interval_s"]:
+                return None
+            _flight_last[reason] = now
+            directory = (_flight_cfg["dir"]
+                         or os.environ.get(FLIGHTREC_DIR_ENV)
+                         or "artifacts")
+            cap = _flight_cfg["cap"]
+        os.makedirs(directory, exist_ok=True)
+        try:
+            metrics = get_registry().render_prometheus()
+        except Exception:  # noqa: BLE001
+            metrics = "# metrics render failed\n"
+        rec = {
+            "reason": reason,
+            "ts": round(now, 6),
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                 time.gmtime(now)),
+            "pid": os.getpid(),
+            "context": context or {},
+            "fit_span": current_fit_span(),
+            "journal_tail": get_journal().tail(journal_tail),
+            "metrics_exposition": metrics,
+            "threads": _thread_stacks(),
+        }
+        safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in reason)[:40]
+        stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime(now))
+        path = os.path.join(
+            directory,
+            f"flightrec_{stamp}_{int((now % 1) * 1e6):06d}"
+            f"_{safe}_{os.getpid()}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(rec, fh, indent=1, default=str)
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass
+        os.replace(tmp, path)
+        # rotation: keep the newest `cap` records
+        try:
+            recs = sorted(
+                (p for p in os.listdir(directory)
+                 if p.startswith("flightrec_") and p.endswith(".json")),
+                key=lambda p: os.path.getmtime(
+                    os.path.join(directory, p)))
+            for p in recs[:-cap]:
+                os.unlink(os.path.join(directory, p))
+        except OSError:
+            pass
+        return path
+    except Exception:  # noqa: BLE001 - the recorder must never make a
+        return None    # crash worse
 
 
 # -- trace identity ----------------------------------------------------------

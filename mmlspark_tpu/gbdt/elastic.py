@@ -300,6 +300,11 @@ class HeartbeatWatchdog:
                   "abandoning the gang with RESTART_EXIT_CODE=%d — "
                   "resume comes from the chunk checkpoint", pid, age,
                   self.cfg.lease_timeout_s, RESTART_EXIT_CODE)
+        # os._exit runs no cleanup: the flight record is the only
+        # artifact this process leaves behind about WHY it abandoned
+        _tm.record_flight("peer_lost_abandon",
+                          {"peer": pid, "age_s": round(age, 3),
+                           "process_id": self.cfg.process_id})
         os._exit(RESTART_EXIT_CODE)
 
     def _loop(self) -> None:
@@ -546,6 +551,9 @@ def run_worker(args) -> int:
         log.error("controller %d lease expired (%.2fs); abandoning "
                   "with RESTART_EXIT_CODE", pid, age)
         dump_stats()
+        _tm.record_flight("peer_lost_abandon",
+                          {"peer": pid, "age_s": round(age, 3),
+                           "process_id": args.process_id})
         os._exit(RESTART_EXIT_CODE)
 
     wd = HeartbeatWatchdog(cfg, stats=wd_stats, on_peer_lost=on_lost,

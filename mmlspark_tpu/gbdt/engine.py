@@ -1209,6 +1209,12 @@ def train(*args, **kwargs) -> Booster:
     except BaseException as e:
         _tm.get_journal().emit("fit_failed", fit=span,
                                error=type(e).__name__)
+        if not isinstance(e, KeyboardInterrupt):
+            # self-contained post-mortem: journal tail (boost_chunk /
+            # ckpt_* history), metrics and thread stacks at the moment
+            # the fit died — the flight record IS the crash report
+            _tm.record_flight("fit_failed",
+                              {"fit": span, "error": repr(e)})
         _tm.set_current_fit_span(None)
         raise
     _tm.get_journal().emit(

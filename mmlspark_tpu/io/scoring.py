@@ -87,7 +87,7 @@ import numpy as np
 
 from ..core.profiling import StageStats
 from ..core.schema import DataTable
-from ..core.telemetry import get_journal, get_registry
+from ..core.telemetry import get_journal, get_registry, record_flight
 
 log = logging.getLogger(__name__)
 
@@ -603,13 +603,20 @@ class ScoringEngine:
                 return                        # clean stop/drain exit
             except (KeyboardInterrupt, SystemExit):
                 raise
-            except BaseException:  # noqa: BLE001 - crash boundary
+            except BaseException as e:  # noqa: BLE001 - crash boundary
                 if self._stop.is_set():
                     return
                 log.exception("scoring worker %d crashed; restarting",
                               slot)
                 self.stats.incr("restarted")
                 inflight = self._current.pop(slot, None)
+                # the restart erases the crash scene — capture it first
+                # (throttled + rotated inside record_flight, so a
+                # crash-looping worker cannot flood the disk)
+                record_flight(
+                    "scoring_worker_crash",
+                    {"slot": slot, "error": repr(e),
+                     "batch_rows": len(inflight[0]) if inflight else 0})
                 if inflight is not None:
                     self._salvage_crashed(*inflight)
 

@@ -32,7 +32,9 @@ import numpy as np
 
 from ..core.profiling import StageStats
 from ..core.schema import DataTable
-from ..core.telemetry import (get_registry, merge_snapshots,
+from ..core.telemetry import (current_fit_span, get_journal,
+                              get_registry, merge_snapshots,
+                              mirror_journal_from_env, record_flight,
                               render_prometheus)
 from .transport import (CH_CONTROL, CH_METRICS, CH_SCORING, CH_STATS,
                         parse_address)
@@ -100,8 +102,20 @@ class _ServingHandler(BaseHTTPRequestHandler):
     def _metrics(self) -> Optional[str]:
         """Prometheus text for /metrics; ``None`` -> 503.  Default:
         this process's global registry (scoring engine, train stats,
-        whatever else registered)."""
+        whatever else registered).  Instantiating the SLO monitor here
+        means the ``mmlspark_tpu_slo_*`` families ride every serving
+        scrape from the first one — not only after someone probes
+        ``/slo``."""
+        from ..core.slo import get_monitor
+        get_monitor()
         return get_registry().render_prometheus()
+
+    def _slo(self) -> dict:
+        """JSON report for /slo: the process-global SLO monitor's
+        burn-rate evaluation (sampling on demand, so two scrapes a few
+        seconds apart yield meaningful windowed rates)."""
+        from ..core.slo import get_monitor
+        return get_monitor().report()
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -113,6 +127,14 @@ class _ServingHandler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001
                 ready = False
             self._send_json(200 if ready else 503, {"ready": ready})
+        elif self.path == "/slo":
+            try:
+                report = self._slo()
+            except Exception:  # noqa: BLE001 - the route must degrade
+                log.exception("serving: /slo evaluation failed")
+                self.send_error(503, "slo monitor unavailable")
+                return
+            self._send_json(200, report)
         elif self.path == "/metrics":
             try:
                 text = self._metrics()
@@ -522,6 +544,13 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     """
     from .transport import TransportClient, TransportConfig
 
+    # cross-process tracing: when the driver-side tool set
+    # MMLSPARK_TPU_JOURNAL_DIR, this worker's journal (request_recv /
+    # request_reply app events + hop_* transport spans) is mirrored to
+    # a per-pid JSONL the trace reader can merge with the driver's
+    mirror_journal_from_env(f"w{worker_id}")
+    journal = get_journal()
+
     # "engine_ready" mirrors the driver's ready beacon (None until the
     # first beacon arrives — treated as ready so a beacon-less driver
     # degrades to link-up readiness, the pre-beacon contract)
@@ -557,8 +586,13 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                     p.response = msg["response"]
                     p.status = msg.get("status", 200)
                     p.event.set()
+                pl = payloads.get(rid)
             if p is not None:
                 wstats.incr("replied")
+            journal.emit("request_reply", rid=rid,
+                         tid=_payload_tid(rid, pl),
+                         status=msg.get("status", 200),
+                         delivered=p is not None)
             try:
                 # short timeout: this runs ON the read pump — blocking
                 # on credits here would also block the inbound CREDIT
@@ -569,13 +603,24 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                             timeout=2.0)
             except OSError:
                 pass
-        elif channel == CH_METRICS and op == "metrics_txt":
-            # driver's answer to a /metrics scrape round-trip
+        elif channel == CH_METRICS and op in ("metrics_txt",
+                                              "slo_json"):
+            # driver's answer to a /metrics or /slo round-trip
             with plock:
                 mw = mwaiters.pop(msg.get("req"), None)
             if mw is not None:
-                mw.response = msg.get("text")
+                mw.response = (msg.get("text") if op == "metrics_txt"
+                               else msg.get("report"))
                 mw.event.set()
+
+    def _payload_tid(rid, payload):
+        """A request's trace id in the worker process: the client's
+        ``_trace_id`` payload key, else the rid this worker minted —
+        the same contract the engine applies driver-side, so both
+        journals speak about one request under one id."""
+        if isinstance(payload, dict) and payload.get("_trace_id"):
+            return str(payload["_trace_id"])
+        return str(rid)
 
     adv = {"host": ""}
 
@@ -597,12 +642,21 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             client.send(CH_CONTROL, {
                 "op": "hello", "worker": worker_id,
                 "host": adv["host"], "port": httpd.server_address[1]})
+            # first stats beacon NOW, not a full period later: the
+            # driver's per-worker `worker_up` gauge must read fresh
+            # from the moment the slot joins (a scrape right after
+            # start would otherwise show a healthy worker as dark)
+            client.send(CH_STATS, {"op": "stats",
+                                   "snapshot": wstats.snapshot(),
+                                   "fit": current_fit_span()})
             with plock:
                 requeue = [(r, payloads[r]) for r in pending
                            if r in payloads]
             for rid, payload in requeue:
-                client.send(CH_SCORING, {"op": "park", "rid": rid,
-                                         "payload": payload})
+                client.send(CH_SCORING,
+                            {"op": "park", "rid": rid,
+                             "payload": payload},
+                            tc={"tid": _payload_tid(rid, payload)})
         except OSError:
             pass   # link died instantly — the next reconnect retries
 
@@ -644,6 +698,33 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                 return _local_metrics()
             return waiter.response
 
+        def _slo(self):
+            # like /metrics: the scoring counters the SLO objectives
+            # read live in the DRIVER process, so a worker's /slo does
+            # one exchange round-trip; link down / driver silent
+            # degrades to the worker-local monitor (its transport
+            # objectives still evaluate) instead of a 503
+            from ..core.slo import get_monitor
+            if not client.connected:
+                return get_monitor().report()
+            nonce = uuid.uuid4().hex
+            waiter = _Pending()
+            with plock:
+                mwaiters[nonce] = waiter
+            try:
+                client.send(CH_METRICS,
+                            {"op": "slo_req", "req": nonce},
+                            deadline_ms=5000)
+            except OSError:
+                with plock:
+                    mwaiters.pop(nonce, None)
+                return get_monitor().report()
+            if not waiter.event.wait(5.0):
+                with plock:
+                    mwaiters.pop(nonce, None)
+                return get_monitor().report()
+            return waiter.response
+
         def do_POST(self):
             if api_path not in ("/", self.path):
                 self.send_error(404)
@@ -661,6 +742,9 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                 pending[rid] = p
                 payloads[rid] = payload
             wstats.incr("parked")
+            tid = _payload_tid(rid, payload)
+            journal.emit("request_recv", rid=rid, tid=tid,
+                         worker=worker_id)
             # deadline propagation: a client-declared budget rides the
             # frame header so the driver can 504 dead work unscored
             dl = payload.get("_deadline_ms") \
@@ -670,7 +754,8 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                             {"op": "park", "rid": rid,
                              "payload": payload},
                             deadline_ms=dl if isinstance(
-                                dl, (int, float)) and dl > 0 else None)
+                                dl, (int, float)) and dl > 0 else None,
+                            tc={"tid": tid})
             except OSError:
                 # session closed for good; the wait below bounds the
                 # client's exposure (a mere blip queues the frame for
@@ -734,8 +819,12 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             if not client.connected:
                 continue
             try:
+                # the beacon names the fit span this process is inside
+                # (None outside training) — the trace reader can tie a
+                # worker's stats to the fit they served under
                 client.send(CH_STATS, {"op": "stats",
-                                       "snapshot": wstats.snapshot()})
+                                       "snapshot": wstats.snapshot(),
+                                       "fit": current_fit_span()})
             except OSError:
                 pass
 
@@ -830,9 +919,11 @@ class MultiprocessHTTPServer:
             on_message=self._on_transport_msg,
             on_session_lost=self._on_session_lost, name="exchange")
         self.queue: _TrackedQueue = _TrackedQueue()
-        # rid -> (session id, monotonic park time); the stamp bounds
-        # how long an orphaned route can leak (see _sweep_routes)
-        self._route: Dict[str, Tuple[str, float]] = {}
+        # rid -> (session id, monotonic park time, trace id); the stamp
+        # bounds how long an orphaned route can leak (_sweep_routes);
+        # the trace id lets the reply frame carry the request's trace
+        # context back through the worker hop
+        self._route: Dict[str, Tuple[str, float, str]] = {}
         self._acks: Dict[str, Tuple[_Pending, str]] = {}  # rid -> waiter
         self._lock = threading.Lock()
         self._slot_sid: Dict[int, str] = {}   # worker slot -> session id
@@ -846,6 +937,13 @@ class MultiprocessHTTPServer:
         for _k in ("worker_deaths", "worker_respawns"):
             self.stats.incr(_k, 0)
         self.worker_stats: Dict[int, dict] = {}
+        # worker slot -> monotonic instant of its last stats beacon (or
+        # scrape piggyback): the per-worker `worker_up` gauge ages from
+        # here, so a silent worker is visible from ONE scrape
+        self._beacon_seen: Dict[int, float] = {}
+        #: beacon age beyond which a worker's `worker_up` gauge reads 0
+        #: (3x the 1 s beacon period + slack)
+        self.beacon_stale_s = 4.0
         # the scoring engine installs its liveness check here; the
         # beacon thread broadcasts it to worker processes so their
         # /readyz reflects ENGINE readiness, not just link liveness
@@ -959,12 +1057,37 @@ class MultiprocessHTTPServer:
         topology: the driver's registry (scoring engine, train stats,
         this exchange's own counters) plus each worker's last-reported
         stats under ``ns="worker<N>"`` and their aggregate under
-        ``ns="workers"`` — what the worker-side ``/metrics`` route
-        serves after its exchange round-trip, so a single scrape of any
-        worker sees everything."""
+        ``ns="workers"``.  EVERY slot appears, beaconing or not: a
+        ``worker_up`` gauge (1 while the slot's beacons are fresh, 0
+        for a silent/dead/never-joined worker — ``_up`` suffix, so the
+        ``workers`` aggregate takes the MIN and one dark worker shows
+        there too) and a ``last_beacon_age_ms`` gauge make a silent
+        worker visible from ONE scrape instead of requiring a
+        dashboard diff against the slot count."""
+        from ..core.slo import get_monitor
+        get_monitor()   # slo families ride every topology scrape
+        now = time.monotonic()
         with self._lock:
-            per_worker = {w: dict(s)
-                          for w, s in self.worker_stats.items()}
+            # copy the gauges level too: the synthetic worker_up /
+            # beacon-age gauges are inserted below OUTSIDE the lock,
+            # and a shallow dict(s) would mutate the stored snapshot a
+            # concurrent scrape (HTTP thread vs transport pump) is
+            # iterating
+            per_worker = {
+                w: {**s, "gauges": dict(s.get("gauges") or {})}
+                for w, s in self.worker_stats.items()}
+            seen = dict(self._beacon_seen)
+        for w in range(len(self.addresses)):
+            snap = per_worker.setdefault(
+                w, {"rows": 0, "rows_per_s": 0.0, "counters": {},
+                    "gauges": {}, "stages": {}})
+            gauges = snap.setdefault("gauges", {})
+            age_s = (now - seen[w]) if w in seen else float("inf")
+            gauges["worker_up"] = \
+                1.0 if age_s <= self.beacon_stale_s else 0.0
+            gauges["last_beacon_age_ms"] = (
+                round(age_s * 1e3, 1) if age_s != float("inf")
+                else float("inf"))
         extra = {f"worker{w}": snap
                  for w, snap in sorted(per_worker.items())}
         if per_worker:
@@ -1018,6 +1141,12 @@ class MultiprocessHTTPServer:
                             "(exitcode %s); respawning", i, p.exitcode)
                 self.counters["worker_respawns"] += 1
                 self.stats.incr("worker_respawns")
+                # flight record BEFORE the respawn overwrites state:
+                # the journal tail + metrics + thread stacks at the
+                # moment the death was noticed are the post-mortem
+                record_flight("serving_worker_death",
+                              {"worker": i, "exitcode": p.exitcode,
+                               "pid": p.pid})
                 newp = self._make_proc(i)
                 self._procs[i] = newp
                 newp.start()
@@ -1040,8 +1169,13 @@ class MultiprocessHTTPServer:
                 if (deadline_ms and isinstance(payload, dict)
                         and "_deadline_ms" not in payload):
                     payload["_deadline_ms"] = deadline_ms
+                tid = str(rid)
+                if isinstance(payload, dict) \
+                        and payload.get("_trace_id"):
+                    tid = str(payload["_trace_id"])
                 with self._lock:
-                    self._route[rid] = (session.sid, time.monotonic())
+                    self._route[rid] = (session.sid, time.monotonic(),
+                                        tid)
                     self._parks += 1
                     if self._parks % self._SWEEP_EVERY == 0:
                         self._sweep_routes_locked()
@@ -1069,6 +1203,7 @@ class MultiprocessHTTPServer:
                 if w is not None and isinstance(msg.get("snapshot"),
                                                 dict):
                     self.worker_stats[w] = msg["snapshot"]
+                    self._beacon_seen[w] = time.monotonic()
         elif channel == CH_METRICS and op == "metrics_req":
             # a /metrics scrape hit this worker: fold its piggybacked
             # stats in, render the WHOLE topology (driver registry +
@@ -1078,6 +1213,7 @@ class MultiprocessHTTPServer:
                 w = session.meta.get("worker")
                 if w is not None and isinstance(msg.get("stats"), dict):
                     self.worker_stats[w] = msg["stats"]
+                    self._beacon_seen[w] = time.monotonic()
             try:
                 text = self.render_metrics()
             except Exception:  # noqa: BLE001 - scrape must degrade
@@ -1092,6 +1228,22 @@ class MultiprocessHTTPServer:
                                           "text": text}, timeout=2.0)
             except OSError:
                 pass   # dying link: the transport handles the purge
+        elif channel == CH_METRICS and op == "slo_req":
+            # a /slo probe hit a worker: evaluate the driver's monitor
+            # (the scoring counters live here) and answer
+            from ..core.slo import get_monitor
+            try:
+                report = get_monitor().report()
+            except Exception:  # noqa: BLE001 - probe must degrade
+                log.exception("serving: slo evaluation failed")
+                report = {"error": "slo evaluation failed"}
+            try:
+                session.send(CH_METRICS, {"op": "slo_json",
+                                          "req": msg.get("req"),
+                                          "report": report},
+                             timeout=2.0)
+            except OSError:
+                pass
 
     def _on_worker_hello(self, session, msg: dict) -> None:
         w = msg.get("worker")
@@ -1155,8 +1307,8 @@ class MultiprocessHTTPServer:
     def _purge_session(self, sid: str) -> None:
         """Drop every route and ack waiter still pointing at ``sid``."""
         with self._lock:
-            for r in [r for r, (s, _) in self._route.items()
-                      if s == sid]:
+            for r in [r for r, entry in self._route.items()
+                      if entry[0] == sid]:
                 self._route.pop(r, None)
             dead_acks = [r for r, (_, s) in self._acks.items()
                          if s == sid]
@@ -1172,7 +1324,8 @@ class MultiprocessHTTPServer:
         worker handler thread).  Called under ``self._lock``."""
         horizon = time.monotonic() - (2 * self._reply_timeout
                                       + self._sweep_grace)
-        stale = [r for r, (_, t) in self._route.items() if t < horizon]
+        stale = [r for r, entry in self._route.items()
+                 if entry[1] < horizon]
         for r in stale:
             del self._route[r]
         if stale:
@@ -1197,21 +1350,21 @@ class MultiprocessHTTPServer:
         return batch
 
     def _reply_session(self, rid: str):
-        """Pop the route for ``rid`` and return its live session, or
-        None.  A session that is down RIGHT NOW reports undelivered
-        immediately (the old fail-fast contract): if the worker is
-        merely mid-blip it re-parks the request on resume and the
-        engine scores it again — at-least-once scoring, with
-        exactly-once CLIENT delivery still decided atomically by the
-        socket owner."""
+        """Pop the route for ``rid`` and return ``(live session, trace
+        id)``, or ``(None, None)``.  A session that is down RIGHT NOW
+        reports undelivered immediately (the old fail-fast contract):
+        if the worker is merely mid-blip it re-parks the request on
+        resume and the engine scores it again — at-least-once scoring,
+        with exactly-once CLIENT delivery still decided atomically by
+        the socket owner."""
         with self._lock:
             entry = self._route.pop(rid, None)
         if entry is None:
-            return None
+            return None, None
         session = self._ts.sessions.get(entry[0])
         if session is None or not session.connected:
-            return None
-        return session
+            return None, None
+        return session, entry[2]
 
     def reply(self, request_id: str, response: Any,
               status: int = 200) -> bool:
@@ -1219,7 +1372,7 @@ class MultiprocessHTTPServer:
         on that worker's delivered/undelivered ack (the socket owner
         decides atomically, so a reply racing the worker-side timeout
         reports exactly what the client saw)."""
-        session = self._reply_session(request_id)
+        session, tid = self._reply_session(request_id)
         if session is None:
             return False
         waiter = _Pending()
@@ -1228,7 +1381,8 @@ class MultiprocessHTTPServer:
         try:
             session.send(CH_SCORING,
                          {"op": "reply", "rid": request_id,
-                          "response": response, "status": status})
+                          "response": response, "status": status},
+                         tc={"tid": tid})
         except OSError:
             # worker session closed between park and reply: undelivered
             with self._lock:
@@ -1246,7 +1400,7 @@ class MultiprocessHTTPServer:
         whole micro-batch instead of a blocking RTT per row."""
         waiting: List[Tuple[str, _Pending]] = []
         for rid, response, status in entries:
-            session = self._reply_session(rid)
+            session, tid = self._reply_session(rid)
             if session is None:
                 continue
             waiter = _Pending()
@@ -1255,7 +1409,8 @@ class MultiprocessHTTPServer:
             try:
                 session.send(CH_SCORING,
                              {"op": "reply", "rid": rid,
-                              "response": response, "status": status})
+                              "response": response, "status": status},
+                             tc={"tid": tid})
             except OSError:
                 with self._lock:
                     self._acks.pop(rid, None)

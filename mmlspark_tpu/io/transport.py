@@ -40,6 +40,15 @@ transport IS the fault boundary — SURVEY.md §3.4):
   reconnect (bounded exponential backoff, jittered) replays exactly the
   suffix the peer has not seen — the receiver drops duplicates by
   sequence number, so a link blip loses nothing and duplicates nothing.
+* **Trace context** (ISSUE 8) — ``send(..., tc={"tid": ...})`` attaches
+  a reserved ``_tc`` payload key carrying the trace id and the sender's
+  wall clock; both endpoints journal per-hop transport spans
+  (``hop_enqueue`` / ``hop_send`` / ``hop_ack`` on the sender,
+  ``hop_deliver`` with the send→recv clock offset on the receiver, a
+  ``retrans`` flag on replayed sends), so ``tools/trace_report.py`` can
+  stitch a scoring request's driver-side and worker-side spans into ONE
+  cross-process timeline.  The ``_tc`` key is stripped before the app's
+  ``on_message`` sees the payload.
 
 Telemetry: all endpoints share :data:`transport_stats` (registered
 under the ``transport`` namespace): ``frames_sent`` / ``frames_recvd``
@@ -71,7 +80,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..core.profiling import StageStats
-from ..core.telemetry import get_registry
+from ..core.telemetry import get_journal, get_registry
 
 log = logging.getLogger(__name__)
 
@@ -411,6 +420,12 @@ class Session:
         self._recv_seq = 0                  # highest contiguous seq seen
         self._since_ack = 0
         self._since_credit = 0
+        #: seq -> trace id for in-flight TRACED frames (bounded by the
+        #: replay buffer: entries drop when their seq is acked) and the
+        #: subset already wired once (a second wire write is a
+        #: retransmission, flagged on its hop_send span)
+        self._traced: Dict[int, str] = {}
+        self._traced_sent: set = set()
         #: highest seq actually written to the CURRENT link; the wire
         #: writer (``flush``) only ever writes ``_wired + 1`` next, so
         #: DATA frames hit the wire in strict sequence order no matter
@@ -488,13 +503,26 @@ class Session:
 
     def send(self, channel: int, obj: Any, *,
              deadline_ms: Optional[float] = None,
-             timeout: Optional[float] = None) -> int:
+             timeout: Optional[float] = None,
+             tc: Optional[Dict[str, Any]] = None) -> int:
         """Send one JSON message on ``channel``; returns its sequence
         number.  Blocks while credits are exhausted (a backpressure
         stall), raising :class:`Backpressure` past ``timeout``
         (default ``cfg.send_timeout_s``).  While the link is down the
         frame is queued in the replay buffer and goes out on resume;
-        a CLOSEd session refuses with :class:`TransportError`."""
+        a CLOSEd session refuses with :class:`TransportError`.
+
+        ``tc={"tid": trace_id}`` attaches the trace context as the
+        reserved ``_tc`` payload key (requires a dict ``obj``), stamps
+        the sender's wall clock into it, and journals ``hop_enqueue`` /
+        ``hop_send`` / ``hop_ack`` spans for this frame's life so the
+        trace reader can reconstruct the transport hop."""
+        tid = None
+        if tc is not None and isinstance(obj, dict):
+            tid = str(tc.get("tid") or "") or None
+        if tid:
+            obj = dict(obj)
+            obj["_tc"] = {"tid": tid, "sts": round(time.time(), 6)}
         payload = json.dumps(obj).encode("utf-8")
         if HEADER_BYTES + len(payload) > self.cfg.max_frame_bytes:
             raise FrameTooLarge(
@@ -525,6 +553,11 @@ class Session:
             abs_deadline = (time.monotonic() + deadline_ms / 1e3
                             if deadline_ms else None)
             self._unacked[seq] = (channel, payload, abs_deadline)
+            if tid:
+                self._traced[seq] = tid
+        if tid:
+            get_journal().emit("hop_enqueue", tid=tid, channel=channel,
+                               seq=seq, session=self.name)
         self.flush()
         return seq
 
@@ -561,9 +594,20 @@ class Session:
                     return n   # link died; resume re-flushes the rest
                 with self._cv:
                     self._wired = nxt
+                    tid = self._traced.get(nxt)
+                    retrans = tid is not None \
+                        and nxt in self._traced_sent
+                    if tid is not None:
+                        self._traced_sent.add(nxt)
                 self.last_send = time.monotonic()
                 transport_stats.incr("frames_sent")
                 transport_stats.incr("bytes_sent", len(frame))
+                if tid is not None:
+                    ev = {"tid": tid, "channel": channel, "seq": nxt,
+                          "session": self.name}
+                    if retrans:
+                        ev["retrans"] = 1
+                    get_journal().emit("hop_send", **ev)
                 n += 1
 
     def prepare_resume(self, peer_last: int) -> int:
@@ -584,13 +628,20 @@ class Session:
 
     def acknowledge(self, upto: int) -> None:
         """Peer confirmed everything ``<= upto``: drop it from the
-        replay buffer."""
+        replay buffer (and close any traced frames' hop spans)."""
+        acked_traced = []
         with self._cv:
             if upto <= self._peer_ack:
                 return
             self._peer_ack = upto
             while self._unacked and next(iter(self._unacked)) <= upto:
                 self._unacked.popitem(last=False)
+            for seq in [s for s in self._traced if s <= upto]:
+                acked_traced.append((seq, self._traced.pop(seq)))
+                self._traced_sent.discard(seq)
+        for seq, tid in acked_traced:
+            get_journal().emit("hop_ack", tid=tid, seq=seq,
+                               session=self.name)
 
     def grant(self, n: int) -> None:
         """Receive an incremental flow-control grant of ``n`` frames."""
@@ -641,6 +692,20 @@ class Session:
             except OSError:
                 pass
         obj = json.loads(payload.decode("utf-8"))
+        if isinstance(obj, dict) and "_tc" in obj:
+            # reserved trace-context key: strip it before the app sees
+            # the payload, journal the delivery hop with the send→recv
+            # wall-clock offset (network + skew — on one host, network)
+            tc = obj.pop("_tc")
+            if isinstance(tc, dict) and tc.get("tid"):
+                try:
+                    offset_ms = round(
+                        (time.time() - float(tc["sts"])) * 1e3, 3)
+                except (KeyError, TypeError, ValueError):
+                    offset_ms = None
+                get_journal().emit(
+                    "hop_deliver", tid=str(tc["tid"]), channel=channel,
+                    seq=seq, offset_ms=offset_ms, session=self.name)
         try:
             if self.on_message is not None:
                 try:
@@ -744,6 +809,8 @@ class Session:
             self._since_credit = 0
             self._wired = 0
             self._unacked.clear()
+            self._traced.clear()
+            self._traced_sent.clear()
             self._credits = credits
             self._cv.notify_all()
         transport_stats.incr("session_resets")
@@ -990,6 +1057,9 @@ class TransportServer:
                 self._dc_since.pop(session.sid, None)
             if resumed:
                 transport_stats.incr("resumes")
+                get_journal().emit("transport_resume",
+                                   session=session.name,
+                                   unacked=session.unacked_frames)
                 session.flush()   # retransmit the unseen suffix
             elif self.on_session is not None:
                 try:
@@ -1071,6 +1141,10 @@ class TransportClient:
         self._pump_thread: Optional[threading.Thread] = None
         self._ka_thread: Optional[threading.Thread] = None
         self._reconnecting = False
+        #: set by every dead pump; consumed by the reconnect loop — a
+        #: reconnect REQUEST must never be lost to the in-progress
+        #: guard (see _reconnect_loop)
+        self._reconnect_pending = False
         self._local_close = False
         _ensure_registered()
 
@@ -1086,9 +1160,10 @@ class TransportClient:
 
     def send(self, channel: int, obj: Any, *,
              deadline_ms: Optional[float] = None,
-             timeout: Optional[float] = None) -> int:
+             timeout: Optional[float] = None,
+             tc: Optional[Dict[str, Any]] = None) -> int:
         return self.session.send(channel, obj, deadline_ms=deadline_ms,
-                                 timeout=timeout)
+                                 timeout=timeout, tc=tc)
 
     def connect(self, *, retries: Optional[int] = None
                 ) -> "TransportClient":
@@ -1174,6 +1249,8 @@ class TransportClient:
             self.session.set_credits(credits)
             self.session.attach(sock)
             transport_stats.incr("resumes")
+            get_journal().emit("transport_resume", session=self.name,
+                               unacked=self.session.unacked_frames)
             self.session.flush()
         else:
             if had_state:
@@ -1241,29 +1318,60 @@ class TransportClient:
             time.sleep(step)
 
     def _reconnect_loop(self) -> None:
+        """Re-dial with bounded, jittered backoff.  Entry records a
+        reconnect REQUEST before the in-progress guard: a link that
+        dies milliseconds after a successful resume (a poisoned link
+        the chaos drill builds deliberately) has its pump call here
+        while the PREVIOUS loop is still unwinding past its dial — the
+        old guard silently dropped that request and the client never
+        reconnected again.  Now the running loop re-checks the pending
+        flag after every successful dial (and once more as it exits),
+        so a racing teardown always gets its redial."""
         with self._lock:
+            self._reconnect_pending = True
             if self._reconnecting or self.session.closed:
                 return
             self._reconnecting = True
         try:
-            for attempt in range(max(0, int(self.cfg.reconnect_tries))):
-                time.sleep(self._backoff(attempt))
-                if self.session.closed:
+            while True:
+                with self._lock:
+                    if self.session.closed \
+                            or not self._reconnect_pending:
+                        return
+                    self._reconnect_pending = False
+                redialed = False
+                for attempt in range(
+                        max(0, int(self.cfg.reconnect_tries))):
+                    time.sleep(self._backoff(attempt))
+                    if self.session.closed:
+                        return
+                    try:
+                        self._dial_once()
+                        transport_stats.incr("reconnects")
+                        redialed = True
+                        break
+                    except (OSError, ValueError):
+                        continue
+                if not redialed:
+                    log.warning("%s: reconnect budget exhausted; "
+                                "session down", self.name)
+                    self.session.close()
+                    if self.on_down is not None:
+                        try:
+                            self.on_down()
+                        except Exception:  # noqa: BLE001
+                            log.exception("%s: on_down failed",
+                                          self.name)
                     return
-                try:
-                    self._dial_once()
-                    transport_stats.incr("reconnects")
-                    return
-                except (OSError, ValueError):
-                    continue
-            log.warning("%s: reconnect budget exhausted; session down",
-                        self.name)
-            self.session.close()
-            if self.on_down is not None:
-                try:
-                    self.on_down()
-                except Exception:  # noqa: BLE001
-                    log.exception("%s: on_down failed", self.name)
+                # dialed: loop — if the new link already died, its pump
+                # set _reconnect_pending and the next pass redials
         finally:
             with self._lock:
                 self._reconnecting = False
+                retry = self._reconnect_pending \
+                    and not self.session.closed
+            if retry:
+                # a pump died between our last pending check and the
+                # guard release: process its request (bounded — each
+                # recursion consumes one pending request)
+                self._reconnect_loop()

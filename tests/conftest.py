@@ -21,6 +21,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# The crash flight recorder (core/telemetry.record_flight) defaults to
+# artifacts/ in the CWD; tests that exercise crash paths (chaos smoke,
+# injected fit failures) must not litter the repo's committed artifacts
+# directory, so point the default at a throwaway tmp dir.  Tests that
+# assert ON the recorder override this explicitly.
+if "MMLSPARK_TPU_FLIGHTREC_DIR" not in os.environ:
+    import tempfile
+
+    os.environ["MMLSPARK_TPU_FLIGHTREC_DIR"] = tempfile.mkdtemp(
+        prefix="flightrec_tests_")
+
 # Persistent XLA compilation cache: the suite is compile-bound on CPU
 # (every distinct fit shape jits a boost scan), and several tests spawn
 # fresh worker processes that would otherwise recompile identical

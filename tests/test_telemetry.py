@@ -578,11 +578,18 @@ class TestMetricsHTTPSingleProcess:
                 assert (("mmlspark_tpu_events_total",
                          frozenset({"ns": "scoring",
                                     "event": ev}.items())) in second)
-            # serving stage latencies are exposed
+            # serving stage latencies are exposed as histograms
             stages = {dict(lab).get("stage")
                       for (n, lab) in second
-                      if n == "mmlspark_tpu_stage_latency_seconds"}
+                      if n == "mmlspark_tpu_stage_latency_seconds_bucket"}
             assert {"decode", "score", "reply", "e2e"} <= stages
+            # every histogram carries the +Inf closing bucket
+            for (n, lab) in second:
+                if n != "mmlspark_tpu_stage_latency_seconds_bucket":
+                    continue
+                d = dict(lab)
+                assert second[(n, frozenset({**d, "le": "+Inf"}
+                                            .items()))] >= 0
         finally:
             eng.stop()
             srv.stop()
@@ -611,8 +618,19 @@ class TestMetricsHTTPMultiprocess:
             assert parsed[("mmlspark_tpu_rows_total", key)] >= 4
             stages = {dict(lab).get("stage")
                       for (n, lab) in parsed
-                      if n == "mmlspark_tpu_stage_latency_seconds"}
+                      if n == "mmlspark_tpu_stage_latency_seconds_bucket"}
             assert {"decode", "score", "reply"} <= stages
+            # ISSUE 8 satellite: every worker slot exposes an up-style
+            # gauge + beacon age, so a silent worker shows in 1 scrape
+            for w in ("worker0", "worker1", "workers"):
+                assert parsed[("mmlspark_tpu_gauge",
+                               frozenset({"ns": w,
+                                          "name": "worker_up"}
+                                         .items()))] == 1.0
+                assert (("mmlspark_tpu_gauge",
+                         frozenset({"ns": w,
+                                    "name": "last_beacon_age_ms"}
+                                   .items())) in parsed)
             # resilience counters (seeded zeros still present)
             for ev in ("shed", "expired", "salvaged", "restarted"):
                 assert (("mmlspark_tpu_events_total",
@@ -710,3 +728,167 @@ class TestToolArtifactSchema:
         assert rc == 0
         out = capsys.readouterr().out
         assert "fit span=abc complete=True" in out
+
+
+# ------------------------------------------------------- ISSUE 8: histograms
+
+
+class TestMergeableHistograms:
+    def test_bucket_exposition_is_cumulative_and_parses(self):
+        """_bucket rows carry le labels with CUMULATIVE counts closed
+        by +Inf — the Prometheus histogram contract."""
+        s = StageStats()
+        t = s.timer("score")
+        for v in (0.0011, 0.0012, 0.004, 0.5):
+            t.record(v)
+        parsed = parse_prometheus(
+            render_prometheus({"ns1": s.snapshot()}))
+        buckets = {
+            dict(lab)["le"]: v for (n, lab), v in parsed.items()
+            if n == "mmlspark_tpu_stage_latency_seconds_bucket"}
+        assert buckets["+Inf"] == 4
+        finite = sorted((float(le), c) for le, c in buckets.items()
+                        if le != "+Inf")
+        counts = [c for _, c in finite]
+        assert counts == sorted(counts)          # cumulative
+        assert counts[-1] <= buckets["+Inf"]
+        key = frozenset({"ns": "ns1", "stage": "score"}.items())
+        assert parsed[("mmlspark_tpu_stage_latency_seconds_count",
+                       key)] == 4
+        assert parsed[("mmlspark_tpu_stage_latency_seconds_sum",
+                       key)] == pytest.approx(0.5063, abs=1e-3)
+
+    def test_two_source_merge_is_exact(self):
+        """ISSUE 8 satellite: cross-worker percentile aggregation is
+        EXACT — merging two workers' snapshots yields bit-identical
+        p50/p99 to a single accumulator that saw every sample (the
+        sample-ring design could not legally combine worker p99s)."""
+        import random
+
+        from mmlspark_tpu.core.profiling import LatencyStats
+        rng = random.Random(7)
+        a, b, combined = LatencyStats(), LatencyStats(), LatencyStats()
+        # deliberately skewed: worker a fast, worker b slow — the old
+        # max-of-p99s bound is wrong in BOTH directions for p50
+        for _ in range(400):
+            v = rng.uniform(0.0005, 0.002)
+            a.record(v)
+            combined.record(v)
+        for _ in range(100):
+            v = rng.uniform(0.05, 0.4)
+            b.record(v)
+            combined.record(v)
+        merged = merge_snapshots(
+            [{"stages": {"e2e": a.snapshot()}},
+             {"stages": {"e2e": b.snapshot()}}])["stages"]["e2e"]
+        want = combined.snapshot()
+        assert merged["p50_ms"] == want["p50_ms"]
+        assert merged["p99_ms"] == want["p99_ms"]
+        assert merged["count"] == want["count"] == 500
+        assert merged["buckets"] == want["buckets"]
+        # and the old conservative fallback still applies to sources
+        # without buckets (hand-built dicts, version-skewed beacons)
+        legacy = merge_snapshots(
+            [{"stages": {"x": {"count": 1, "total_s": 0.1,
+                               "p50_ms": 7.0, "p99_ms": 9.0}}},
+             {"stages": {"x": {"count": 1, "total_s": 0.2,
+                               "p50_ms": 5.0, "p99_ms": 11.0}}}])
+        assert legacy["stages"]["x"]["p99_ms"] == 11.0
+        # MIXED bucketed+bucketless sources drop the partial bucket
+        # set entirely: rendering it under the full count would show
+        # the bucketless samples as +Inf (>300s) outliers
+        mixed = merge_snapshots(
+            [{"stages": {"x": a.snapshot()}},
+             {"stages": {"x": {"count": 1000, "total_s": 1.0,
+                               "p50_ms": 1.0, "p99_ms": 2.0}}}])
+        assert "buckets" not in mixed["stages"]["x"]
+        assert mixed["stages"]["x"]["count"] == 1400
+
+
+# --------------------------------------------------- ISSUE 8: journal mirror
+
+
+class TestJournalRotation:
+    def test_mirror_rotates_at_cap_without_losing_records(self,
+                                                          tmp_path):
+        path = str(tmp_path / "mirror.jsonl")
+        j = EventJournal(capacity=64)
+        j.configure(path, max_bytes=4096)
+        for i in range(300):
+            j.emit("ev", i=i, pad="x" * 40)
+        j.configure(None)
+        assert os.path.exists(path + ".1"), "no rotation happened"
+        assert os.path.getsize(path) <= 4096 + 256
+        cur = read_journal(path)
+        prev = read_journal(path + ".1")
+        both = prev + cur
+        assert both, "both mirror generations empty"
+        # the rotation boundary loses nothing: .1 tail and current head
+        # are seq-contiguous, and the newest record is the last emit
+        # (in .1 when the final emit itself triggered the rotation)
+        seqs = [e["seq"] for e in both]
+        assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+        assert both[-1]["i"] == 299
+        # every record is pid-stamped for cross-process merges
+        assert all(e["pid"] == os.getpid() for e in both)
+
+    def test_dump_is_readable_after_emit(self, tmp_path):
+        j = EventJournal(capacity=8)
+        j.emit("a")
+        path = str(tmp_path / "d.jsonl")
+        assert j.dump(path) == 1          # fsync'd dump
+        assert read_journal(path)[0]["ev"] == "a"
+
+
+# ----------------------------------------------- ISSUE 8: docs drift guard
+
+
+class TestMetricFamilyDocGuard:
+    def _rendered_names(self):
+        """Families + sample names from a REPRESENTATIVE exposition:
+        a stage histogram, counters, gauges, rows, and the SLO monitor
+        families."""
+        from mmlspark_tpu.core.slo import SLOMonitor
+        reg = MetricsRegistry()
+        s = StageStats()
+        s.incr("shed", 0)
+        s.set_gauge("depth", 1.0)
+        s.timer("score").record(0.002)
+        s.add_rows(1)
+        reg.register("scoring", s)
+        mon = SLOMonitor(registry=reg)
+        reg.register_exposition("slo", mon.render_prometheus)
+        text = reg.render_prometheus()
+        families = set(re.findall(r"^# TYPE (\S+) \S+$", text,
+                                  re.MULTILINE))
+        samples = set(re.findall(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)\{", text,
+                                 re.MULTILINE))
+        return families, samples, text
+
+    def test_every_rendered_family_is_documented(self):
+        """Tier-1 guard (ISSUE 8 satellite): the exposition and
+        docs/observability.md cannot drift — every family rendered by
+        render_prometheus (including the SLO provider families) must be
+        named in the doc, and every mmlspark_tpu_* name the doc claims
+        must actually be rendered."""
+        doc = open(os.path.join(REPO, "docs",
+                                "observability.md")).read()
+        families, samples, text = self._rendered_names()
+        assert families, "representative exposition rendered nothing"
+        missing = sorted(f for f in families if f not in doc)
+        assert not missing, (
+            f"metric families rendered but undocumented in "
+            f"docs/observability.md: {missing}")
+        # reverse direction: names the doc claims must exist (prefix
+        # mentions like `mmlspark_tpu_slo_` are fine; concrete names
+        # must be a rendered family or a derived sample name)
+        claimed = {t for t in re.findall(r"mmlspark_tpu_[a-z0-9_]+",
+                                         doc)
+                   if not t.endswith("_")}
+        known = families | samples
+        for fam in families:
+            known |= {f"{fam}_bucket", f"{fam}_sum", f"{fam}_count"}
+        stale = sorted(c for c in claimed if c not in known)
+        assert not stale, (
+            f"docs/observability.md documents names that are not "
+            f"rendered: {stale}")

@@ -449,6 +449,16 @@ def main():
     correctness = check_correctness(b, X)
     print("correctness:", correctness, flush=True)
 
+    # SLO burn-rate monitor (ISSUE 8): sample the registry through the
+    # whole bench so the artifact carries "was the error budget being
+    # burned" next to the raw goodput numbers.  Windows are scaled to
+    # the bench duration (the production defaults are 60 s / 300 s).
+    from mmlspark_tpu.core.slo import SLOMonitor, set_monitor
+    slo_monitor = set_monitor(SLOMonitor(
+        fast_window_s=max(2.0, args.duration / 4),
+        slow_window_s=max(8.0, args.duration)))
+    slo_monitor.start(tick_s=0.5)
+
     detail = {"correctness_bit_exact": correctness,
               "model": {"trees": len(b.trees), "num_leaves": 31,
                         "features": int(X.shape[1])},
@@ -473,6 +483,12 @@ def main():
         detail["http_threads"] = scenario_http_threads(b, X, args)
         print(json.dumps(detail["http_threads"]), flush=True)
 
+    slo_monitor.stop()
+    slo_report = slo_monitor.report()
+    print("slo:", json.dumps({"healthy": slo_report["healthy"],
+                              "breaching": slo_report["breaching"]}),
+          flush=True)
+
     gkey = f"goodput_slo{args.slo_ms:g}ms_rows_per_s"
     result = {
         "metric": "serving_slo_goodput_rows_per_sec",
@@ -481,6 +497,10 @@ def main():
         "vs_baseline": detail["open_jit"]["ratio_slo_goodput"],
         "accept_ratio_ge_3": detail["open_jit"]["ratio_slo_goodput"] >= 3.0,
         "telemetry": telemetry_block(),
+        # burn-rate verdict over the whole bench: pass/fail context for
+        # the goodput number (a bench that "won" while torching its
+        # error budget did not win)
+        "slo": slo_report,
         "detail": detail,
     }
     print(json.dumps({k: v for k, v in result.items() if k != "detail"}),

@@ -25,15 +25,20 @@ Run: ``python tools/chaos_serving.py --out artifacts/chaos_serving_r06.json``
 """
 
 import argparse
+import glob
 import http.client
 import json
 import os
 import socket
 import sys
+import tempfile
 import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import trace_report  # noqa: E402  (tools/ sibling, not a package)
 
 OUTCOMES = ("ok", "wrong", "bad_request", "server_error", "shed",
             "expired", "conn_error", "timeout", "other")
@@ -283,11 +288,35 @@ def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np
 
+    from mmlspark_tpu.core import telemetry
+    from mmlspark_tpu.core.slo import SLOMonitor, set_monitor
     from mmlspark_tpu.gbdt import LightGBMRegressor
     from mmlspark_tpu.io.chaos import (ChaosPlan, ChaosPredictor,
                                        kill_process)
     from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
     from mmlspark_tpu.io.serving import MultiprocessHTTPServer
+
+    # cross-process tracing (ISSUE 8): every worker process mirrors its
+    # journal into this directory, so after the drill the driver's and
+    # workers' journals merge into ONE per-request timeline
+    journal_dir = tempfile.mkdtemp(prefix="chaos_serving_journals_")
+    os.environ[telemetry.JOURNAL_DIR_ENV] = journal_dir
+    # flight records from the drill's INTENDED kills land next to the
+    # journals (not in the repo's artifacts/); the artifact records the
+    # paths so the post-mortem chain is auditable.  Pre-existing
+    # records in an inherited directory must not satisfy the
+    # flight_recorder_dumped verdict, so snapshot what's already there.
+    os.environ.setdefault(telemetry.FLIGHTREC_DIR_ENV, journal_dir)
+    flightrec_dir = os.environ[telemetry.FLIGHTREC_DIR_ENV]
+    preexisting_flightrecs = set(glob.glob(
+        os.path.join(flightrec_dir, "flightrec_*.json")))
+
+    # SLO burn-rate monitor: sampled through the chaos and clean
+    # phases; the artifact embeds its verdict (the chaos phase SHOULD
+    # burn — shed/expired are injected — and the monitor must see it)
+    slo_monitor = set_monitor(SLOMonitor(fast_window_s=5.0,
+                                         slow_window_s=30.0))
+    slo_monitor.start(tick_s=0.5)
 
     rng = np.random.default_rng(args.seed)
     X = rng.normal(size=(256, 8)).astype(np.float32)
@@ -379,6 +408,28 @@ def main():
         detail["clean"] = clean.snapshot()
         print(json.dumps(detail["clean"]), flush=True)
 
+        # ---- phase C2: one TRACED request (ISSUE 8 acceptance) -------
+        # a client-chosen trace id rides the payload through worker →
+        # driver → worker; both processes journal its hops, and the
+        # merged journals must reconstruct one cross-process timeline
+        print("== traced request ==", flush=True)
+        trace_tid = telemetry.new_trace_id()
+        traced_ok = False
+        if recovered:
+            addrs = [a for a in srv.addresses if a]
+            body = json.dumps({"features": X[0].tolist(),
+                               "_trace_id": trace_tid}).encode()
+            try:
+                status, value = post_once(addrs[0], body,
+                                          args.client_timeout)
+                traced_ok = (status == 200 and value is not None
+                             and float(value) == float(want[0]))
+            except (ConnectionError, socket.timeout, OSError):
+                traced_ok = False
+        detail["traced_request"] = {"trace_id": trace_tid,
+                                    "answered_exact": traced_ok}
+        time.sleep(1.5)   # let reply hop_ack + worker journal flush
+
         snap = engine.stats_snapshot()
         detail["engine_counters"] = snap["counters"]
         detail["engine_rows"] = snap["rows"]
@@ -389,6 +440,47 @@ def main():
     finally:
         engine.stop()
         srv.stop()
+        slo_monitor.stop()
+
+    # ---- cross-process trace timeline (ISSUE 8 acceptance) -------
+    # merge the driver's in-memory journal with every worker's JSONL
+    # mirror and reconstruct the traced request's single timeline:
+    # worker request_recv → park hops → driver form/decode/score/reply
+    # → reply hops → worker request_reply, across ≥2 pids
+    worker_journals = sorted(glob.glob(
+        os.path.join(journal_dir, "journal_*.jsonl")))
+    merged = trace_report.load_events(
+        list(telemetry.get_journal().events()) + worker_journals)
+    timeline = trace_report.request_timeline(merged, trace_tid)
+    trace_report.print_request(timeline)
+    detail["trace_timeline"] = {
+        "trace_id": trace_tid,
+        "journals_merged": 1 + len(worker_journals),
+        "pids": timeline["pids"],
+        "cross_process": timeline["cross_process"],
+        "hops": len(timeline["hops"]),
+        "retransmits": timeline["retransmits"],
+        "complete": timeline["complete"],
+        "events": timeline["events"],
+    }
+
+    # flight records from the chaos phase (the worker SIGKILL triggers
+    # the driver supervisor's dump): the self-contained post-mortems —
+    # only the ones THIS drill produced count
+    flightrecs = sorted(
+        p for p in glob.glob(os.path.join(flightrec_dir,
+                                          "flightrec_*.json"))
+        if p not in preexisting_flightrecs)
+    detail["flight_records"] = [os.path.basename(p) for p in flightrecs]
+
+    # SLO burn-rate verdict: the drill's pass/fail context — the chaos
+    # phase burns budget BY DESIGN (injected shed/expired/kills); what
+    # must hold is that the monitor measured every objective
+    slo_report = slo_monitor.report()
+    detail["slo"] = slo_report
+    print("slo:", json.dumps({"healthy": slo_report["healthy"],
+                              "breaching": slo_report["breaching"]}),
+          flush=True)
 
     # ---- phase D: transport-level chaos (ISSUE 6) ----------------
     print("== transport drill ==", flush=True)
@@ -418,6 +510,28 @@ def main():
         "counters_exposed": all(
             k in detail["engine_counters"]
             for k in ("shed", "expired", "salvaged", "restarted")),
+        # ISSUE 8: the merged driver+worker journals reconstruct ONE
+        # cross-process timeline for the traced request, transport hop
+        # spans included
+        "traced_request_answered":
+            detail["traced_request"]["answered_exact"],
+        "trace_cross_process_timeline":
+            detail["trace_timeline"]["complete"]
+            and detail["trace_timeline"]["cross_process"]
+            and detail["trace_timeline"]["hops"] >= 1,
+        # the SLO monitor MEASURED the drill: every objective present,
+        # and the scoring objectives (which definitely saw traffic)
+        # produced real windowed burn numbers — `in`-style key checks
+        # would pass vacuously on a monitor that sampled nothing (the
+        # burn levels themselves are context, not a gate: chaos burns
+        # budget by design)
+        "slo_evaluated": bool(slo_report["objectives"])
+        and slo_report["objectives"]["scoring_goodput"]
+        ["burn_rate_slow"] is not None
+        and slo_report["objectives"]["scoring_shed"]
+        ["burn_rate_slow"] is not None,
+        # the worker SIGKILL left a self-contained flight record behind
+        "flight_recorder_dumped": len(detail["flight_records"]) >= 1,
         **transport_verdicts,
     }
     result = {
@@ -430,6 +544,13 @@ def main():
     print(json.dumps({"verdicts": verdicts,
                       "pass": bool(all(verdicts.values()))}),
           flush=True)
+    if not all(verdicts.values()):
+        # a failed drill is exactly what the flight recorder is for:
+        # freeze the journal tail, metrics and stacks with the verdicts
+        path = telemetry.record_flight(
+            "chaos_serving_verdict_failure",
+            {"verdicts": {k: bool(v) for k, v in verdicts.items()}})
+        print(f"flight record -> {path}", flush=True)
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:

@@ -40,11 +40,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _worker_env():
+def _worker_env(workdir=None):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if workdir:
+        # flight records from the drill's INTENDED kills (fit_failed /
+        # peer_lost dumps) belong next to the drill's logs, not in the
+        # repo's committed artifacts/
+        env.setdefault("MMLSPARK_TPU_FLIGHTREC_DIR", workdir)
     return env
 
 
@@ -76,7 +81,7 @@ def spawn_worker(pid, port, workdir, phase, attempt, *, ckpt="",
     # round; files also keep the failure diagnostics
     log_path = os.path.join(workdir, f"log_{phase}_{attempt}_p{pid}.txt")
     with open(log_path, "w") as log_fh:
-        return subprocess.Popen(cmd, env=_worker_env(),
+        return subprocess.Popen(cmd, env=_worker_env(workdir),
                                 stdout=log_fh,
                                 stderr=subprocess.STDOUT, text=True)
 
@@ -307,7 +312,21 @@ def main():
 
     print("== phase 4: transport heartbeat chaos (ISSUE 6) ==",
           flush=True)
+    # SLO burn-rate context (ISSUE 8): phase 4 runs watchdogs and the
+    # transport IN THIS process, so the heartbeat-freshness and
+    # transport-retransmit objectives are live — sample them through
+    # the phase and embed the verdict
+    from mmlspark_tpu.core.slo import SLOMonitor, set_monitor
+    slo_monitor = set_monitor(SLOMonitor(fast_window_s=2.0,
+                                         slow_window_s=8.0))
+    slo_monitor.start(tick_s=0.25)
     transport_verdicts, transport_detail = transport_heartbeat_drill()
+    slo_monitor.stop()
+    slo_report = slo_monitor.report()
+    detail["slo"] = slo_report
+    print("slo:", json.dumps({"healthy": slo_report["healthy"],
+                              "breaching": slo_report["breaching"]}),
+          flush=True)
     detail["transport_heartbeats"] = transport_detail
     print(json.dumps(transport_verdicts), flush=True)
     detail["total_wall_s"] = round(time.time() - t_all, 1)
@@ -360,6 +379,16 @@ def main():
             for s in kill_last.values()
             for k in ("chunks_replayed", "ckpt_resumed",
                       "ckpt_discarded")),
+        # ISSUE 8: the SLO monitor MEASURED the in-process transport-
+        # heartbeat phase — the objectives live there (watchdog gauges
+        # + transport counters) must have produced real windowed burn
+        # numbers, not just rendered their keys (burn levels are
+        # context; the drill's own verdicts gate correctness)
+        "slo_evaluated": bool(slo_report["objectives"])
+        and slo_report["objectives"]["heartbeat_freshness"]
+        ["burn_rate_slow"] is not None
+        and slo_report["objectives"]["transport_retransmit"]
+        ["burn_rate_slow"] is not None,
         **transport_verdicts,
     }
     result = {
@@ -375,6 +404,12 @@ def main():
     print(json.dumps({"verdicts": verdicts,
                       "pass": bool(all(verdicts.values()))}, indent=1),
           flush=True)
+    if not all(verdicts.values()):
+        from mmlspark_tpu.core.telemetry import record_flight
+        path = record_flight(
+            "chaos_training_verdict_failure",
+            {"verdicts": {k: bool(v) for k, v in verdicts.items()}})
+        print(f"flight record -> {path}", flush=True)
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:

@@ -1,20 +1,30 @@
 """Trace-report reader: reconstruct per-request and per-fit timelines
-from an :class:`mmlspark_tpu.core.telemetry.EventJournal` JSONL dump
-(ISSUE 5).
+from :class:`mmlspark_tpu.core.telemetry.EventJournal` JSONL dumps
+(ISSUE 5), including CROSS-PROCESS timelines merged from driver and
+worker journals (ISSUE 8).
 
 The serving engine journals per-BATCH pipeline events
 (``form``/``decode``/``score``/``reply``, plus
 ``shed``/``expired``/``salvage``) carrying the batch's request ids and
-trace ids; the training engine journals per-FIT events (``fit_begin``,
-``boost_chunk``, ``ckpt_saved``/``ckpt_resumed``/``ckpt_discarded``,
-``chunk_replayed``, ``peer_stalled``/``peer_lost``, ``fit_end``) stamped
-with a fit span id.  This tool stitches either kind back into a
+trace ids; the transport journals per-hop spans (``hop_enqueue`` /
+``hop_send`` / ``hop_ack`` sender-side, ``hop_deliver`` with the
+send→recv clock offset receiver-side, a ``retrans`` flag on replayed
+sends); the multiprocess serving worker journals ``request_recv`` /
+``request_reply`` where the client socket lives; the training engine
+journals per-FIT events (``fit_begin``, ``boost_chunk``,
+``ckpt_saved``/``ckpt_resumed``/``ckpt_discarded``,
+``chunk_replayed``, ``peer_stalled``/``peer_lost``, ``fit_end``)
+stamped with a fit span id.  This tool stitches any of it back into a
 timeline:
 
 * :func:`request_timeline` — given a trace id (the client's
   ``_trace_id`` payload key, or the request id minted at admission),
-  find the request's batch events and order them: a complete scored
-  request shows ``form → decode → score → reply``.
+  find the request's events across every journal handed in and order
+  them: a complete scored request on the multiprocess topology shows
+  ``request_recv → hop_enqueue/hop_send → hop_deliver → form → decode
+  → score → reply → hop_enqueue/hop_send → hop_deliver →
+  request_reply`` spanning both processes (``cross_process`` reports
+  how many pids contributed).
 * :func:`fit_timeline` — given a fit span id (or the newest fit in the
   journal), order everything stamped with it.
 
@@ -23,9 +33,12 @@ CLI::
     python tools/trace_report.py JOURNAL.jsonl [more.jsonl ...] \
         [--trace-id TID] [--fit SPAN | --fit latest]
 
-Multiple journal files (e.g. one per controller of a gang) are merged
-and ordered by ``(ts, seq)`` — ``seq`` is process-monotonic, ``ts`` is
-wall clock, so cross-process order is as honest as the hosts' clocks.
+Multiple journal files (e.g. the driver's plus each worker's
+``MMLSPARK_TPU_JOURNAL_DIR`` mirror, or one per controller of a gang)
+are merged and ordered by ``(ts, seq)`` — ``seq`` is
+process-monotonic, ``ts`` is wall clock, so cross-process order is as
+honest as the hosts' clocks (the ``hop_deliver`` ``offset_ms`` field
+carries the measured send→recv skew for exactly that reason).
 """
 
 import argparse
@@ -38,6 +51,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 #: the serving pipeline stages a fully-served request passes through
 REQUEST_STAGES = ("form", "decode", "score", "reply")
+
+#: per-hop transport span events (single ``tid`` field, not the batch
+#: ``trace_ids`` list)
+HOP_EVENTS = ("hop_enqueue", "hop_send", "hop_ack", "hop_deliver")
+
+#: worker-process bookend events of a multiprocess request
+WORKER_EVENTS = ("request_recv", "request_reply")
 
 
 def load_events(paths) -> List[dict]:
@@ -56,9 +76,10 @@ def load_events(paths) -> List[dict]:
 
 def _resolve_rid(events: Iterable[dict], trace_id: str) -> str:
     """Map a trace id to its request id via any batch event that
-    carries both aligned lists; a trace id that never appears is
-    assumed to BE the rid (the minted-at-admission default, where the
-    two are the same string)."""
+    carries both aligned lists (or a worker bookend event carrying
+    both scalar fields); a trace id that never appears is assumed to
+    BE the rid (the minted-at-admission default, where the two are the
+    same string)."""
     for e in events:
         tids = e.get("trace_ids") or []
         if trace_id in tids:
@@ -66,31 +87,46 @@ def _resolve_rid(events: Iterable[dict], trace_id: str) -> str:
             i = tids.index(trace_id)
             if i < len(rids):
                 return str(rids[i])
+        if e.get("tid") == trace_id and e.get("rid"):
+            return str(e["rid"])
     return trace_id
 
 
 def request_timeline(events: Iterable[dict], trace_id: str) -> dict:
-    """Reconstruct one request's pipeline timeline.
+    """Reconstruct one request's pipeline timeline across every
+    journal handed in (driver + workers).
 
     Returns ``{"trace_id", "rid", "events": [...], "stages": [...],
+    "hops": [...], "pids": [...], "cross_process": bool,
     "complete": bool}`` — ``complete`` means the full
     form→decode→score→reply chain was observed (a shed/expired request
     is legitimately incomplete and shows its degradation event
-    instead)."""
+    instead); ``hops`` is the subset of per-hop transport spans,
+    ``retransmits`` counts replayed sends among them, and
+    ``cross_process`` is True when more than one pid contributed
+    events — the stitched driver+worker view."""
     events = list(events)
     rid = _resolve_rid(events, trace_id)
+    ids = {trace_id, rid}
     mine: List[dict] = []
     for e in events:
-        if rid in (e.get("rids") or []) \
-                or trace_id in (e.get("trace_ids") or []):
+        if ids & set(e.get("rids") or []) \
+                or ids & set(e.get("trace_ids") or []) \
+                or e.get("tid") in ids or e.get("rid") in ids:
             mine.append(e)
     mine.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
     stages = [e.get("ev") for e in mine]
+    hops = [e for e in mine if e.get("ev") in HOP_EVENTS]
+    pids = sorted({e["pid"] for e in mine if e.get("pid") is not None})
     return {
         "trace_id": trace_id,
         "rid": rid,
         "events": mine,
         "stages": stages,
+        "hops": hops,
+        "retransmits": sum(1 for e in hops if e.get("retrans")),
+        "pids": pids,
+        "cross_process": len(pids) > 1,
         "complete": all(s in stages for s in REQUEST_STAGES),
     }
 
@@ -129,17 +165,23 @@ def fit_timeline(events: Iterable[dict],
 
 def _fmt_event(e: dict, t0: float) -> str:
     extras = {k: v for k, v in e.items()
-              if k not in ("ts", "seq", "ev", "rids", "trace_ids")}
+              if k not in ("ts", "seq", "ev", "rids", "trace_ids",
+                           "pid")}
     nrows = len(e.get("rids") or [])
     if nrows:
         extras["batch"] = nrows
     tail = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
-    return f"  +{e.get('ts', t0) - t0:9.3f}s  {e.get('ev', '?'):14s} {tail}"
+    pid = f"[{e['pid']:>7}] " if e.get("pid") is not None else ""
+    return (f"  +{e.get('ts', t0) - t0:9.3f}s  {pid}"
+            f"{e.get('ev', '?'):14s} {tail}")
 
 
 def print_request(report: dict) -> None:
     print(f"request trace_id={report['trace_id']} rid={report['rid']} "
-          f"complete={report['complete']}")
+          f"complete={report['complete']} "
+          f"cross_process={report.get('cross_process', False)} "
+          f"hops={len(report.get('hops') or [])} "
+          f"retransmits={report.get('retransmits', 0)}")
     evs = report["events"]
     t0 = evs[0].get("ts", 0.0) if evs else 0.0
     for e in evs:
