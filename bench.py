@@ -179,6 +179,11 @@ def run_bench(args, n, f, iters, leaves, result):
             {"features": X, "label": y})
         our_times.append(time.perf_counter() - t0)
     our_time = min(our_times)
+    # provenance: the RESOLVED histogram kernel + collective the fit ran
+    # (compile probes may have downgraded the requested method) — the
+    # bench artifact must say which kernel produced the number
+    from mmlspark_tpu.gbdt import engine as _engine
+    result["detail"].update(_engine.last_fit_info)
     out = model.transform({"features": X, "label": y})
     our_auc = roc_auc_score(y, np.asarray(out["probability"])[:, 1])
     log(f"ours: {our_time:.2f}s (runs: "

@@ -146,8 +146,16 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
     histogramMethod = Param("histogramMethod",
                             "TPU histogram backend: auto, dot16, onehot, "
                             "segment, pallas, pallas_bf16, pallas_fused (segment "
-                            "gather fused in-kernel)", default="auto",
+                            "gather fused in-kernel), pallas_ring (gather + "
+                            "histogram + cross-shard ring reduce in one "
+                            "kernel)", default="auto",
                             typeConverter=TypeConverters.toString)
+    collective = Param("collective",
+                       "Cross-shard histogram reduction on mesh fits: "
+                       "auto, psum (XLA all-reduce) or ring (Pallas "
+                       "on-chip ring reduce-scatter/all-gather; "
+                       "docs/collectives.md)", default="auto",
+                       typeConverter=TypeConverters.toString)
     categoricalSlotIndexes = Param(
         "categoricalSlotIndexes",
         "Feature indexes treated as categorical (reference "
@@ -235,6 +243,7 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
             skip_drop=self.getSkipDrop(),
             drop_seed=self.getDropSeed(),
             histogram_method=self.getHistogramMethod(),
+            collective=self.getCollective(),
             verbosity=self.getVerbosity(),
             parallelism=self.getParallelism(),
             top_k=self.getTopK(),

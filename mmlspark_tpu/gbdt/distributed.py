@@ -43,19 +43,9 @@ from .grower import (GrowerConfig, TreeArrays, _grow_tree_impl,
 from .objectives import Objective
 
 
-def _shard_map(f, mesh, in_specs, out_specs, check_vma=False):
-    """Version-portable ``shard_map``: newer jax exposes ``jax.shard_map``
-    with a ``check_vma`` kwarg; older releases ship it as
-    ``jax.experimental.shard_map.shard_map`` with the same check under
-    the ``check_rep`` name.  Every mesh path routes through this one
-    shim so a jax upgrade/downgrade is a one-line event, not a broken
-    distributed subsystem."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=check_vma)
-    from jax.experimental.shard_map import shard_map as _sm
-    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-               check_rep=check_vma)
+from ..core.mesh import shard_map_compat as _shard_map  # noqa: E402
+# (the shim lives in core.mesh so the ops-layer ring collectives can
+#  share it without an ops -> gbdt import inversion)
 
 
 VALID_PARALLELISM = ("serial", "data", "feature", "data+feature", "voting")
@@ -81,13 +71,41 @@ def resolve_mesh(parallelism: str, mesh: Optional[Mesh] = None) -> Mesh:
     return Mesh(arr, (DATA_AXIS, FEATURE_AXIS))
 
 
+def data_only_mesh(mesh: Mesh) -> Mesh:
+    """The same devices on a SINGLE-named-axis ``(data,)`` mesh.
+
+    The Pallas ring collectives (ops/pallas_collectives.py) require
+    exactly one named mesh axis — both for Mosaic's LOGICAL device-id
+    lowering along the ring and for the interpret-mode DMA discharge,
+    which rejects multi-axis environments.  Only meaningful for pure
+    data-parallel layouts (feature axis of size 1); raises otherwise."""
+    if _feat_n(mesh) != 1:
+        raise ValueError(
+            "ring collectives need a pure data-parallel layout; "
+            f"mesh has a feature axis of size {_feat_n(mesh)}")
+    devs = np.asarray(mesh.devices).reshape(-1)
+    return Mesh(devs, (DATA_AXIS,))
+
+
+def _feat_n(mesh: Mesh) -> int:
+    """Feature-axis size, 1 when the mesh is data-only (ring layout)."""
+    return int(dict(mesh.shape).get(FEATURE_AXIS, 1))
+
+
+def _f_ax(mesh: Mesh):
+    """FEATURE_AXIS when the mesh carries one, else None — so the same
+    PartitionSpecs build against both 2-axis and data-only meshes."""
+    return FEATURE_AXIS if FEATURE_AXIS in dict(mesh.shape) else None
+
+
 def _sharded_cfg(mesh: Mesh, cfg: GrowerConfig) -> GrowerConfig:
     data_n = int(mesh.shape[DATA_AXIS])
-    feat_n = int(mesh.shape[FEATURE_AXIS])
+    feat_n = _feat_n(mesh)
     return GrowerConfig(**{
         **cfg.__dict__,
         "axis_name": DATA_AXIS if data_n > 1 else None,
         "feature_axis_name": FEATURE_AXIS if feat_n > 1 else None,
+        "data_axis_size": data_n,
     })
 
 
@@ -186,11 +204,12 @@ def make_goss_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
                          else P(None, DATA_AXIS, None))
     else:
         val_hist_spec = P(None, None) if K == 1 else P(None, None, None)
+    fa = _f_ax(mesh)
     mapped = _shard_map(
         steps, mesh=mesh,
-        in_specs=(P(DATA_AXIS, FEATURE_AXIS), sc_spec, P(DATA_AXIS),
+        in_specs=(P(DATA_AXIS, fa), sc_spec, P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), P(None, None),
-                  P(None, FEATURE_AXIS, None),
+                  P(None, fa, None),
                   P(DATA_AXIS, None), sc_spec),
         out_specs=(P(), sc_spec, sc_spec, val_hist_spec),
         check_vma=False)
@@ -259,11 +278,12 @@ def make_boost_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig, lr: float,
 
     bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
     val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
+    fa = _f_ax(mesh)
     mapped = _shard_map(
         steps, mesh=mesh,
-        in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+        in_specs=(P(DATA_AXIS, fa), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), bag_spec,
-                  P(None, FEATURE_AXIS, None),
+                  P(None, fa, None),
                   P(DATA_AXIS, None), P(DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), val_hist_spec),
         check_vma=False)
@@ -319,11 +339,12 @@ def make_multiclass_scan(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
 
     bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
     val_hist_spec = P(None, DATA_AXIS, None) if has_val else P(None, None)
+    fa = _f_ax(mesh)
     mapped = _shard_map(
         steps, mesh=mesh,
-        in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS, None),
+        in_specs=(P(DATA_AXIS, fa), P(DATA_AXIS, None),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), bag_spec,
-                  P(None, FEATURE_AXIS, None),
+                  P(None, fa, None),
                   P(DATA_AXIS, None), P(DATA_AXIS, None)),
         out_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS, None),
                    val_hist_spec),
@@ -378,7 +399,7 @@ def make_dart_step(mesh: Mesh, obj: Objective, cfg: GrowerConfig,
     path — dropout bookkeeping is tiny host metadata, only the fit and
     the scoring ride the mesh."""
     cfg = _sharded_cfg(mesh, cfg)
-    fshard = int(mesh.shape[FEATURE_AXIS]) > 1
+    fshard = _feat_n(mesh) > 1
     K = num_class
 
     def step(bins, binsT, s_minus, labels, weights, bag, fi):
@@ -425,7 +446,7 @@ def make_tree_predict(mesh: Mesh, num_leaves: int, num_class: int = 1):
     (grower.predict_tree_binned_fshard — the scoring analog of the
     feature-parallel split-column broadcast).  ``num_class > 1`` scores
     one dart iteration's K stacked trees to (n, K)."""
-    fshard = int(mesh.shape[FEATURE_AXIS]) > 1
+    fshard = _feat_n(mesh) > 1
     if fshard:
         def walk(tree, bins):
             return predict_tree_binned_fshard(tree, bins, num_leaves,
@@ -548,14 +569,15 @@ def make_ranking_scan(mesh: Mesh, cfg: GrowerConfig, lr: float,
 
     val_hist_spec = P(None, DATA_AXIS) if has_val else P(None, None)
     bag_spec = P(None, DATA_AXIS) if bag_sharded else P(None, None)
+    fa = _f_ax(mesh)
     mapped = _shard_map(
         steps, mesh=mesh,
-        in_specs=(P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+        in_specs=(P(DATA_AXIS, fa), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS, None, None),
                   P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
                   P(DATA_AXIS, None, None), P(DATA_AXIS, None),
                   P(None, None), bag_spec,
-                  P(None, FEATURE_AXIS, None),
+                  P(None, fa, None),
                   P(DATA_AXIS, None), P(DATA_AXIS)),
         out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), val_hist_spec),
         check_vma=False)
@@ -583,7 +605,7 @@ def prepare_arrays_from_shards(bins_shards, label_shards, weight_shards,
     (rp = total pad rows across shards).
     """
     D = int(mesh.shape[DATA_AXIS])
-    fn = int(mesh.shape[FEATURE_AXIS])
+    fn = _feat_n(mesh)
     if len(bins_shards) != D:
         raise ValueError(
             f"need exactly one shard slot per data-mesh slice: got "
@@ -647,7 +669,7 @@ def prepare_arrays_from_shards(bins_shards, label_shards, weight_shards,
         return jax.make_array_from_callback(shape, sh, cb)
 
     lab_dtype = np.int32 if num_class > 1 else np.float32
-    bins_d = make(P(DATA_AXIS, FEATURE_AXIS), bin_dtype, 0,
+    bins_d = make(P(DATA_AXIS, _f_ax(mesh)), bin_dtype, 0,
                   lambda d: bins_shards[d], width=f_padded)
     lab_d = make(P(DATA_AXIS), lab_dtype, 0,
                  lambda d: np.asarray(label_shards[d], lab_dtype))
@@ -689,7 +711,7 @@ def prepare_arrays(bins: np.ndarray, labels: np.ndarray, weights: np.ndarray,
     from ..core.mesh import pad_to_multiple
     n, f = bins.shape
     dn = int(mesh.shape[DATA_AXIS])
-    fn = int(mesh.shape[FEATURE_AXIS])
+    fn = _feat_n(mesh)
     rp = pad_to_multiple(n, dn) - n
     fp = pad_to_multiple(f, fn) - f
     if rp:
@@ -705,7 +727,7 @@ def prepare_arrays(bins: np.ndarray, labels: np.ndarray, weights: np.ndarray,
 
     bins_d = jax.device_put(
         jnp.asarray(bins),   # dtype preserved (uint8 when B <= 256)
-        NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS)))
+        NamedSharding(mesh, P(DATA_AXIS, _f_ax(mesh))))
     lab_d = jax.device_put(
         jnp.asarray(labels, jnp.int32 if num_class > 1 else jnp.float32),
         NamedSharding(mesh, P(DATA_AXIS)))

@@ -34,13 +34,62 @@ from .objectives import Objective, MulticlassObjective
 
 
 def _resolve_hist_method(method: str) -> str:
-    """pallas_fused compile-probe resolution, imported lazily: pallas
-    (+ Mosaic) must not become an eager dependency of every gbdt import
-    when the method is never requested."""
-    if method != "pallas_fused":
+    """pallas_fused / pallas_ring compile-probe resolution, imported
+    lazily: pallas (+ Mosaic) must not become an eager dependency of
+    every gbdt import when the method is never requested.  The probe
+    verdicts are cached process-wide per (backend, method), so repeated
+    fits never re-probe (ops.pallas_histogram.probe_cached)."""
+    if method not in ("pallas_fused", "pallas_ring"):
         return method
     from ..ops.pallas_histogram import resolve_histogram_method
     return resolve_histogram_method(method)
+
+
+def _resolve_collective_cfg(params: "TrainParams", mesh, *,
+                            ranking: bool = False):
+    """Resolve ``params.collective`` → ``("psum"|"ring", mesh)``.
+
+    "auto" stays on psum until an on-chip A/B flips the default
+    (tools/tpu_session.sh queues one).  "ring" requires a pure
+    data-parallel multi-shard layout on a path whose scans support the
+    data-only mesh (gbdt/goss/rf/multiclass — not ranking, dart or
+    voting), plus a Mosaic compile probe on accelerator backends; it
+    degrades to psum with a log line otherwise.  On success the mesh is
+    rebuilt SINGLE-AXIS (``distributed.data_only_mesh``): the Pallas
+    ring kernels — and their interpret-mode discharge, which rejects
+    multi-axis environments — ring over exactly one named axis."""
+    if params.collective in ("auto", "psum", "") or mesh is None:
+        return "psum", mesh
+    if params.collective != "ring":
+        raise ValueError(f"Unknown collective {params.collective!r}; "
+                         "valid: auto, psum, ring")
+    from ..core.mesh import DATA_AXIS
+    from .distributed import _feat_n, data_only_mesh
+    d = int(mesh.shape[DATA_AXIS])
+    if (d <= 1 or _feat_n(mesh) > 1 or ranking
+            or params.boosting == "dart"
+            or params.parallelism == "voting"):
+        log.info("collective='ring' needs a multi-shard pure "
+                 "data-parallel gbdt/goss/rf fit; this fit keeps psum")
+        return "psum", mesh
+    from ..ops.pallas_collectives import resolve_collective
+    resolved = resolve_collective("ring", d)
+    if resolved == "ring":
+        return "ring", data_only_mesh(mesh)
+    return "psum", mesh
+
+
+#: What the LAST fit in this process actually ran (resolved histogram
+#: kernel + collective + backend) — bench.py records it for provenance,
+#: and the /metrics exposition below surfaces it as an info gauge.
+last_fit_info: Dict[str, str] = {}
+
+
+def _record_fit_resolution(cfg, collective: str) -> None:
+    last_fit_info.clear()
+    last_fit_info.update(histogram_method=cfg.hist_method,
+                         collective=collective,
+                         backend=jax.default_backend())
 
 log = logging.getLogger("mmlspark_tpu.gbdt")
 
@@ -81,6 +130,12 @@ class TrainParams:
     #: PV-Tree voting: features voted per shard (LightGBM top_k)
     top_k: int = 20
     histogram_method: str = "auto"
+    #: cross-shard histogram reduction on mesh fits: "auto" (psum until
+    #: an on-chip A/B flips it), "psum", or "ring" — the Pallas on-chip
+    #: ring reduce-scatter/all-gather (ops/pallas_collectives.py;
+    #: docs/collectives.md).  Ring fits run on a data-only 1-axis mesh
+    #: and degrade to psum wherever the kernel gates refuse.
+    collective: str = "auto"
     #: pack four uint8 bins per u32 word for the per-split segment gather
     #: (grower.GrowerConfig.packed_gather); measured knob, default off
     packed_gather: bool = False
@@ -224,6 +279,25 @@ del _k
 _tm.get_registry().register("train", train_stats)
 
 
+def _fit_resolution_exposition() -> str:
+    """Prometheus info gauge naming the RESOLVED histogram kernel and
+    collective the last fit in this process ran — so /metrics answers
+    "which kernel is training actually using" without log spelunking."""
+    if not last_fit_info:
+        return ""
+    labels = ",".join(f'{k}="{v}"' for k, v in sorted(
+        last_fit_info.items()))
+    name = "mmlspark_tpu_train_histogram_method_info"
+    return (f"# HELP {name} Resolved histogram kernel/collective of the "
+            "last fit\n"
+            f"# TYPE {name} gauge\n"
+            f"{name}{{{labels}}} 1\n")
+
+
+_tm.get_registry().register_exposition("train_histogram_method",
+                                       _fit_resolution_exposition)
+
+
 def _ckpt_event(name: str, **fields) -> None:
     """Journal a checkpoint lifecycle event, stamped with the current
     fit span so ``tools/trace_report.py`` can place it on the fit's
@@ -239,7 +313,8 @@ _MONITOR_LOSS_MAX_ROWS = 65536
 
 def _monitor_chunk(it0: int, it1: int, dt_s: float, n_rows: int, K: int,
                    hist_method: str, objective=None, scores=None,
-                   labels=None, weights=None) -> None:
+                   labels=None, weights=None,
+                   collective: str = "none") -> None:
     """Per-boost-chunk live training telemetry: ms/tree, rows/s,
     last-iteration and (when the objective can compute it cheaply)
     train-loss gauges on ``train_stats``, plus one ``boost_chunk``
@@ -283,7 +358,7 @@ def _monitor_chunk(it0: int, it1: int, dt_s: float, n_rows: int, K: int,
     ev = {"fit": _tm.current_fit_span(), "it_start": int(it0),
           "it_end": int(it1), "ms_per_tree": round(ms_per_tree, 3),
           "rows_per_s": round(rows_per_s, 1),
-          "hist_method": hist_method}
+          "hist_method": hist_method, "collective": collective}
     if loss is not None:
         ev["train_loss"] = round(float(loss), 6)
     _tm.get_journal().emit("boost_chunk", **ev)
@@ -532,7 +607,7 @@ def _ckpt_fingerprint_mesh(n, f, K, params, labels, bins, w,
     (stored in each state file, validated locally, and made unanimous
     by the gang gate in ``_train_distributed``)."""
     import hashlib
-    from ..core.mesh import DATA_AXIS, FEATURE_AXIS
+    from ..core.mesh import DATA_AXIS
     if shard_data is not None:
         sizes = list(shard_data["sizes"])
         y_cat = np.concatenate(
@@ -551,8 +626,9 @@ def _ckpt_fingerprint_mesh(n, f, K, params, labels, bins, w,
     else:
         base = _ckpt_fingerprint(n, f, K, params, labels, bins, w,
                                  init_scores)
+    from .distributed import _feat_n
     topo = (f"|mesh={int(mesh.shape[DATA_AXIS])}x"
-            f"{int(mesh.shape[FEATURE_AXIS])}"
+            f"{_feat_n(mesh)}"
             f"|procs={jax.process_count()}")
     return hashlib.sha256((base + topo).encode("utf-8")).hexdigest()
 
@@ -1288,6 +1364,8 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
         if params.boost_from_average and init_scores is None else 0.0
 
     use_voting = params.parallelism == "voting"
+    collective, mesh = _resolve_collective_cfg(
+        params, mesh, ranking=ranking_info is not None)
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
         num_bins=mapper.num_total_bins, lambda_l1=params.lambda_l1,
@@ -1296,11 +1374,13 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
         min_gain_to_split=params.min_gain_to_split,
         hist_method=_resolve_hist_method(params.histogram_method),
         packed_gather=params.packed_gather,
+        collective=collective,
         voting_k=params.top_k if use_voting else 0,
         use_categorical=mapper.has_categorical,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
         max_cat_threshold=params.max_cat_threshold,
         max_cat_to_onehot=params.max_cat_to_onehot)
+    _record_fit_resolution(cfg, collective)
 
     if params.boosting not in ("gbdt", "goss", "dart", "rf"):
         raise NotImplementedError(
@@ -1960,6 +2040,8 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
     init = objective.init_score(y_global, w_global) \
         if params.boost_from_average and init_scores is None else 0.0
 
+    collective, mesh = _resolve_collective_cfg(
+        params, mesh, ranking=ranking_info is not None)
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
         num_bins=mapper.num_total_bins, lambda_l1=params.lambda_l1,
@@ -1968,11 +2050,13 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         min_gain_to_split=params.min_gain_to_split,
         hist_method=_resolve_hist_method(params.histogram_method),
         packed_gather=params.packed_gather,
+        collective=collective,
         voting_k=params.top_k if params.parallelism == "voting" else 0,
         use_categorical=mapper.has_categorical,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
         max_cat_threshold=params.max_cat_threshold,
         max_cat_to_onehot=params.max_cat_to_onehot)
+    _record_fit_resolution(cfg, collective)
 
     from .budget import check_fit_budget
     f_sh = next(b.shape[1] for b in bins_shards if b is not None)
@@ -2602,9 +2686,10 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     # original feature id and a feature-sharded mesh would split bundles,
     # so both are excluded; voting's shard-local vote scan likewise.
     efb_dev_m, efb_host_m = None, None
+    from .distributed import _feat_n as _feat_shards
     if params.enable_bundle and not mapper.has_categorical \
             and mapper.num_total_bins <= 256 \
-            and int(mesh.shape[FEATURE_AXIS]) == 1 \
+            and _feat_shards(mesh) == 1 \
             and cfg.voting_k == 0 and not use_goss_m \
             and shard_data is None:  # EFB plans need the full host matrix
         efb_dev_m, efb_host_m, bundled = _build_efb(
@@ -2887,7 +2972,7 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         # addressable on any one controller), so train loss is skipped
         # rather than gathered
         _monitor_chunk(it, it + C, time.perf_counter() - t_chunk, n, K,
-                       cfg.hist_method)
+                       cfg.hist_method, collective=cfg.collective)
         stop = False
         if has_val:
             vh = np.asarray(val_hist)[:, :nv]    # drop val pad rows

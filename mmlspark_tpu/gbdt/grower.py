@@ -119,6 +119,15 @@ class GrowerConfig:
     voting_k: int = 0
     axis_name: Optional[str] = None          # data-parallel psum axis
     feature_axis_name: Optional[str] = None  # feature-parallel axis
+    #: cross-shard histogram reduction: "psum" (XLA all-reduce) or
+    #: "ring" (Pallas on-chip ring reduce-scatter/all-gather,
+    #: ops/pallas_collectives.py).  Resolved by the engine at config
+    #: build (resolve_collective); "ring" requires a data-only 1-axis
+    #: mesh and silently degrades to psum where the kernel gates refuse.
+    collective: str = "psum"
+    #: static size of the data mesh axis (the ring kernels need it at
+    #: trace time; 1 = serial).  Set by distributed._sharded_cfg.
+    data_axis_size: int = 1
     #: categorical split finding (LightGBM Fisher-grouping analog); static
     #: so the no-categorical compile pays zero cost for the extra machinery
     use_categorical: bool = False
@@ -364,6 +373,19 @@ def _is_voting(cfg: GrowerConfig) -> bool:
     return cfg.axis_name is not None and cfg.voting_k > 0
 
 
+def _reduce_hist(h, cfg: GrowerConfig):
+    """Cross-shard reduction of a local histogram: ``lax.psum`` or the
+    on-chip Pallas ring (ops/pallas_collectives.py) per
+    ``cfg.collective``.  The ring entry is trace-safe — it consults only
+    the cached Mosaic verdict and falls back to psum when the kernel is
+    unavailable or the VMEM gate refuses the state."""
+    if cfg.collective == "ring" and cfg.data_axis_size > 1:
+        from ..ops.pallas_collectives import ring_allreduce_or_psum
+        return ring_allreduce_or_psum(h, cfg.axis_name,
+                                      cfg.data_axis_size)
+    return jax.lax.psum(h, cfg.axis_name)
+
+
 def _hist(bins, gh, cfg: GrowerConfig, efb: Optional[EFBArrays] = None):
     h = compute_histogram(bins, gh, cfg.num_bins, method=cfg.hist_method)
     if efb is not None:
@@ -375,7 +397,7 @@ def _hist(bins, gh, cfg: GrowerConfig, efb: Optional[EFBArrays] = None):
     if cfg.axis_name is not None and not _is_voting(cfg):
         # voting mode keeps histograms shard-local; only the voted
         # candidate slices are ever reduced (find_best_split_voting)
-        h = jax.lax.psum(h, cfg.axis_name)
+        h = _reduce_hist(h, cfg)
     return h
 
 
@@ -556,8 +578,8 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
                                     cfg.num_bins)
         if fused is not None:
             return fused
-    if (cfg.hist_method == "pallas_fused" and binsT is not None
-            and cfg.num_bins <= 256):
+    if (cfg.hist_method in ("pallas_fused", "pallas_ring")
+            and binsT is not None and cfg.num_bins <= 256):
         from ..ops.pallas_histogram import (FUSED_MAX_ROWS,
                                             fused_compile_supported,
                                             histogram_pallas_fused)
@@ -616,6 +638,63 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
     branch = jnp.searchsorted(jnp.asarray(sizes, jnp.int32), cnt,
                               side="left")
     return jax.lax.switch(branch, [make(s) for s in sizes], 0)
+
+
+def _segment_hist_dist(bins, gh, row_order, off, cnt, n, sizes,
+                       cfg: GrowerConfig, bins_pk=None, binsT=None):
+    """Distributed segment histogram: returns ``(hist, reduced)`` where
+    ``reduced`` is a STATIC bool — True when the cross-shard reduction
+    already happened inside the kernel.
+
+    With ``hist_method='pallas_ring'`` under a ring collective, the
+    whole gather→histogram→ring-allreduce runs as ONE Pallas kernel
+    (ops/pallas_collectives.fused_segment_hist_ring): the bucket is
+    chosen from the GLOBAL max segment count (``pmax``) so every shard
+    enters the same ``lax.switch`` branch — a collective may never live
+    in a branch shards could disagree on — and the kernel overlaps the
+    ICI transfer of finished histogram chunks with the MXU accumulation
+    of the next.  Anything the static gates refuse falls back to the
+    local :func:`_segment_hist` with the reduction applied by the
+    caller."""
+    use_fused_ring = (
+        cfg.collective == "ring" and cfg.hist_method == "pallas_ring"
+        and cfg.axis_name is not None and not _is_voting(cfg)
+        and cfg.data_axis_size > 1 and binsT is not None
+        and cfg.num_bins <= 256)
+    if use_fused_ring:
+        from ..ops.pallas_collectives import (fused_ring_applicable,
+                                              fused_ring_compile_supported,
+                                              fused_segment_hist_ring)
+        import jax as _jax
+        interp = _jax.default_backend() not in ("tpu", "axon")
+        # probe=False: only the cached Mosaic verdict is consulted under
+        # the trace (the engine probes at config-build time)
+        if (fused_ring_applicable(binsT.shape[0], n, cfg.num_bins,
+                                  cfg.data_axis_size)
+                and fused_ring_compile_supported(interp, probe=False)
+                is not False):
+            f_out = bins.shape[1]
+            cnt_g = jax.lax.pmax(cnt, cfg.axis_name)
+
+            def make_f(size):
+                def fn(_):
+                    seg = jax.lax.dynamic_slice(row_order, (off,), (size,))
+                    valid = jnp.arange(size, dtype=jnp.int32) < cnt
+                    rows = jnp.minimum(seg, n - 1)
+                    gh_sub = jnp.take(gh, rows, axis=0) * \
+                        valid.astype(jnp.float32)[:, None]
+                    return fused_segment_hist_ring(
+                        binsT, gh_sub, rows, cfg.num_bins, size,
+                        cfg.axis_name, cfg.data_axis_size,
+                        interpret=interp)[:f_out]
+                return fn
+
+            branch = jnp.searchsorted(jnp.asarray(sizes, jnp.int32),
+                                      cnt_g, side="left")
+            return jax.lax.switch(branch, [make_f(s) for s in sizes],
+                                  0), True
+    return _segment_hist(bins, gh, row_order, off, cnt, n, sizes, cfg,
+                         bins_pk=bins_pk, binsT=binsT), False
 
 
 def _leaf_of_position(leaf_start, leaf_cnt, n):
@@ -731,11 +810,17 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
     if binsT is None:
         binsT = bins.T
     binsT_hist = binsT
-    if cfg.hist_method == "pallas_fused":
-        # pad the feature axis to the kernel's 8-feature fold ONCE per
-        # grow — a per-call jnp.pad inside the split loop would copy the
-        # whole (f, n) matrix at every segment histogram
-        fp8 = (-binsT.shape[0]) % 8
+    if cfg.hist_method in ("pallas_fused", "pallas_ring"):
+        # pad the feature axis to the kernel's fold ONCE per grow — a
+        # per-call jnp.pad inside the split loop would copy the whole
+        # (f, n) matrix at every segment histogram.  The ring-fused
+        # kernel additionally needs one chunk of feature blocks per
+        # device, so it pads to 8 * data_axis_size.
+        mult = 8
+        if (cfg.hist_method == "pallas_ring"
+                and cfg.collective == "ring" and cfg.data_axis_size > 1):
+            mult = 8 * cfg.data_axis_size
+        fp8 = (-binsT.shape[0]) % mult
         if fp8:
             binsT_hist = jnp.pad(binsT, ((0, fp8), (0, 0)))
     bins_pk = None
@@ -858,16 +943,19 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
                     use_right = cnt_r_p <= cnt_l_p
                 child_off = jnp.where(use_right, off + cnt_l_p, off)
                 child_cnt = jnp.where(use_right, cnt_r_p, cnt_l_p)
-                hist_small = _segment_hist(bins, gh, row_order, child_off,
-                                           child_cnt, n, sizes, cfg,
-                                           bins_pk=bins_pk,
-                                           binsT=binsT_hist)
+                hist_small, reduced = _segment_hist_dist(
+                    bins, gh, row_order, child_off, child_cnt, n, sizes,
+                    cfg, bins_pk=bins_pk, binsT=binsT_hist)
                 if efb is not None:
+                    # expansion is linear, so it commutes with the
+                    # reduction — safe whether the fused ring already
+                    # reduced or the psum below still will
                     hist_small = _efb_expand(hist_small, efb)
-                if cfg.axis_name is not None and not _is_voting(cfg):
+                if (not reduced and cfg.axis_name is not None
+                        and not _is_voting(cfg)):
                     # voting keeps per-leaf histograms local; only voted
                     # candidate slices are reduced inside _find_split
-                    hist_small = jax.lax.psum(hist_small, cfg.axis_name)
+                    hist_small = _reduce_hist(hist_small, cfg)
                 parent_hist = state.leaf_hist[l]
                 hist_r = jnp.where(use_right, hist_small,
                                    parent_hist - hist_small)

@@ -44,9 +44,45 @@ GH_CHANNELS = 3  # grad, hess, count
 _SWEEP_CACHE: dict = {}
 
 
+def _sanitize_sweep(doc: dict) -> Optional[dict]:
+    """Winner table with 0.0-clamped readings refused.
+
+    A slope that clamps to 0.0 means the measurement sat below the
+    dispatch-noise floor (tools/sweep_histogram.py) — the method may be
+    the fastest or pure noise, so it must never be RANKED.  A winner
+    entry is kept only when its own reading at that bucket is present
+    and strictly positive AND no other exact method at the bucket is
+    0.0-clamped (an unmeasurable rival means the ranking itself is
+    unresolved).  Refused buckets fall out of the table, so
+    :func:`_auto_method` falls back to the nearest larger resolved
+    bucket / the backend default — exactly the committed
+    ``_sweep_tpu.json`` artifacts (``pallas: 0.0`` at 2048, ``dot16:
+    0.0`` at 4096/8192) demand."""
+    winners = doc.get("winner_by_rows") or {}
+    times = doc.get("times_us_by_rows") or {}
+    out = {}
+    for rows, method in winners.items():
+        t = times.get(rows)
+        if t is None:
+            # no raw readings recorded (hand-built table): trust it
+            out[rows] = method
+            continue
+        win_t = t.get(method)
+        if win_t is None or win_t <= 0.0:
+            continue
+        rivals = [v for k, v in t.items()
+                  if k != method and k in ("segment", "dot16", "onehot",
+                                           "pallas") and v is not None]
+        if any(v <= 0.0 for v in rivals):
+            continue
+        out[rows] = method
+    return out or None
+
+
 def _load_sweep(backend: str) -> Optional[dict]:
     """Measured winner-by-rows table for this backend (see
-    tools/sweep_histogram.py), or None if never swept."""
+    tools/sweep_histogram.py), sanitized against 0.0-clamped noise
+    artifacts, or None if never swept."""
     if backend == "axon":  # tunneled TPU: same silicon, same table
         backend = "tpu"
     if backend not in _SWEEP_CACHE:
@@ -57,7 +93,7 @@ def _load_sweep(backend: str) -> Optional[dict]:
         table = None
         try:
             with open(path) as fh:
-                table = json.load(fh).get("winner_by_rows") or None
+                table = _sanitize_sweep(json.load(fh))
         except (OSError, ValueError):
             pass
         _SWEEP_CACHE[backend] = table
@@ -224,7 +260,9 @@ def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         must already be zeroed.
       num_bins: static bin count B.
       method: "segment" | "dot16" | "onehot" | "pallas" | "pallas_bf16"
-        | "auto".
+        | "auto" (plus the fused variants "pallas_fused" and
+        "pallas_ring", which behave like "pallas" here — their fusion
+        lives in the grower's segment path / ring collective).
 
     Returns:
       ``(f, num_bins, 3)`` float32 histogram.
@@ -241,10 +279,12 @@ def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         return _hist_dot16(bins, gh, num_bins, row_chunk)
     if method == "onehot":
         return _hist_onehot(bins, gh, num_bins, row_chunk)
-    if method in ("pallas", "pallas_bf16", "pallas_fused"):
-        # 'pallas_fused' fuses the SEGMENT gather (grower._segment_hist);
-        # direct full-matrix calls like the root histogram have nothing
-        # to gather and run the plain kernel
+    if method in ("pallas", "pallas_bf16", "pallas_fused", "pallas_ring"):
+        # 'pallas_fused' fuses the SEGMENT gather (grower._segment_hist)
+        # and 'pallas_ring' additionally fuses the cross-shard ring
+        # reduction (ops/pallas_collectives.py); direct full-matrix
+        # calls like the root histogram have nothing to gather/reduce
+        # and run the plain kernel
         from .pallas_histogram import BMAX, histogram_pallas
         if num_bins > BMAX:   # kernel folds 16x16 nibbles; fall back
             return _hist_dot16(bins, gh, num_bins, row_chunk)

@@ -198,13 +198,44 @@ def histogram_pallas_fused(binsT, gh_sub, idx, num_bins: int, size: int,
     return hist[:f, :num_bins, :]
 
 
+#: Process-wide Mosaic-compile verdicts, keyed (backend, kernel name):
+#: None/absent = not yet probed, True/False = probe outcome.  One probe
+#: per (backend, method) per process — repeated fits (and the ring
+#: kernels in ops/pallas_collectives.py) consult the cache instead of
+#: re-compiling the probe.
+_COMPILE_CACHE: dict = {}
+
 #: Cached Mosaic-compile verdict for the fused kernel on this process's
 #: backend: None = not yet probed, True/False = probe outcome.  The
 #: in-kernel ``jnp.take`` row gather has only ever run in CPU interpret
 #: mode (ADVICE r5); Mosaic's lowering of arbitrary dynamic gathers may
 #: fail on the very hardware the kernel targets, and
 #: ``histogram_method=pallas_fused`` must degrade, not hard-fail.
+#: (Kept as the authoritative slot for ``pallas_fused`` — tests reset it
+#: to None to force a re-probe; ``_COMPILE_CACHE`` mirrors it.)
 _FUSED_COMPILE_OK: Optional[bool] = None
+
+
+def probe_cached(method: str, probe_fn, probe: bool = True
+                 ) -> Optional[bool]:
+    """Run ``probe_fn`` ONCE per (backend, method) per process and cache
+    whether it raised.  ``probe=False`` returns only the cached verdict
+    (``None`` = unknown) without touching the device — safe under a
+    trace.  Shared by the fused-histogram and ring-collective kernels."""
+    key = (jax.default_backend(), method)
+    if key not in _COMPILE_CACHE:
+        if not probe:
+            return None
+        try:
+            probe_fn()
+            _COMPILE_CACHE[key] = True
+        except Exception as e:  # noqa: BLE001 - Mosaic/XLA compile error
+            log.warning(
+                "pallas kernel %r failed to compile on backend %s "
+                "(%s: %s); callers fall back", method, key[0],
+                type(e).__name__, e)
+            _COMPILE_CACHE[key] = False
+    return _COMPILE_CACHE[key]
 
 
 def fused_compile_supported(interpret: bool = False,
@@ -243,14 +274,31 @@ def fused_compile_supported(interpret: bool = False,
 
 
 def resolve_histogram_method(method: str) -> str:
-    """Downgrade ``'pallas_fused'`` to ``'pallas'`` when the fused
-    kernel does not compile on this backend (one probe per process).
-    Every other method passes through untouched.  Called by the GBDT
-    engine at config-build time — i.e. OUTSIDE jit — so the fused branch
-    inside the traced grower only ever consults the cached verdict."""
+    """Downgrade a Pallas method whose kernel does not compile on this
+    backend (one probe per (backend, method) per process —
+    :func:`probe_cached`): ``'pallas_ring'`` → ``'pallas_fused'`` →
+    ``'pallas'``.  Every other method passes through untouched.  Called
+    by the GBDT engine at config-build time — i.e. OUTSIDE jit — so the
+    fused branches inside the traced grower only ever consult the cached
+    verdicts."""
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    if method == "pallas_ring":
+        # the ring FUSION is probed separately; when it fails, the
+        # segment gather still fuses (pallas_fused) and the reduction
+        # degrades to ring_allreduce_or_psum in the grower
+        from .pallas_collectives import fused_ring_compile_supported
+        if not fused_ring_compile_supported(interpret):
+            method = "pallas_fused"
+        else:
+            # pallas_ring's NON-ring call sites (gate-refused buckets,
+            # psum fits sharing the method string) ride the PLAIN fused
+            # kernel — probe it too, so the traced gates consult a real
+            # verdict instead of sailing past an unprobed None and
+            # hard-failing inside jit
+            fused_compile_supported(interpret)
+            return method
     if method != "pallas_fused":
         return method
-    interpret = jax.default_backend() not in ("tpu", "axon")
     if fused_compile_supported(interpret):
         return method
     return "pallas"

@@ -81,6 +81,26 @@ def rng():
 
 
 @pytest.fixture(scope="session")
+def mesh2():
+    """2-device DATA-ONLY mesh over the forced host platform — the ring
+    collective's layout (ops/pallas_collectives.py needs exactly one
+    named axis for the interpret-mode DMA discharge), and the mesh the
+    ISSUE-10 bit-parity contract is pinned on (at D=2 a ring's pairwise
+    adds commute with psum's, so forests must match BITWISE)."""
+    from jax.sharding import Mesh
+    from mmlspark_tpu.core.mesh import DATA_AXIS
+    return Mesh(np.asarray(jax.devices()[:2]), (DATA_AXIS,))
+
+
+@pytest.fixture(scope="session")
+def mesh2_2axis():
+    """2-device standard (data, feature) mesh — what the engine receives
+    BEFORE collective resolution rebuilds it data-only."""
+    from mmlspark_tpu.core.mesh import build_mesh
+    return build_mesh(data=2, feature=1, devices=jax.devices()[:2])
+
+
+@pytest.fixture(scope="session")
 def binary_table(rng):
     """Small adult-income-shaped binary classification table."""
     from sklearn.datasets import make_classification

@@ -1,0 +1,360 @@
+"""On-chip fused histogram collectives (ISSUE 10).
+
+Everything here runs the REAL Pallas kernels in interpret mode on the
+forced multi-device host platform (tests/conftest.py): remote DMAs
+discharge to all_gather exchanges, so the ring schedule's semantics —
+chunk rotation, slot reuse, reduction order — are exercised without a
+chip.  The bit-parity contract is pinned at D=2 (pairwise float adds
+commute, so ring == psum bitwise); larger rings are ulp-rotated and
+tested with allclose.  The on-chip perf A/B rides tools/tpu_session.sh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.core.mesh import DATA_AXIS
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    from mmlspark_tpu.gbdt.distributed import _shard_map
+    return jax.jit(_shard_map(fn, mesh, in_specs, out_specs))
+
+
+def _data_mesh(d):
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(jax.devices()[:d]), (DATA_AXIS,))
+
+
+class TestRingAllreduce:
+    @pytest.mark.parametrize("d", [2, 3, 8])
+    def test_matches_psum(self, d, rng):
+        """Ring vs psum on the (f, B, 3) histogram state: bit-identical
+        at D=2, ulp-rotated at larger rings."""
+        from mmlspark_tpu.ops.pallas_collectives import ring_allreduce
+        mesh = _data_mesh(d)
+        f, B = 11, 64
+        x = jax.device_put(
+            jnp.asarray(rng.normal(size=(d * f, B, 3)), jnp.float32),
+            NamedSharding(mesh, P(DATA_AXIS, None, None)))
+        spec = P(DATA_AXIS, None, None)
+        got = np.asarray(_smap(
+            lambda a: ring_allreduce(a, DATA_AXIS, d, interpret=True),
+            mesh, spec, spec)(x))
+        want = np.asarray(_smap(
+            lambda a: jax.lax.psum(a, DATA_AXIS), mesh, spec, spec)(x))
+        if d == 2:
+            np.testing.assert_array_equal(got, want)
+        else:
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_ragged_sizes(self, rng, mesh2):
+        """Flatten/pad/chunk round-trip: shapes that don't divide 128
+        lanes or the device count still reduce exactly."""
+        from mmlspark_tpu.ops.pallas_collectives import ring_allreduce
+        for shape in ((3,), (7, 5), (1, 129), (13, 17, 3)):
+            x = jax.device_put(
+                jnp.asarray(rng.normal(size=(2,) + shape), jnp.float32),
+                NamedSharding(mesh2, P(*((DATA_AXIS,)
+                                         + (None,) * len(shape)))))
+            spec = P(*((DATA_AXIS,) + (None,) * len(shape)))
+            got = np.asarray(_smap(
+                lambda a: ring_allreduce(a, DATA_AXIS, 2, interpret=True),
+                mesh2, spec, spec)(x))
+            want = np.asarray(_smap(
+                lambda a: jax.lax.psum(a, DATA_AXIS),
+                mesh2, spec, spec)(x))
+            np.testing.assert_array_equal(got, want)
+
+    def test_vmem_gate_raises_and_or_psum_falls_back(self, mesh2):
+        from mmlspark_tpu.ops import pallas_collectives as pc
+        big = jnp.zeros((2 * 1024, 1200), jnp.float32)  # > 4 MB / shard
+        with pytest.raises(ValueError, match="VMEM-residency gate"):
+            _smap(lambda a: pc.ring_allreduce(a, DATA_AXIS, 2,
+                                              interpret=True),
+                  mesh2, P(DATA_AXIS, None), P(DATA_AXIS, None))(
+                jax.device_put(big, NamedSharding(
+                    mesh2, P(DATA_AXIS, None))))
+        # the trace-safe entry silently degrades to psum instead
+        out = _smap(lambda a: pc.ring_allreduce_or_psum(a, DATA_AXIS, 2),
+                    mesh2, P(DATA_AXIS, None), P(DATA_AXIS, None))(
+            jax.device_put(big, NamedSharding(mesh2, P(DATA_AXIS, None))))
+        assert np.all(np.asarray(out) == 0.0)
+
+
+class TestFusedSegmentHistRing:
+    """The gather→hist→ring kernel vs the gather→hist→psum reference, at
+    the partition grower's real pow2 bucket ladder."""
+
+    @pytest.mark.parametrize("size", [2048, 4096, 8192, 16384])
+    def test_bucket_ladder_bit_parity(self, size, rng, mesh2):
+        from mmlspark_tpu.ops.pallas_collectives import (
+            fused_ring_applicable, fused_segment_hist_ring)
+        from mmlspark_tpu.ops.pallas_histogram import histogram_pallas_fused
+        d, f, n_local, B = 2, 11, 1500, 64
+        assert fused_ring_applicable(f, n_local, B, d)
+        binsT = jax.device_put(
+            jnp.asarray(rng.integers(0, B, size=(d * f, n_local)),
+                        jnp.int32),
+            NamedSharding(mesh2, P(DATA_AXIS, None)))
+        gh = jax.device_put(
+            jnp.asarray(rng.normal(size=(d * size, 3)), jnp.float32),
+            NamedSharding(mesh2, P(DATA_AXIS, None)))
+        idx = jax.device_put(
+            jnp.asarray(rng.integers(0, n_local, size=(d * size,)),
+                        jnp.int32),
+            NamedSharding(mesh2, P(DATA_AXIS)))
+        in_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))
+        out_spec = P(DATA_AXIS, None, None)
+        got = np.asarray(_smap(
+            lambda b, g, i: fused_segment_hist_ring(
+                b, g, i, B, size, DATA_AXIS, d, interpret=True),
+            mesh2, in_specs, out_spec)(binsT, gh, idx))
+        want = np.asarray(_smap(
+            lambda b, g, i: jax.lax.psum(
+                histogram_pallas_fused(b, g, i, B, size, interpret=True),
+                DATA_AXIS),
+            mesh2, in_specs, out_spec)(binsT, gh, idx))
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.slow
+    def test_bucket_65536_bit_parity(self, rng, mesh2):
+        """Top of the committed ladder — minutes-scale in interpret
+        mode, so it rides the slow marker like the other long tails."""
+        self.test_bucket_ladder_bit_parity(65536, rng, mesh2)
+
+    def test_full_256_bins_and_odd_features(self, rng, mesh2):
+        """B=256 (full nibble fold) with a feature count that needs both
+        the 8-fold and the per-device chunk padding."""
+        from mmlspark_tpu.ops.pallas_collectives import (
+            fused_segment_hist_ring)
+        from mmlspark_tpu.ops.pallas_histogram import histogram_pallas_fused
+        d, f, n_local, B, size = 2, 13, 700, 256, 512
+        binsT = jax.device_put(
+            jnp.asarray(rng.integers(0, B, size=(d * f, n_local)),
+                        jnp.int32),
+            NamedSharding(mesh2, P(DATA_AXIS, None)))
+        gh = jax.device_put(
+            jnp.asarray(rng.normal(size=(d * size, 3)), jnp.float32),
+            NamedSharding(mesh2, P(DATA_AXIS, None)))
+        idx = jax.device_put(
+            jnp.asarray(rng.integers(0, n_local, size=(d * size,)),
+                        jnp.int32),
+            NamedSharding(mesh2, P(DATA_AXIS)))
+        in_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))
+        out_spec = P(DATA_AXIS, None, None)
+        got = np.asarray(_smap(
+            lambda b, g, i: fused_segment_hist_ring(
+                b, g, i, B, size, DATA_AXIS, d, interpret=True),
+            mesh2, in_specs, out_spec)(binsT, gh, idx))
+        want = np.asarray(_smap(
+            lambda b, g, i: jax.lax.psum(
+                histogram_pallas_fused(b, g, i, B, size, interpret=True),
+                DATA_AXIS),
+            mesh2, in_specs, out_spec)(binsT, gh, idx))
+        np.testing.assert_array_equal(got, want)
+
+    def test_vmem_gate_refuses_oversized_binst(self):
+        from mmlspark_tpu.ops.pallas_collectives import (
+            FUSED_RING_MAX_BINST_BYTES, fused_ring_applicable)
+        # boundary: exactly at the gate passes, one row past fails
+        d, f = 2, 16          # fp = 16 (already 8*D aligned)
+        n_ok = FUSED_RING_MAX_BINST_BYTES // f
+        assert fused_ring_applicable(f, n_ok, 64, d)
+        assert not fused_ring_applicable(f, n_ok + 1, 64, d)
+        # > BMAX bins can never fuse
+        assert not fused_ring_applicable(f, 1000, 512, d)
+        # serial (single shard) has nothing to ring over
+        assert not fused_ring_applicable(f, 1000, 64, 1)
+
+
+class TestFusedMaxRowsBoundary:
+    def test_histogram_pallas_fused_gate(self):
+        """The n <= FUSED_MAX_ROWS VMEM gate: at the boundary the kernel
+        runs; one row past raises (grower falls back to the bucket
+        gather + plain kernel path)."""
+        from mmlspark_tpu.ops.pallas_histogram import (
+            FB, FUSED_MAX_ROWS, histogram_pallas_fused)
+        binsT = jnp.zeros((FB, FUSED_MAX_ROWS), jnp.uint8)
+        out = histogram_pallas_fused(
+            binsT, jnp.zeros((8, 3), jnp.float32),
+            jnp.zeros((8,), jnp.int32), num_bins=16, size=8,
+            interpret=True)
+        assert out.shape == (FB, 16, 3)
+        with pytest.raises(ValueError, match="VMEM-resident"):
+            histogram_pallas_fused(
+                jnp.zeros((FB, FUSED_MAX_ROWS + 1), jnp.uint8),
+                jnp.zeros((8, 3), jnp.float32),
+                jnp.zeros((8,), jnp.int32), num_bins=16, size=8,
+                interpret=True)
+
+
+class TestForestIdentity:
+    """End-to-end: collective='ring' forests are BIT-IDENTICAL to their
+    psum references on the 2-device mesh — the dense ring behind dot16
+    and the fully fused pallas_ring kernel both."""
+
+    def _fit(self, method, collective, mesh):
+        from mmlspark_tpu.gbdt import fit_bin_mapper
+        from mmlspark_tpu.gbdt.engine import TrainParams, train
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(640, 9))
+        y = (X[:, 0] - X[:, 2] + 0.3 * X[:, 4] > 0).astype(np.float64)
+        mapper = fit_bin_mapper(X, max_bin=63)
+        bins = mapper.transform_packed(X)
+        return train(bins, y, None, mapper, get_objective("binary"),
+                     TrainParams(num_iterations=3, num_leaves=7,
+                                 min_data_in_leaf=5, max_bin=63,
+                                 histogram_method=method,
+                                 collective=collective, verbosity=0),
+                     mesh=mesh)
+
+    @staticmethod
+    def _assert_forests_equal(a, b):
+        assert len(a.trees) == len(b.trees)
+        for s, t in zip(a.trees, b.trees):
+            np.testing.assert_array_equal(s.split_feature,
+                                          t.split_feature)
+            np.testing.assert_array_equal(s.threshold, t.threshold)
+            np.testing.assert_array_equal(np.asarray(s.leaf_value),
+                                          np.asarray(t.leaf_value))
+
+    def test_dense_ring_forest_identity(self, mesh2_2axis):
+        a = self._fit("dot16", "psum", mesh2_2axis)
+        b = self._fit("dot16", "ring", mesh2_2axis)
+        self._assert_forests_equal(a, b)
+
+    def test_fused_ring_forest_identity(self, mesh2_2axis):
+        a = self._fit("pallas_fused", "psum", mesh2_2axis)
+        b = self._fit("pallas_ring", "ring", mesh2_2axis)
+        self._assert_forests_equal(a, b)
+
+    def test_ring_actually_rings(self, mesh2_2axis, monkeypatch):
+        """Guard against a silent fall-through to psum making the parity
+        tests vacuous: count ring_allreduce invocations during a ring
+        fit."""
+        from mmlspark_tpu.ops import pallas_collectives as pc
+        calls = []
+        real = pc.ring_allreduce
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(pc, "ring_allreduce", spy)
+        self._fit("dot16", "ring", mesh2_2axis)
+        assert calls, "collective='ring' never reached the ring kernel"
+
+    def test_resolution_recorded(self, mesh2_2axis):
+        from mmlspark_tpu.gbdt.engine import last_fit_info
+        self._fit("pallas_ring", "ring", mesh2_2axis)
+        assert last_fit_info["collective"] == "ring"
+        assert last_fit_info["histogram_method"] == "pallas_ring"
+        # ... and the /metrics exposition names the resolved kernel
+        from mmlspark_tpu.core import telemetry as tm
+        text = tm.get_registry().render_prometheus()
+        assert "mmlspark_tpu_train_histogram_method_info" in text
+        assert 'histogram_method="pallas_ring"' in text
+        assert 'collective="ring"' in text
+
+
+class TestResolutionAndFallback:
+    def test_ring_kernel_failure_degrades_to_psum(self, monkeypatch):
+        """collective='ring' must degrade, not hard-fail, when Mosaic
+        cannot lower the ring kernel on the target backend."""
+        from mmlspark_tpu.ops import pallas_collectives as pc
+        from mmlspark_tpu.ops import pallas_histogram as ph
+        monkeypatch.setattr(ph, "_COMPILE_CACHE", {})
+
+        def boom():
+            raise RuntimeError("Mosaic lowering failed")
+
+        monkeypatch.setattr(pc, "_probe_ring_once", boom)
+        monkeypatch.setattr(pc.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(ph.jax, "default_backend", lambda: "tpu")
+        assert pc.ring_compile_supported(interpret=False) is False
+        assert pc.resolve_collective("ring", 4) == "psum"
+        # unknown values are a loud error, not a silent psum
+        with pytest.raises(ValueError, match="Unknown collective"):
+            pc.resolve_collective("tree", 4)
+
+    def test_fused_ring_failure_downgrades_method(self, monkeypatch):
+        """histogram_method='pallas_ring' falls to pallas_fused when the
+        fused-ring kernel does not lower (then further to pallas per the
+        existing chain)."""
+        from mmlspark_tpu.ops import pallas_collectives as pc
+        from mmlspark_tpu.ops import pallas_histogram as ph
+        monkeypatch.setattr(ph, "_COMPILE_CACHE", {})
+
+        def boom():
+            raise RuntimeError("Mosaic lowering failed")
+
+        monkeypatch.setattr(pc, "_probe_fused_ring_once", boom)
+        monkeypatch.setattr(pc.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(ph.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(ph, "_FUSED_COMPILE_OK", True)
+        assert ph.resolve_histogram_method("pallas_ring") == \
+            "pallas_fused"
+        monkeypatch.setattr(ph, "_FUSED_COMPILE_OK", False)
+        assert ph.resolve_histogram_method("pallas_ring") == "pallas"
+
+    def test_probe_cached_once_per_backend_method(self, monkeypatch):
+        """Satellite: the compile probe runs ONCE per (backend, method)
+        process-wide — repeated fits must not re-probe."""
+        from mmlspark_tpu.ops import pallas_histogram as ph
+        monkeypatch.setattr(ph, "_COMPILE_CACHE", {})
+        count = {"n": 0}
+
+        def probe():
+            count["n"] += 1
+
+        for _ in range(3):
+            assert ph.probe_cached("my_kernel", probe) is True
+        assert count["n"] == 1
+        # a different backend key probes independently
+        monkeypatch.setattr(ph.jax, "default_backend", lambda: "tpu")
+        assert ph.probe_cached("my_kernel", probe) is True
+        assert count["n"] == 2
+        # probe=False never triggers a probe
+        assert ph.probe_cached("other_kernel", probe,
+                               probe=False) is None
+        assert count["n"] == 2
+
+    def test_auto_collective_stays_psum(self, mesh2_2axis):
+        from mmlspark_tpu.gbdt.engine import (TrainParams,
+                                              _resolve_collective_cfg)
+        c, m = _resolve_collective_cfg(
+            TrainParams(collective="auto"), mesh2_2axis)
+        assert c == "psum" and m is mesh2_2axis
+
+    def test_ring_excluded_paths_keep_psum(self, mesh2_2axis):
+        """dart / voting / ranking / feature-sharded layouts keep psum
+        (their scans bind the 2-axis mesh the ring cannot ride)."""
+        from mmlspark_tpu.core.mesh import build_mesh
+        from mmlspark_tpu.gbdt.engine import (TrainParams,
+                                              _resolve_collective_cfg)
+        for kw in (dict(boosting="dart"), dict(parallelism="voting")):
+            c, m = _resolve_collective_cfg(
+                TrainParams(collective="ring", **kw), mesh2_2axis)
+            assert c == "psum" and m is mesh2_2axis
+        c, m = _resolve_collective_cfg(
+            TrainParams(collective="ring"), mesh2_2axis, ranking=True)
+        assert c == "psum"
+        fmesh = build_mesh(data=1, feature=2, devices=jax.devices()[:2])
+        c, m = _resolve_collective_cfg(
+            TrainParams(collective="ring", parallelism="feature"), fmesh)
+        assert c == "psum"
+
+    def test_ring_resolution_builds_data_only_mesh(self, mesh2_2axis):
+        from mmlspark_tpu.core.mesh import DATA_AXIS, FEATURE_AXIS
+        from mmlspark_tpu.gbdt.engine import (TrainParams,
+                                              _resolve_collective_cfg)
+        c, m = _resolve_collective_cfg(
+            TrainParams(collective="ring"), mesh2_2axis)
+        assert c == "ring"
+        assert tuple(m.axis_names) == (DATA_AXIS,)
+        assert FEATURE_AXIS not in dict(m.shape)

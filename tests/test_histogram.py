@@ -324,3 +324,66 @@ class TestPallasFused:
             np.testing.assert_array_equal(s.split_feature, t.split_feature)
             np.testing.assert_allclose(s.leaf_value, t.leaf_value,
                                        rtol=1e-5, atol=1e-7)
+
+
+class TestSweepSanitize:
+    """_auto_method must never rank a 0.0-clamped sweep reading (ISSUE 10
+    satellite): a slope that clamped to zero sat below the dispatch-noise
+    floor and says nothing about which method wins."""
+
+    def test_committed_tpu_table_drops_clamped_buckets(self):
+        """The REAL committed _sweep_tpu.json carries pallas=0.0 at 2048
+        and dot16=0.0 at 4096/8192/65536; sanitization must refuse to
+        rank those buckets while keeping the resolved 16384/32768 ones."""
+        import json
+        import os
+
+        import mmlspark_tpu.ops.histogram as H
+        path = os.path.join(os.path.dirname(H.__file__), "_sweep_tpu.json")
+        with open(path) as fh:
+            doc = json.load(fh)
+        table = H._sanitize_sweep(doc)
+        assert table is not None
+        for rows in ("2048", "4096", "8192", "65536"):
+            assert rows not in table, \
+                f"bucket {rows} has a 0.0-clamped reading and must " \
+                "not be ranked"
+        assert table.get("16384") == "dot16"
+        assert table.get("32768") == "dot16"
+
+    def test_winner_with_zero_reading_refused(self):
+        from mmlspark_tpu.ops.histogram import _sanitize_sweep
+        doc = {"winner_by_rows": {"2048": "pallas", "4096": "dot16"},
+               "times_us_by_rows": {
+                   "2048": {"pallas": 0.0, "dot16": 10.0},
+                   "4096": {"pallas": 12.0, "dot16": 5.0}}}
+        table = _sanitize_sweep(doc)
+        assert table == {"4096": "dot16"}
+
+    def test_unmeasurable_rival_refuses_bucket(self):
+        """A winner whose RIVAL clamped to 0.0 is also unranked: the
+        rival may be the true winner."""
+        from mmlspark_tpu.ops.histogram import _sanitize_sweep
+        doc = {"winner_by_rows": {"2048": "dot16"},
+               "times_us_by_rows": {
+                   "2048": {"dot16": 22.0, "pallas": 0.0,
+                            "segment": 561.0}}}
+        assert _sanitize_sweep(doc) is None
+
+    def test_hand_built_table_without_times_trusted(self):
+        from mmlspark_tpu.ops.histogram import _sanitize_sweep
+        doc = {"winner_by_rows": {"2048": "dot16"}}
+        assert _sanitize_sweep(doc) == {"2048": "dot16"}
+
+    def test_auto_method_falls_back_to_nearest_resolved(self, monkeypatch):
+        """With the committed table's 2048/4096/8192 buckets refused, a
+        2048-row call site ranks by the nearest RESOLVED bucket (16384 →
+        dot16) instead of trusting noise."""
+        import mmlspark_tpu.ops.histogram as H
+        monkeypatch.setattr(H, "_SWEEP_CACHE", {})
+        monkeypatch.setattr(H.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(H, "_native_available", lambda: False)
+        assert H._auto_method(2048) == "dot16"
+        assert H._auto_method(16384) == "dot16"
+        # beyond the largest resolved bucket: largest entry's winner
+        assert H._auto_method(10_000_000) == "dot16"

@@ -64,6 +64,14 @@ def main():
                     help="subset of bucket sizes for this invocation "
                          "(results merge into the existing table, so a "
                          "long sweep can be split across runs)")
+    ap.add_argument("--collectives", action="store_true",
+                    help="measure the cross-shard histogram reduction "
+                         "instead of the local formulations: "
+                         "fused gather+hist+ring (pallas_ring) vs "
+                         "fused-hist + ring_allreduce vs fused-hist + "
+                         "psum, per bucket size, on a data-only mesh "
+                         "over every visible device (needs >= 2; same "
+                         "in-program R-slope discipline)")
     args = ap.parse_args()
 
     import jax
@@ -80,6 +88,8 @@ def main():
     backend = jax.default_backend()
     if backend == "axon":  # tunneled TPU: file under the real platform name
         backend = "tpu"
+    if args.collectives:
+        return collective_sweep(args, backend)
     f, B, R = args.features, args.bins, args.reps
     sizes = args.sizes or [2048, 4096, 8192, 16384, 32768, 65536, 131072,
                            262144, 524288]
@@ -187,6 +197,132 @@ def main():
             flush=True)
 
     print(f"wrote {args.out} and {sweep_path}", flush=True)
+
+
+def collective_sweep(args, backend):
+    """Per-bucket A/B of the cross-shard reduction (ISSUE 10): the fused
+    gather→hist→ring kernel vs the two-step fused-hist + ring vs
+    fused-hist + psum, measured with the same in-program slope (the
+    per-launch RPC floor cancels).  Results merge into the sweep JSON
+    under ``collective_us_by_rows`` — the winner knob stays manual
+    (``collective=ring`` through passThroughArgs) until an official
+    bench A/B flips the default."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mmlspark_tpu.core.mesh import DATA_AXIS
+    from mmlspark_tpu.gbdt.distributed import _shard_map
+    from mmlspark_tpu.ops.pallas_collectives import (
+        fused_ring_applicable, fused_segment_hist_ring, ring_allreduce)
+    from mmlspark_tpu.ops.pallas_histogram import histogram_pallas_fused
+
+    D = len(jax.devices())
+    if D < 2:
+        sys.exit("--collectives needs >= 2 devices (chip mesh, or "
+                 "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                 "on CPU)")
+    interpret = backend != "tpu"
+    mesh = Mesh(np.asarray(jax.devices()), (DATA_AXIS,))
+    f, B, R = args.features, args.bins, args.reps
+    sizes = args.sizes or [2048, 4096, 8192, 16384, 32768, 65536]
+    rng = np.random.default_rng(0)
+
+    sweep_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "mmlspark_tpu", "ops", f"_sweep_{backend}.json")
+    try:
+        with open(sweep_path) as fh:
+            state = json.load(fh)
+    except (OSError, ValueError):
+        state = {"backend": backend, "features": f, "num_bins": B}
+    coll = dict(state.get("collective_us_by_rows") or {})
+
+    def smap(fn, n_in):
+        specs = tuple([P(DATA_AXIS, None), P(DATA_AXIS, None),
+                       P(DATA_AXIS)][:n_in])
+        return _shard_map(fn, mesh, specs, P(DATA_AXIS, None, None))
+
+    for size in sizes:
+        n_local = size          # shard rows ~ bucket size
+        if not fused_ring_applicable(f, n_local, B, D):
+            print(f"size={size}: fused-ring VMEM gate refuses "
+                  f"(f={f}, n={n_local}, D={D}); skipping", flush=True)
+            continue
+        binsT = jnp.asarray(
+            rng.integers(0, B, size=(D * f, n_local)), jnp.int32)
+        gh = jnp.asarray(rng.normal(size=(D * size, 3)), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, n_local, size=(D * size,)),
+                          jnp.int32)
+        sh = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
+        binsT = sh(binsT, P(DATA_AXIS, None))
+        gh = sh(gh, P(DATA_AXIS, None))
+        idx = sh(idx, P(DATA_AXIS))
+
+        variants = {
+            "pallas_ring": lambda b, g, i: fused_segment_hist_ring(
+                b, g, i, B, size, DATA_AXIS, D, interpret=interpret),
+            "fused+ring": lambda b, g, i: ring_allreduce(
+                histogram_pallas_fused(b, g, i, B, size,
+                                       interpret=interpret),
+                DATA_AXIS, D, interpret=interpret),
+            "fused+psum": lambda b, g, i: jax.lax.psum(
+                histogram_pallas_fused(b, g, i, B, size,
+                                       interpret=interpret), DATA_AXIS),
+        }
+        times = dict(coll.get(str(size), {}))
+        ref = None
+        for name, fn in variants.items():
+            def run_r(reps, fn=fn):
+                @jax.jit
+                def run(b, g, i):
+                    def body(acc, _):
+                        return acc + smap(fn, 3)(b, g, i), None
+                    acc, _ = jax.lax.scan(
+                        body, jnp.zeros_like(smap(fn, 3)(b, g, i)),
+                        None, length=reps)
+                    return acc
+                return run
+            try:
+                pr, p1 = run_r(R), run_r(1)
+                out = p1(binsT, gh, idx)
+                jax.block_until_ready(out)
+                if ref is None:
+                    ref = np.asarray(out)
+                else:
+                    err = float(np.max(np.abs(np.asarray(out) - ref)))
+                    scale = float(np.max(np.abs(ref))) or 1.0
+                    assert err / scale < 2e-2, f"{name} mismatch {err}"
+                jax.block_until_ready(pr(binsT, gh, idx))
+                best_r = best_1 = float("inf")
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(pr(binsT, gh, idx))
+                    best_r = min(best_r, time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(p1(binsT, gh, idx))
+                    best_1 = min(best_1, time.perf_counter() - t0)
+                us = (best_r - best_1) / (R - 1) * 1e6
+                # a slope at/below zero sat under the dispatch-noise
+                # floor: record it UNRESOLVED (None), never as a 0.0
+                # that a reader could rank — the exact artifact class
+                # _sanitize_sweep refuses in the main table
+                times[name] = us if us > 0.0 else None
+            except Exception as e:  # noqa: BLE001
+                times[name] = None
+                print(f"  size={size} {name}: FAIL "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+        coll[str(size)] = times
+        state["collective_us_by_rows"] = coll
+        state["collective_device_count"] = D
+        with open(sweep_path, "w") as fh:
+            json.dump(state, fh, indent=1)
+        print(f"size={size:7d} " + " ".join(
+            f"{k}={v:.0f}us" if v is not None else f"{k}=—"
+            for k, v in times.items()), flush=True)
+    print(f"wrote {sweep_path} (collective_us_by_rows; D={D}, "
+          f"interpret={interpret})", flush=True)
 
 
 def write_markdown(out_path, state, backend, f, B, R):

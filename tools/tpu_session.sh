@@ -57,6 +57,21 @@ step 900 bash -c 'python bench.py --pass-through packed_gather=true | tee artifa
 #     First Mosaic compile of the fused kernel may be slow; budget wide.
 step 1800 bash -c 'python bench.py --pass-through histogram_method=pallas_fused | tee artifacts/bench_tpu_session_fused.out'
 
+# 4c. ISSUE 10 collective A/B: the on-chip Pallas ring vs the stock
+#     psum, through the official bench (multi-device chip only; on a
+#     single-chip lease the collective resolves back to psum and the
+#     runs just reproduce 4b).  First the collective alone, then the
+#     fully fused gather+hist+ring kernel; bench.py records the
+#     RESOLVED method + collective into each artifact's detail block.
+step 1800 bash -c 'python bench.py --pass-through collective=ring | tee artifacts/bench_tpu_session_ring.out'
+step 1800 bash -c 'python bench.py --pass-through "histogram_method=pallas_ring collective=ring" | tee artifacts/bench_tpu_session_ring_fused.out'
+
+# 4d. in-program slope A/B of the reductions at the grower's bucket
+#     sizes (tools/sweep_histogram.py --collectives): pallas_ring
+#     (one fused kernel) vs fused-hist+ring vs fused-hist+psum — the
+#     R-discipline applies (signal must clear the dispatch jitter)
+step 2400 python tools/sweep_histogram.py --collectives --reps 65
+
 # 5. secondary BASELINE target: ImageFeaturizer imgs/sec on-chip
 step 900 bash -c 'python tools/bench_featurizer.py | tee artifacts/bench_featurizer_tpu.out'
 
