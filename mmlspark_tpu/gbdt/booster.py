@@ -20,7 +20,7 @@ import functools
 import io
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -206,14 +206,27 @@ class Booster:
         self._cache_token += 1
 
     def predictor(self, num_iteration: Optional[int] = None,
-                  backend: str = "auto") -> "CompiledPredictor":
+                  backend: str = "auto",
+                  tree_range: Optional[Tuple[int, int]] = None,
+                  include_init_score: bool = True
+                  ) -> "CompiledPredictor":
         """Serving-hot-path margin scorer with all per-call dispatch
         (shape checks, ``_stack()`` dict indexing, ``use_t`` slicing,
         native-vs-jit backend probe) resolved ONCE at construction.
         ``backend``: "auto" (native when available on cpu, else jit),
         "native", or "jit" (force the XLA walk — the accelerator path,
-        also what benchmarks pin for apples-to-apples comparisons)."""
-        return CompiledPredictor(self, num_iteration, backend)
+        also what benchmarks pin for apples-to-apples comparisons).
+
+        ``tree_range=(lo, hi)`` scores only trees ``lo..hi-1`` — the
+        sharded scoring fleet's tree-range partial scorer (ISSUE 11).
+        Bounds must align to ``num_class`` (shards hold whole boosting
+        iterations, since tree→class assignment is positional).  With
+        ``include_init_score=False`` the partial carries NO init score,
+        so summing the shards' partials reproduces the full margin
+        (shard 0 keeps the init score exactly once)."""
+        return CompiledPredictor(self, num_iteration, backend,
+                                 tree_range=tree_range,
+                                 include_init_score=include_init_score)
 
     def _stack(self):
         """Pad trees to uniform arrays for a jitted scan."""
@@ -539,7 +552,9 @@ class CompiledPredictor:
 
     def __init__(self, booster: Booster,
                  num_iteration: Optional[int] = None,
-                 backend: str = "auto"):
+                 backend: str = "auto",
+                 tree_range: Optional[Tuple[int, int]] = None,
+                 include_init_score: bool = True):
         if backend not in ("auto", "native", "jit"):
             raise ValueError(f"backend must be auto|native|jit, "
                              f"got {backend!r}")
@@ -547,16 +562,40 @@ class CompiledPredictor:
         self._token = booster._cache_token
         self._num_trees = len(booster.trees)
         self._K = booster.num_class
-        self._init_score = booster.init_score
+        self._init_score = booster.init_score if include_init_score \
+            else 0.0
         self.num_features = booster.max_feature_idx + 1
         self.num_iteration = num_iteration
+        self.tree_range = tree_range
         s = booster._stack()
         if s is None:
             self._mode = "empty"
             return
         T = s["feat"].shape[0]
-        use_t = T if num_iteration is None \
-            else min(num_iteration * self._K, T)
+        if tree_range is not None:
+            # tree-range partial scorer (the fleet's shard slice):
+            # bounds must land on num_class boundaries because BOTH
+            # walkers assign class = local tree index % K — a
+            # misaligned lo would silently rotate classes
+            if num_iteration is not None:
+                raise ValueError(
+                    "pass num_iteration OR tree_range, not both")
+            lo, hi = int(tree_range[0]), int(tree_range[1])
+            if not 0 <= lo <= hi <= T:
+                raise ValueError(
+                    f"tree_range {tree_range} outside [0, {T}]")
+            if lo % self._K or (hi % self._K and hi != T):
+                raise ValueError(
+                    f"tree_range {tree_range} must align to "
+                    f"num_class={self._K} boundaries")
+            if lo == hi:
+                self._mode = "empty"
+                return
+            sl = slice(lo, hi)
+        else:
+            use_t = T if num_iteration is None \
+                else min(num_iteration * self._K, T)
+            sl = slice(0, use_t)
         sn = booster._stacked_np
         from .. import native
         native_ok = sn is not None and jax.default_backend() == "cpu" \
@@ -567,19 +606,19 @@ class CompiledPredictor:
                 "scorer is unavailable on this backend")
         if backend != "jit" and native_ok:
             self._mode = "native"
-            self._nargs = (sn["feat"][:use_t], sn["thr"][:use_t],
-                           sn["left"][:use_t], sn["right"][:use_t],
-                           sn["leaf"][:use_t], sn["single"][:use_t],
-                           sn["is_cat"][:use_t], sn["dleft"][:use_t],
-                           sn["cat_bnd"][:use_t], sn["cat_words"][:use_t])
+            self._nargs = (sn["feat"][sl], sn["thr"][sl],
+                           sn["left"][sl], sn["right"][sl],
+                           sn["leaf"][sl], sn["single"][sl],
+                           sn["is_cat"][sl], sn["dleft"][sl],
+                           sn["cat_bnd"][sl], sn["cat_words"][sl])
             self._has_cat = sn["has_cat"]
         else:
             self._mode = "jit"
-            self._jargs = (s["feat"][:use_t], s["thr"][:use_t],
-                           s["left"][:use_t], s["right"][:use_t],
-                           s["leaf"][:use_t], s["single"][:use_t],
-                           s["is_cat"][:use_t], s["dleft"][:use_t],
-                           s["cat_bnd"][:use_t], s["cat_words"][:use_t])
+            self._jargs = (s["feat"][sl], s["thr"][sl],
+                           s["left"][sl], s["right"][sl],
+                           s["leaf"][sl], s["single"][sl],
+                           s["is_cat"][sl], s["dleft"][sl],
+                           s["cat_bnd"][sl], s["cat_words"][sl])
             self._depth = s["depth"]
             self._has_cat = s["has_cat"]
 

@@ -21,6 +21,9 @@ from .chaos import (ChaosChannel, ChaosPlan, ChaosPredictor, ChaosQueue,
 from .transport import (Backpressure, ChecksumError, FrameTooLarge,
                         HandshakeError, TransportClient, TransportConfig,
                         TransportError, TransportServer, parse_address)
+from .wire import BinaryReq, WireError
+from .fleet import (ConsistentHashRing, PredictorFleet,
+                    ShardedPredictor, shard_tree_ranges)
 from .binary import BinaryFileReader, read_binary_files
 from .powerbi import PowerBIWriter
 
@@ -36,6 +39,9 @@ __all__ = [
     "Backpressure", "ChecksumError", "FrameTooLarge", "HandshakeError",
     "TransportClient", "TransportConfig", "TransportError",
     "TransportServer", "parse_address",
+    "BinaryReq", "WireError",
+    "ConsistentHashRing", "PredictorFleet", "ShardedPredictor",
+    "shard_tree_ranges",
     "BinaryFileReader", "read_binary_files",
     "PowerBIWriter",
 ]

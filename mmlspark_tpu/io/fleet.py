@@ -1,0 +1,631 @@
+"""Sharded predictor fleet over the resumable transport (ISSUE 11).
+
+The single-host :class:`~mmlspark_tpu.io.scoring.ScoringEngine` tops
+out at one process's share of the machine; this module is the
+"millions of users" tier ROADMAP item 2 planned on top of the PR-6
+transport:
+
+* **Tree-range sharding** (``routing="shard"``) — a large forest is
+  split into contiguous tree ranges aligned to ``num_class`` boundaries
+  (:func:`shard_tree_ranges`); each worker process scores ONLY its
+  slice (``Booster.predictor(tree_range=...)``, init score on shard 0
+  exactly once) and the driver reduces the partial margin sums in
+  shard order.  :class:`ShardedPredictor` is the same partial-sum
+  computation run locally — the single-host reference the fleet is
+  pinned bit-exact against (the reduce order is identical, so float32
+  addition associates identically).
+* **Replicated pool** (``routing="replica"``) — every worker holds the
+  FULL model and each request routes to exactly one replica by
+  consistent hashing (:class:`ConsistentHashRing`): losing or adding a
+  replica remaps only the ring arc it owned, not the whole key space —
+  the right shape for small models where sharding would just add
+  reduce latency.
+* **Resumable wire** — every driver↔worker hop is a
+  :mod:`mmlspark_tpu.io.transport` session carrying
+  :mod:`mmlspark_tpu.io.wire` raw-float32 blocks (requests ship ONE
+  packed feature matrix; partials come back as ONE packed margin
+  block).  A link blip replays only the unacked frames in both
+  directions — an in-flight request's partials survive the blip
+  without rescoring, and :class:`~mmlspark_tpu.io.chaos.ChaosTransport`
+  drills exactly that (tests/test_fleet.py).
+
+:class:`PredictorFleet` is an ordinary predictor callable
+(``(n, f) float32 -> margins`` with ``num_features``/``mode``), so it
+plugs straight into ``ScoringEngine(predictor=fleet)`` — the whole
+serving stack (admission control, deadlines, salvage, telemetry) rides
+on top unchanged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import logging
+import os
+import queue
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.profiling import StageStats
+from ..core.telemetry import get_registry
+from . import wire
+from .transport import (CH_CONTROL, CH_SCORING, TransportClient,
+                        TransportConfig, TransportServer, TransportError)
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "ConsistentHashRing", "PredictorFleet", "ShardedPredictor",
+    "shard_tree_ranges",
+]
+
+
+def shard_tree_ranges(num_trees: int, num_shards: int,
+                      num_class: int = 1) -> List[Tuple[int, int]]:
+    """Split a forest of ``num_trees`` into ``num_shards`` contiguous
+    ``(lo, hi)`` tree ranges aligned to ``num_class`` boundaries (both
+    forest walkers assign class = local index % K, so shards must hold
+    whole boosting iterations).  Ranges are balanced to within one
+    iteration; shards beyond the iteration count come back empty
+    ``(T, T)`` rather than failing, so a 4-shard fleet can serve a
+    3-iteration model."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    K = max(1, int(num_class))
+    units = (num_trees + K - 1) // K          # boosting iterations
+    base, extra = divmod(units, num_shards)
+    ranges: List[Tuple[int, int]] = []
+    lo_u = 0
+    for s in range(num_shards):
+        hi_u = lo_u + base + (1 if s < extra else 0)
+        ranges.append((min(lo_u * K, num_trees),
+                       min(hi_u * K, num_trees)))
+        lo_u = hi_u
+    return ranges
+
+
+class ShardedPredictor:
+    """Tree-range partial-sum scoring run locally — the single-host
+    reference for the fleet's reduce (identical shard split, identical
+    float32 reduce order → bit-exact), and a usable predictor in its
+    own right (each call walks the same trees, just as N partial
+    walks).  ``include_init_score`` lands on shard 0 exactly once."""
+
+    def __init__(self, booster, num_shards: int = 2,
+                 backend: str = "auto",
+                 ranges: Optional[Sequence[Tuple[int, int]]] = None):
+        self.ranges = list(ranges) if ranges is not None else \
+            shard_tree_ranges(len(booster.trees), num_shards,
+                              booster.num_class)
+        self.num_features = booster.max_feature_idx + 1
+        self._K = booster.num_class
+        self._parts = [
+            booster.predictor(backend=backend, tree_range=(lo, hi),
+                              include_init_score=(i == 0))
+            for i, (lo, hi) in enumerate(self.ranges)]
+
+    @property
+    def mode(self) -> str:
+        return "sharded"
+
+    def partials(self, X) -> List[np.ndarray]:
+        """Each shard's ``(n, K)`` float32 partial margin block."""
+        n = np.shape(X)[0]
+        return [np.asarray(p(X), np.float32).reshape(n, -1)
+                for p in self._parts]
+
+    def __call__(self, X):
+        parts = self.partials(X)
+        out = parts[0]
+        for p in parts[1:]:         # shard order: the pinned reduce
+            out = out + p
+        return out[:, 0] if self._K == 1 else out
+
+
+class ConsistentHashRing:
+    """Consistent hashing with virtual nodes: ``route(key)`` maps a
+    request id to one replica; removing a node remaps ONLY the arcs it
+    owned (its keys spread over the survivors) and re-adding it
+    restores them — the property that keeps a replica loss from
+    reshuffling every client's affinity."""
+
+    def __init__(self, nodes: Sequence[Any] = (), vnodes: int = 64):
+        self._vnodes = int(vnodes)
+        self._ring: List[Tuple[int, Any]] = []
+        self._nodes: set = set()
+        for n in nodes:
+            self.add(n)
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(
+            hashlib.md5(key.encode("utf-8")).digest()[:8], "big")
+
+    def add(self, node: Any) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        # build-and-rebind (like remove): route() bisects the list
+        # lock-free from scorer threads, so it must never observe a
+        # mid-sort ring
+        ring = self._ring + [(self._hash(f"{node}#{v}"), node)
+                             for v in range(self._vnodes)]
+        ring.sort()
+        self._ring = ring
+
+    def remove(self, node: Any) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._ring = [(h, n) for h, n in self._ring if n != node]
+
+    def nodes(self) -> set:
+        return set(self._nodes)
+
+    def route(self, key: str) -> Any:
+        """The node owning ``key``'s ring arc (clockwise successor)."""
+        if not self._ring:
+            raise RuntimeError("consistent-hash ring has no nodes")
+        h = self._hash(str(key))
+        ring = self._ring
+        lo, hi = 0, len(ring)
+        while lo < hi:                  # first vnode hash > h
+            mid = (lo + hi) // 2
+            if ring[mid][0] <= h:
+                lo = mid + 1
+            else:
+                hi = mid
+        return ring[lo % len(ring)][1]
+
+
+class _FleetCall:
+    """One in-flight fleet request: the partials collected so far and
+    the shard set still owed."""
+
+    __slots__ = ("event", "parts", "expect", "error")
+
+    def __init__(self, expect):
+        self.event = threading.Event()
+        self.parts: Dict[int, np.ndarray] = {}
+        self.expect = set(expect)
+        self.error: Optional[str] = None
+
+
+def _fleet_worker_main(driver_host: str, driver_port: int,
+                       shard_id: int, model_path: Optional[str],
+                       lo: int, hi: int, backend: str, token: str,
+                       replica: bool = False,
+                       booster=None) -> None:
+    """Fleet worker entrypoint (module-level for spawn pickling; tests
+    run it as a thread passing ``booster`` directly).  Holds the shard's
+    tree-range partial predictor (or the full model in replica mode),
+    answers raw-float32 score requests with packed partial blocks, and
+    rides ONE resumable transport session — a link blip replays, it
+    does not rescore."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if booster is None:
+        from ..gbdt.booster import Booster
+        booster = Booster.load_native_model(model_path)
+    if replica:
+        pred = booster.predictor(backend=backend)
+    else:
+        pred = booster.predictor(backend=backend, tree_range=(lo, hi),
+                                 include_init_score=(lo == 0))
+    stop_evt = threading.Event()
+    work: "queue.Queue" = queue.Queue()
+
+    def on_message(session, channel, msg, deadline_ms):
+        if channel == CH_CONTROL and isinstance(msg, dict) \
+                and msg.get("op") == "stop":
+            stop_evt.set()
+            work.put(None)
+            return
+        if channel == CH_SCORING:
+            # scoring runs OFF the read pump (a long jit compile must
+            # not stall keepalives into a false half-open teardown)
+            work.put(msg)
+
+    def on_connect(resumed):
+        try:
+            client.send(CH_CONTROL, {"op": "hello", "shard": shard_id})
+        except OSError:
+            pass    # link died instantly; the next reconnect re-hellos
+
+    client = TransportClient(
+        (driver_host, driver_port), token=token,
+        cfg=TransportConfig(reconnect_backoff=(0.05, 1.0),
+                            reconnect_tries=8),
+        on_message=on_message, on_connect=on_connect,
+        on_down=lambda: (stop_evt.set(), work.put(None)),
+        name=f"fleet-shard{shard_id}")
+    client.connect()
+
+    def score_one(msg) -> None:
+        rid = ""
+        try:
+            if isinstance(msg, (bytes, memoryview)):
+                _kind, rid, X = wire.unpack_matrix(msg)
+            elif isinstance(msg, dict) and msg.get("op") == "score":
+                # negotiated JSON fallback (peer without the binary
+                # capability)
+                rid = str(msg.get("rid", ""))
+                X = np.asarray(msg["X"], np.float32)
+            else:
+                return
+            m = np.asarray(pred(X), np.float32).reshape(X.shape[0], -1)
+            if client.session.peer_binary:
+                client.send_bytes(
+                    CH_SCORING,
+                    wire.pack_matrix(rid, m, kind=wire.K_PARTIAL))
+            else:
+                client.send(CH_SCORING, {"op": "partial", "rid": rid,
+                                         "shard": shard_id,
+                                         "m": m.tolist()})
+        except Exception as e:  # noqa: BLE001 - one request, not the loop
+            log.exception("fleet shard %d: scoring failed", shard_id)
+            try:
+                client.send(CH_SCORING, {"op": "partial_error",
+                                         "rid": rid, "shard": shard_id,
+                                         "detail": repr(e)})
+            except OSError:
+                pass
+
+    while not stop_evt.is_set():
+        msg = work.get()
+        if msg is None:
+            break
+        score_one(msg)
+    client.close()
+
+
+class PredictorFleet:
+    """A multiprocess predictor pool behind one callable.
+
+    ``routing="shard"`` — tree-range sharding with partial-sum reduce:
+    every request fans out to ALL shards as one packed float32 block;
+    the driver sums the partial margin blocks in shard order (the
+    pinned reduce :class:`ShardedPredictor` reproduces locally).
+
+    ``routing="replica"`` — full-model replicas behind consistent-hash
+    routing: each request's id picks ONE replica on the ring.
+
+    ``spawn=True`` forks real worker processes (the model rides a temp
+    native-model file); ``spawn=False`` runs the workers as threads in
+    this process sharing ``booster`` — the test topology (still real
+    sockets, real frames, chaos-wrappable).
+    """
+
+    def __init__(self, booster, num_shards: int = 2, *,
+                 routing: str = "shard", backend: str = "auto",
+                 token: Optional[str] = None, host: str = "127.0.0.1",
+                 spawn: bool = True, join_timeout: float = 60.0,
+                 request_timeout_s: float = 30.0,
+                 transport_config: Optional[TransportConfig] = None):
+        import secrets
+        if routing not in ("shard", "replica"):
+            raise ValueError("routing must be 'shard' or 'replica'")
+        self.routing = routing
+        self.num_shards = int(num_shards)
+        self.num_features = booster.max_feature_idx + 1
+        self._K = booster.num_class
+        self._init_score = float(booster.init_score)
+        self._booster = booster
+        self._backend = backend
+        self._spawn = bool(spawn)
+        self._join_timeout = join_timeout
+        self._timeout = request_timeout_s
+        self.token = secrets.token_hex(16) if token is None else token
+        self.ranges = ([(0, len(booster.trees))] * self.num_shards
+                       if routing == "replica" else
+                       shard_tree_ranges(len(booster.trees),
+                                         self.num_shards,
+                                         self._K))
+        self._ts = TransportServer(
+            host, 0, token=self.token,
+            cfg=transport_config or TransportConfig(),
+            on_message=self._on_msg, on_session_lost=self._on_lost,
+            name="fleet-driver")
+        self._ring = ConsistentHashRing(range(self.num_shards))
+        self._slot_sid: Dict[int, str] = {}
+        self._calls: Dict[str, _FleetCall] = {}
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._closing = threading.Event()
+        self._procs: List[Any] = []
+        self._threads: List[threading.Thread] = []
+        self._model_path: Optional[str] = None
+        self._supervisor: Optional[threading.Thread] = None
+        # fleet telemetry, federated like every other subsystem
+        self.stats = StageStats()
+        for k in ("requests", "partials", "timeouts", "shard_errors",
+                  "worker_respawns"):
+            self.stats.incr(k, 0)
+        # resolved once: timer() locks per call — per-request tax
+        self._rtt = self.stats.timer("fleet_rtt")
+
+    @property
+    def mode(self) -> str:
+        return "fleet"
+
+    # ---- lifecycle ----
+
+    def _spawn_proc(self, shard: int):
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        dh, dp = self._ts.address
+        lo, hi = self.ranges[shard]
+        p = ctx.Process(
+            target=_fleet_worker_main,
+            args=(dh, dp, shard, self._model_path, lo, hi,
+                  self._backend, self.token,
+                  self.routing == "replica"),
+            daemon=True)
+        p.start()
+        return p
+
+    def start(self) -> "PredictorFleet":
+        self._ts.start()
+        if self._spawn:
+            fd, self._model_path = tempfile.mkstemp(
+                suffix=".lgbm.txt", prefix="fleet_model_")
+            os.close(fd)
+            self._booster.save_native_model(self._model_path)
+            self._procs = [self._spawn_proc(s)
+                           for s in range(self.num_shards)]
+        else:
+            dh, dp = self._ts.address
+            self._threads = [
+                threading.Thread(
+                    target=_fleet_worker_main,
+                    args=(dh, dp, s, None, *self.ranges[s],
+                          self._backend, self.token,
+                          self.routing == "replica"),
+                    kwargs={"booster": self._booster},
+                    name=f"fleet-shard{s}", daemon=True)
+                for s in range(self.num_shards)]
+            for t in self._threads:
+                t.start()
+        deadline = time.monotonic() + self._join_timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._slot_sid) == self.num_shards:
+                    break
+            time.sleep(0.02)
+        else:
+            missing = [s for s in range(self.num_shards)
+                       if s not in self._slot_sid]
+            self.stop()
+            raise RuntimeError(
+                f"fleet shards {missing} never joined within "
+                f"{self._join_timeout}s")
+        if self._spawn:
+            self._supervisor = threading.Thread(
+                target=self._supervise, name="fleet-supervisor",
+                daemon=True)
+            self._supervisor.start()
+        get_registry().register("fleet", self.stats)
+        return self
+
+    def _supervise(self) -> None:
+        while not self._closing.wait(0.5):
+            for s, p in enumerate(self._procs):
+                if p.is_alive() or self._closing.is_set():
+                    continue
+                log.warning("fleet: shard %d process died (exitcode "
+                            "%s); respawning", s, p.exitcode)
+                self.stats.incr("worker_respawns")
+                self._procs[s] = self._spawn_proc(s)
+
+    def stop(self) -> None:
+        self._closing.set()
+        for session in list(self._ts.sessions.values()):
+            try:
+                session.send(CH_CONTROL, {"op": "stop"}, timeout=1.0)
+            except OSError:
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._ts.stop()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
+            self._supervisor = None
+        if self._model_path:
+            try:
+                os.unlink(self._model_path)
+            except OSError:
+                pass
+            self._model_path = None
+        # release any caller still parked on an in-flight request
+        with self._lock:
+            calls = list(self._calls.values())
+            self._calls.clear()
+        for c in calls:
+            c.error = "fleet stopped"
+            c.event.set()
+
+    # ---- driver-side protocol ----
+
+    def _on_msg(self, session, channel: int, msg, deadline_ms) -> None:
+        if channel == CH_CONTROL and isinstance(msg, dict) \
+                and msg.get("op") == "hello":
+            s = msg.get("shard")
+            if isinstance(s, int) and 0 <= s < self.num_shards:
+                stale_sid = None
+                with self._lock:
+                    old_sid = self._slot_sid.get(s)
+                    if old_sid is not None and old_sid != session.sid:
+                        # a respawned worker took the slot over: drop
+                        # the superseded session NOW instead of letting
+                        # it linger until resume grace fires on_lost
+                        stale_sid = old_sid
+                    self._slot_sid[s] = session.sid
+                    session.meta["shard"] = s
+                    # a (re)joined replica re-enters the routing ring —
+                    # its old arcs come back, everyone else's keys stay
+                    # where they were
+                    self._ring.add(s)
+                if stale_sid is not None:
+                    self._ts.drop_session(stale_sid, notify=False)
+            else:
+                log.warning("fleet: ignoring hello with invalid shard "
+                            "id %r", s)
+            return
+        if channel != CH_SCORING:
+            return
+        if isinstance(msg, (bytes, memoryview)):
+            try:
+                kind, rid, m = wire.unpack_matrix(msg)
+            except wire.WireError as e:
+                # one malformed partial costs one request, never the
+                # session: fail the waiter if the rid is recoverable
+                rid = wire.peek_rid(msg)
+                self._fail_call(rid, f"malformed partial: {e}")
+                return
+            if kind != wire.K_PARTIAL:
+                return
+            self._add_partial(session, rid, m)
+        elif isinstance(msg, dict):
+            op = msg.get("op")
+            if op == "partial":
+                m = np.asarray(msg.get("m"), np.float32)
+                self._add_partial(session, str(msg.get("rid")), m,
+                                  shard=msg.get("shard"))
+            elif op == "partial_error":
+                self.stats.incr("shard_errors")
+                self._fail_call(str(msg.get("rid")),
+                                f"shard {msg.get('shard')} failed: "
+                                f"{msg.get('detail')}")
+
+    def _add_partial(self, session, rid: str, m: np.ndarray,
+                     shard: Optional[int] = None) -> None:
+        if shard is None:
+            shard = session.meta.get("shard")
+        with self._lock:
+            call = self._calls.get(rid)
+            if call is None or shard not in call.expect:
+                return        # late/duplicate partial: already answered
+            call.parts[shard] = np.asarray(m, np.float32)
+            call.expect.discard(shard)
+            done = not call.expect
+        self.stats.incr("partials")
+        if done:
+            call.event.set()
+
+    def _fail_call(self, rid: str, detail: str) -> None:
+        with self._lock:
+            call = self._calls.pop(rid, None)
+        if call is not None:
+            call.error = detail
+            call.event.set()
+
+    def _on_lost(self, session) -> None:
+        """A shard session died for good (resume grace expired): free
+        its slot for the respawned worker's hello, take a dead REPLICA
+        out of the routing ring (its arcs remap to the survivors — the
+        failover the ring exists for; shard-mode fan-out still needs
+        every range, so a lost shard there fails calls fast instead),
+        and fail the calls still waiting on it — the engine's salvage
+        path rescores them once capacity returns."""
+        with self._lock:
+            s = session.meta.get("shard")
+            held = (s is not None
+                    and self._slot_sid.get(s) == session.sid)
+            if held:
+                self._slot_sid.pop(s, None)
+                self._ring.remove(s)
+            # only a session that still HELD the slot strands calls: a
+            # superseded session's loss must not fail requests the NEW
+            # healthy session is already serving
+            stranded = ([rid for rid, c in self._calls.items()
+                         if s in c.expect] if held else [])
+        for rid in stranded:
+            self._fail_call(rid, f"shard {s} session lost")
+
+    def _session_for(self, shard: int):
+        with self._lock:
+            sid = self._slot_sid.get(shard)
+        session = self._ts.sessions.get(sid) if sid else None
+        if session is None:
+            raise TransportError(
+                f"fleet shard {shard} has no live session")
+        return session
+
+    # ---- the predictor contract ----
+
+    def __call__(self, X):
+        return self.score(X)
+
+    def score(self, X, key: Optional[str] = None) -> np.ndarray:
+        """Score a batch.  ``routing="shard"`` fans the packed block to
+        every shard and reduces the partial sums in shard order;
+        ``routing="replica"`` consistent-hash-routes the whole request
+        to one replica (``key`` overrides the auto request id as the
+        ring key — e.g. a client id for session affinity)."""
+        X = np.ascontiguousarray(np.asarray(X, np.float32))
+        if X.ndim != 2:
+            raise ValueError(f"expected (n, f) input, got {X.shape}")
+        rid = f"f{next(self._seq)}"
+        if self.routing == "shard":
+            targets = [s for s, (lo, hi) in enumerate(self.ranges)
+                       if hi > lo]
+            if not targets:
+                # a 0-tree forest has no shard to ask: the margin is
+                # the init score — answer immediately instead of
+                # parking a waiter nothing will ever complete
+                out = np.full((X.shape[0], self._K),
+                              np.float32(self._init_score))
+                return out[:, 0] if self._K == 1 else out
+        else:
+            targets = [self._ring.route(key if key is not None
+                                        else rid)]
+        call = _FleetCall(targets)
+        with self._lock:
+            self._calls[rid] = call
+        self.stats.incr("requests")
+        t0 = time.perf_counter()
+        try:
+            buf = None
+            for s in targets:
+                session = self._session_for(s)
+                if session.peer_binary:
+                    if buf is None:
+                        buf = wire.pack_matrix(rid, X)
+                    session.send_bytes(CH_SCORING, buf,
+                                       timeout=self._timeout)
+                else:   # negotiated JSON fallback
+                    session.send(CH_SCORING,
+                                 {"op": "score", "rid": rid,
+                                  "X": X.tolist()},
+                                 timeout=self._timeout)
+            if not call.event.wait(self._timeout):
+                self.stats.incr("timeouts")
+                raise TransportError(
+                    f"fleet request {rid} timed out after "
+                    f"{self._timeout}s (missing shards "
+                    f"{sorted(call.expect)})")
+            if call.error:
+                raise TransportError(
+                    f"fleet request {rid} failed: {call.error}")
+        finally:
+            with self._lock:
+                self._calls.pop(rid, None)
+        self._rtt.record(time.perf_counter() - t0)
+        if self.routing == "replica":
+            out = call.parts[targets[0]]
+        else:
+            # the PINNED reduce: ascending shard order, float32 — the
+            # exact association ShardedPredictor uses locally, so the
+            # fleet is bit-exact with the single-host reference
+            order = sorted(call.parts)
+            out = call.parts[order[0]]
+            for s in order[1:]:
+                out = out + call.parts[s]
+        return out[:, 0] if self._K == 1 else out

@@ -88,6 +88,7 @@ import numpy as np
 from ..core.profiling import StageStats
 from ..core.schema import DataTable
 from ..core.telemetry import get_journal, get_registry, record_flight
+from .wire import BinaryReq
 
 log = logging.getLogger(__name__)
 
@@ -121,6 +122,15 @@ class ColumnPlan:
     from the payload list — no intermediate :class:`DataTable`, no
     per-row dict-intersection walk.  ``decode_table`` covers callers
     that already hold a table.
+
+    Binary wire (ISSUE 11): payloads may also be float32 row views
+    (``np.ndarray`` or :class:`~mmlspark_tpu.io.wire.BinaryReq`) — the
+    negotiated raw-float32 wire's ``np.frombuffer`` output.  A batch of
+    those assembles with one ``np.concatenate`` (a single-row batch is
+    ZERO-copy: the view passes straight through), with the same width
+    validation the JSON paths get.  Column order on the binary wire is
+    the model's canonical feature order — the identical contract the
+    JSON ``features`` vector already used.
     """
 
     def __init__(self, features: Union[str, Sequence[str]] = "features",
@@ -140,7 +150,12 @@ class ColumnPlan:
         self.num_features = num_features
 
     def decode(self, payloads: List[Any]) -> np.ndarray:
-        """Payload dicts → C-contiguous ``(n, f)`` float32 matrix."""
+        """Payload dicts (or binary row views) → C-contiguous ``(n, f)``
+        float32 matrix.  A mixed JSON/binary batch takes the engine's
+        per-row salvage path (each singleton re-enters here and picks
+        its own layout)."""
+        if payloads and isinstance(payloads[0], (np.ndarray, BinaryReq)):
+            return self.decode_binary(payloads)
         if self.vector_key is not None:
             key = self.vector_key
             X = np.asarray([p[key] for p in payloads], dtype=np.float32)
@@ -159,6 +174,25 @@ class ColumnPlan:
                 f"decoded {X.shape[1]} features, model expects "
                 f"{self.num_features}")
         return np.ascontiguousarray(X)
+
+    def decode_binary(self, payloads: List[Any]) -> np.ndarray:
+        """Binary-wire fast path: each payload is already a float32
+        ``(r, f)`` view (``np.frombuffer`` output of
+        :func:`~mmlspark_tpu.io.wire.unpack_matrix`); a multi-entry
+        batch is ONE ``np.concatenate``, a single entry passes through
+        zero-copy.  No JSON, no per-value Python objects."""
+        rows = [p.X if isinstance(p, BinaryReq) else p for p in payloads]
+        X = rows[0] if len(rows) == 1 else np.concatenate(rows, axis=0)
+        if not isinstance(X, np.ndarray) or X.ndim != 2 \
+                or X.dtype != np.float32:
+            raise ValueError(
+                "binary payloads must be (r, f) float32 row blocks")
+        if self.num_features is not None \
+                and X.shape[1] != self.num_features:
+            raise ValueError(
+                f"decoded {X.shape[1]} features, model expects "
+                f"{self.num_features}")
+        return X
 
     def decode_table(self, table: DataTable) -> np.ndarray:
         """Same plan applied to an already-built :class:`DataTable`."""
@@ -284,6 +318,15 @@ class ScoringEngine:
         self._num_repliers = max(0, int(num_repliers))
         self._pad_buckets = bool(pad_buckets)
         self._reply_fn = reply_fn
+        # binary-wire reply mode (ISSUE 11): when the exchange can ship
+        # raw margin blocks (MultiprocessHTTPServer.binary_wire), reply
+        # values stay numpy — sliced straight off the margin ndarray —
+        # and the per-row tolist()/_json_value builds are skipped; the
+        # exchange serializes per session (binary frame or negotiated
+        # JSON fallback) at delivery time
+        self._ndarray_replies = bool(getattr(server, "binary_wire",
+                                             False)) \
+            and reply_fn is None
         self._on_error = on_error
         self._max_queue_depth = (None if max_queue_depth is None
                                  else int(max_queue_depth))
@@ -505,6 +548,13 @@ class ScoringEngine:
                     dl = float(payload["_deadline_ms"]) / 1e3
                 except (TypeError, ValueError):
                     pass
+            elif isinstance(payload, BinaryReq) and payload.deadline_ms:
+                # binary wire: the deadline rode the frame header (no
+                # payload keys exist to carry it)
+                try:
+                    dl = float(payload.deadline_ms) / 1e3
+                except (TypeError, ValueError):
+                    pass
             if dl is not None and age > dl:
                 expired.append(entry)
             elif self._shed_wait is not None and age > self._shed_wait:
@@ -701,6 +751,11 @@ class ScoringEngine:
             m = np.asarray(self._predictor(X))[:n]
         if self._reply_fn is not None:
             return self._reply_fn(m)
+        if self._ndarray_replies:
+            # binary wire: hand the margin ndarray through — indexing
+            # yields numpy scalars/row views the exchange serializes
+            # straight into a float32 reply block (no tolist())
+            return m
         return m.tolist()
 
     def _score_predictor(self, batch):
@@ -768,6 +823,11 @@ class ScoringEngine:
                     dur_ms=round((time.perf_counter() - t1) * 1e3, 3))
         ids = out["id"]
         vals = out[self._reply_col]
+        if self._ndarray_replies:
+            # binary-negotiated exchange: skip the per-row _json_value
+            # build — the exchange serializes numpy values from the
+            # column directly (float32 block per batch)
+            return [(str(rid), v) for rid, v in zip(ids, vals)]
         return [(str(rid), _json_value(v)) for rid, v in zip(ids, vals)]
 
     # -- replies -------------------------------------------------------------

@@ -36,10 +36,18 @@ from ..core.telemetry import (current_fit_span, get_journal,
                               get_registry, merge_snapshots,
                               mirror_journal_from_env, record_flight,
                               render_prometheus)
+from . import wire
 from .transport import (CH_CONTROL, CH_METRICS, CH_SCORING, CH_STATS,
                         parse_address)
 
 log = logging.getLogger(__name__)
+
+
+# numpy → JSON-able, for the negotiated JSON fallback reply path (a
+# binary-mode engine hands numpy values through; a session without the
+# binary capability still gets correct JSON).  One shared definition —
+# the engine's transform path uses the same conversion.
+from .scoring import _json_value as _jsonable  # noqa: E402
 
 
 class _QuietThreadingHTTPServer(ThreadingHTTPServer):
@@ -570,7 +578,49 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
     # rendered exposition text
     mwaiters: Dict[str, _Pending] = {}
 
+    def _deliver_binary_replies(buf):
+        """One raw-float32 reply block (ISSUE 11): the driver batched a
+        whole micro-batch of margins into one frame; unpack, deliver to
+        the parked sockets, and answer with ONE batched delivery ack
+        instead of a JSON ack per row."""
+        try:
+            entries = wire.unpack_replies(buf)
+        except wire.WireError as e:
+            log.warning("worker %d: malformed binary reply block "
+                        "dropped: %s", worker_id, e)
+            return
+        rids, flags = [], []
+        for rid, vals in entries:
+            # the HTTP egress is JSON regardless — the one conversion
+            # happens HERE at the socket owner, not in the driver loop
+            v = vals.item() if vals.size == 1 else vals.tolist()
+            with plock:
+                p = pending.get(rid)
+                if p is not None:
+                    p.response = v
+                    p.status = 200
+                    p.event.set()
+                pl = payloads.get(rid)
+            if p is not None:
+                wstats.incr("replied")
+            journal.emit("request_reply", rid=rid,
+                         tid=_payload_tid(rid, pl), status=200,
+                         delivered=p is not None)
+            rids.append(rid)
+            flags.append(p is not None)
+        try:
+            # short timeout: this runs ON the read pump (see the JSON
+            # ack send below for the rationale)
+            client.send(CH_SCORING, {"op": "ack_many", "rids": rids,
+                                     "delivered": flags}, timeout=2.0)
+        except OSError:
+            pass
+
     def on_message(session, channel, msg, deadline_ms):
+        if isinstance(msg, (bytes, memoryview)):
+            if channel == CH_SCORING:
+                _deliver_binary_replies(msg)
+            return
         op = msg.get("op")
         if channel == CH_CONTROL:
             if op == "stop":
@@ -749,18 +799,53 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             # frame header so the driver can 504 dead work unscored
             dl = payload.get("_deadline_ms") \
                 if isinstance(payload, dict) else None
-            try:
-                client.send(CH_SCORING,
-                            {"op": "park", "rid": rid,
-                             "payload": payload},
-                            deadline_ms=dl if isinstance(
-                                dl, (int, float)) and dl > 0 else None,
-                            tc={"tid": tid})
-            except OSError:
-                # session closed for good; the wait below bounds the
-                # client's exposure (a mere blip queues the frame for
-                # replay instead of landing here)
-                pass
+            dl = dl if isinstance(dl, (int, float)) and dl > 0 else None
+            # raw-float32 park (ISSUE 11): a plain features-vector
+            # request on a binary-negotiated session ships as ONE
+            # packed float32 row — no JSON re-encode on this hop.
+            # Anything richer (explicit _trace_id, extra keys, ragged
+            # vectors) takes the negotiated JSON fallback below.
+            sent = False
+            # a _deadline_ms the header cannot carry AT ALL (a
+            # string-typed or non-positive value the ENGINE would still
+            # parse from the payload) keeps the JSON wire.  Note the
+            # carried semantics intentionally differ in one way: the
+            # header deadline is the REMAINING budget at frame-send
+            # time (decremented by worker-side queueing/replay — the
+            # transport's propagation contract), while the JSON
+            # payload key keeps the original budget; the binary wire
+            # is therefore the stricter of the two, never the looser.
+            if (client.session.peer_binary and isinstance(payload, dict)
+                    and "features" in payload
+                    and set(payload) <= {"features", "_deadline_ms"}
+                    and ("_deadline_ms" not in payload
+                         or dl is not None)):
+                try:
+                    row = np.asarray(payload["features"],
+                                     dtype=np.float32)
+                    if row.ndim == 1 and row.size:
+                        client.session.send_bytes(
+                            CH_SCORING,
+                            wire.pack_matrix(rid, row.reshape(1, -1)),
+                            deadline_ms=dl)
+                        sent = True
+                except (TypeError, ValueError):
+                    sent = False         # undecodable: JSON carries it
+                except OSError:
+                    sent = True          # session closed; same exposure
+                    #                      bound as the JSON path below
+            if not sent:
+                try:
+                    client.send(CH_SCORING,
+                                {"op": "park", "rid": rid,
+                                 "payload": payload},
+                                deadline_ms=dl,
+                                tc={"tid": tid})
+                except OSError:
+                    # session closed for good; the wait below bounds
+                    # the client's exposure (a mere blip queues the
+                    # frame for replay instead of landing here)
+                    pass
             ok = p.event.wait(reply_timeout)
             with plock:
                 # atomic here, where the socket lives: once popped, a
@@ -888,6 +973,12 @@ class MultiprocessHTTPServer:
     """
 
     _SWEEP_EVERY = 512
+
+    #: the scoring engine reads this: replies may stay numpy (sliced
+    #: straight off the margin ndarray) — this exchange serializes them
+    #: per session: a raw-float32 block on binary-negotiated sessions,
+    #: the JSON fallback otherwise (ISSUE 11)
+    binary_wire = True
 
     def __init__(self, num_workers: int = 2, host: str = "127.0.0.1",
                  api_path: str = "/", reply_timeout: float = 30.0,
@@ -1156,7 +1247,12 @@ class MultiprocessHTTPServer:
         """App-protocol dispatch for one authenticated exchange
         session.  The transport already enforced magic/version/token,
         framing, CRC and sequencing — by the time a message lands here
-        it is a well-formed JSON object from a tokened peer."""
+        it is a well-formed JSON object from a tokened peer, or a raw
+        binary scoring payload (FLAG_BINARY frame) this method routes
+        to the zero-copy park path."""
+        if isinstance(msg, (bytes, memoryview)):
+            self._on_binary_scoring(session, channel, msg, deadline_ms)
+            return
         op = msg.get("op")
         if channel == CH_CONTROL and op == "hello":
             self._on_worker_hello(session, msg)
@@ -1193,6 +1289,19 @@ class MultiprocessHTTPServer:
                 if entry is not None:
                     waiter = entry[0]
                     waiter.response = msg["delivered"]
+                    waiter.event.set()
+            elif op == "ack_many":
+                # batched delivery ack answering a binary reply block:
+                # one frame resolves the whole micro-batch's waiters
+                resolved = []
+                with self._lock:
+                    for rid, d in zip(msg.get("rids") or (),
+                                      msg.get("delivered") or ()):
+                        entry = self._acks.pop(rid, None)
+                        if entry is not None:
+                            resolved.append((entry[0], bool(d)))
+                for waiter, d in resolved:
+                    waiter.response = d
                     waiter.event.set()
         elif channel == CH_STATS and op == "stats":
             # periodic worker-stats beacon: keep the last-known
@@ -1244,6 +1353,65 @@ class MultiprocessHTTPServer:
                              timeout=2.0)
             except OSError:
                 pass
+
+    def _on_binary_scoring(self, session, channel: int, buf,
+                           deadline_ms) -> None:
+        """Zero-copy park: a raw-float32 scoring request
+        (io/wire.py preamble + packed row block) lands on the queue as
+        a float32 view — no JSON, no per-value Python objects.  A
+        malformed preamble costs exactly ONE request (a per-row 400
+        when the rid is recoverable), never the connection — the same
+        blast-radius contract the JSON decode path gives."""
+        def refuse(rid):
+            # the per-request 400 of the blast-radius contract: one
+            # bad payload costs ONE request, never the connection
+            if not rid:
+                return
+            try:
+                session.send(CH_SCORING,
+                             {"op": "reply", "rid": rid,
+                              "response": {"error": "bad request"},
+                              "status": 400}, timeout=2.0)
+            except OSError:
+                pass
+
+        if channel != CH_SCORING:
+            log.warning("serving: unexpected binary payload on "
+                        "channel %d dropped", channel)
+            return
+        try:
+            kind, rid, X = wire.unpack_matrix(buf)
+        except wire.WireError as e:
+            rid = wire.peek_rid(buf)
+            log.warning("serving: malformed binary scoring payload "
+                        "(%s); %s", e,
+                        f"400ing request {rid[:8]}" if rid
+                        else "rid unrecoverable, dropping")
+            refuse(rid)
+            return
+        if kind != wire.K_REQ:
+            log.warning("serving: unexpected binary payload kind %d "
+                        "dropped", kind)
+            return
+        if X.shape[0] != 1:
+            # the exchange park contract is ONE row per request id —
+            # the engine maps one decoded row to one batch entry, so a
+            # multi-row block under a single rid would misalign scores
+            # across co-batched requests.  Multi-row matrices are the
+            # FLEET protocol (io/fleet.py).
+            log.warning("serving: %d-row binary park %s rejected "
+                        "(one row per request)", X.shape[0], rid[:8])
+            refuse(rid)
+            return
+        payload = (wire.BinaryReq(X, deadline_ms) if deadline_ms
+                   else X)
+        with self._lock:
+            self._route[rid] = (session.sid, time.monotonic(),
+                                str(rid))
+            self._parks += 1
+            if self._parks % self._SWEEP_EVERY == 0:
+                self._sweep_routes_locked()
+        self.queue.put_unique((rid, payload, time.perf_counter()))
 
     def _on_worker_hello(self, session, msg: dict) -> None:
         w = msg.get("worker")
@@ -1366,6 +1534,21 @@ class MultiprocessHTTPServer:
             return None, None
         return session, entry[2]
 
+    @staticmethod
+    def _binary_value_ok(v) -> bool:
+        """Can this reply value ride the raw-float32 block?  Only
+        values that are ALREADY float32 (the predictor hot path's
+        margin dtype) — anything wider (python floats, float64
+        transform columns) or integer would be silently narrowed, so
+        those keep the exact JSON path, as do error dicts, strings and
+        object columns."""
+        if isinstance(v, (np.ndarray, np.generic)):
+            a = np.asarray(v)
+            # size cap mirrors the wire's u16 n_values field, so the
+            # pack cannot fail after classification
+            return a.dtype == np.float32 and a.size <= 0xFFFF
+        return False
+
     def reply(self, request_id: str, response: Any,
               status: int = 200) -> bool:
         """Route a reply to the worker PROCESS holding the socket; blocks
@@ -1379,10 +1562,24 @@ class MultiprocessHTTPServer:
         with self._lock:
             self._acks[request_id] = (waiter, session.sid)
         try:
-            session.send(CH_SCORING,
-                         {"op": "reply", "rid": request_id,
-                          "response": response, "status": status},
-                         tc={"tid": tid})
+            sent_binary = False
+            if (status == 200 and session.peer_binary
+                    and self._binary_value_ok(response)):
+                try:
+                    session.send_bytes(
+                        CH_SCORING,
+                        wire.pack_replies([(request_id, response)]))
+                    sent_binary = True
+                except ValueError:
+                    # a value that refuses to pack (e.g. >u16 floats)
+                    # falls back to the JSON frame, like reply_many
+                    sent_binary = False
+            if not sent_binary:
+                session.send(CH_SCORING,
+                             {"op": "reply", "rid": request_id,
+                              "response": _jsonable(response),
+                              "status": status},
+                             tc={"tid": tid})
         except OSError:
             # worker session closed between park and reply: undelivered
             with self._lock:
@@ -1397,8 +1594,18 @@ class MultiprocessHTTPServer:
     def reply_many(self, entries: List[Tuple[str, Any, int]]) -> int:
         """Pipelined batch reply: send every reply frame first, then
         collect the delivery acks — one exchange round-trip for the
-        whole micro-batch instead of a blocking RTT per row."""
+        whole micro-batch instead of a blocking RTT per row.
+
+        Binary-negotiated sessions get their whole micro-batch as ONE
+        raw-float32 reply block serialized straight from the margin
+        values (no ``tolist()``, no per-row JSON frames) and answer
+        with one batched ``ack_many``; error replies and non-binary
+        sessions keep the per-row JSON frames (the negotiated
+        fallback/error path)."""
         waiting: List[Tuple[str, _Pending]] = []
+        #: session.sid -> (session, [(rid, value), ...]) — one binary
+        #: block per (session, batch)
+        bin_groups: Dict[str, Tuple[Any, List[Tuple[str, Any]]]] = {}
         for rid, response, status in entries:
             session, tid = self._reply_session(rid)
             if session is None:
@@ -1406,20 +1613,42 @@ class MultiprocessHTTPServer:
             waiter = _Pending()
             with self._lock:
                 self._acks[rid] = (waiter, session.sid)
+            if (status == 200 and session.peer_binary
+                    and self._binary_value_ok(response)):
+                bin_groups.setdefault(
+                    session.sid, (session, []))[1].append(
+                        (rid, response))
+                waiting.append((rid, waiter))
+                continue
             try:
                 session.send(CH_SCORING,
                              {"op": "reply", "rid": rid,
-                              "response": response, "status": status},
+                              "response": _jsonable(response),
+                              "status": status},
                              tc={"tid": tid})
             except OSError:
                 with self._lock:
                     self._acks.pop(rid, None)
                 continue
             waiting.append((rid, waiter))
+        dead: set = set()
+        for session, items in bin_groups.values():
+            try:
+                session.send_bytes(CH_SCORING,
+                                   wire.pack_replies(items))
+            except (OSError, ValueError):
+                # session died (or a value refused to pack): those
+                # waiters are undelivered NOW, not after the ack wait
+                with self._lock:
+                    for rid, _v in items:
+                        self._acks.pop(rid, None)
+                        dead.add(rid)
         delivered = 0
         deadline = time.monotonic() + self._reply_timeout \
             + self._ack_grace
         for rid, waiter in waiting:
+            if rid in dead:
+                continue
             if waiter.event.wait(max(0.0, deadline - time.monotonic())) \
                     and bool(waiter.response):
                 delivered += 1
@@ -1455,9 +1684,21 @@ def request_table(batch: List[Tuple[str, Any]]) -> DataTable:
     list values); anything else lands in a ``value`` object column.
     Entries may be ``(rid, payload)`` or the stamped ``(rid, payload,
     t_enqueue)`` triples the resilience-aware queue carries.
+
+    Binary-wire payloads (float32 row views /
+    :class:`~mmlspark_tpu.io.wire.BinaryReq`, ISSUE 11) are converted
+    back to ``{"features": [...]}`` dicts here so a TRANSFORM-mode
+    engine behind the binary exchange keeps its column contract — the
+    per-value cost lands only on this legacy path, never on the
+    predictor hot path (which consumes the views directly).
     """
     ids = np.asarray([e[0] for e in batch], dtype=object)
     payloads = [e[1] for e in batch]
+    payloads = [
+        {"features": (p.X if isinstance(p, wire.BinaryReq)
+                      else p).ravel().tolist()}
+        if isinstance(p, (np.ndarray, wire.BinaryReq)) else p
+        for p in payloads]
     cols: Dict[str, Any] = {"id": ids}
     if payloads and all(isinstance(p, dict) for p in payloads):
         keys = set(payloads[0])

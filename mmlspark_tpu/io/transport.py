@@ -40,6 +40,16 @@ transport IS the fault boundary — SURVEY.md §3.4):
   reconnect (bounded exponential backoff, jittered) replays exactly the
   suffix the peer has not seen — the receiver drops duplicates by
   sequence number, so a link blip loses nothing and duplicates nothing.
+* **Binary payloads** (ISSUE 11) — the frame header's ``flags`` field
+  gained :data:`FLAG_BINARY`: a frame so marked carries raw bytes that
+  are handed to the app ``on_message`` verbatim — no JSON encode on
+  the sender, no ``json.loads`` on the receiver, zero per-value Python
+  objects on the wire path.  The capability is NEGOTIATED at handshake
+  (``bin: 1`` in HELLO/HELLO_ACK, see :attr:`Session.peer_binary`);
+  ``send_bytes`` refuses when the peer did not negotiate it, so a
+  version-skewed peer degrades to the JSON wire instead of receiving
+  frames it would misparse.  The scoring hot path rides this as the
+  raw-float32 wire (:mod:`mmlspark_tpu.io.wire`).
 * **Trace context** (ISSUE 8) — ``send(..., tc={"tid": ...})`` attaches
   a reserved ``_tc`` payload key carrying the trace id and the sender's
   wall clock; both endpoints journal per-hop transport spans
@@ -55,7 +65,14 @@ under the ``transport`` namespace): ``frames_sent`` / ``frames_recvd``
 / ``bytes_sent`` / ``bytes_recvd`` / ``retransmits`` / ``crc_drops`` /
 ``dup_drops`` / ``backpressure_stalls`` / ``reconnects`` / ``resumes``
 / ``session_resets`` / ``keepalive_drops`` / ``oversize_rejected`` /
-``handshake_rejects``.
+``handshake_rejects`` / ``bin_frames_sent`` / ``bin_frames_recvd``,
+plus per-channel DATA payload byte counters
+(``payload_bytes_sent_ch<N>`` / ``payload_bytes_recvd_ch<N>``) and the
+wire codec timers (``encode_json`` / ``decode_json`` here;
+``encode_binary`` / ``decode_binary`` recorded by
+:mod:`mmlspark_tpu.io.wire`) — the encode/decode cost of the two wires
+is readable off one scrape, and ``tools/bench_serving.py --wire``
+commits the A/B from exactly these numbers.
 
 Chaos: :class:`~mmlspark_tpu.io.chaos.ChaosTransport` wraps either
 end's socket via ``TransportConfig.socket_wrap`` (frame bitflips, ack
@@ -86,10 +103,10 @@ log = logging.getLogger(__name__)
 
 __all__ = [
     "Backpressure", "CH_CONTROL", "CH_ELASTIC", "CH_METRICS",
-    "CH_SCORING", "CH_STATS", "ChecksumError", "FrameTooLarge",
-    "HandshakeError", "Session", "TransportClient", "TransportConfig",
-    "TransportError", "TransportServer", "crc32c", "parse_address",
-    "transport_stats",
+    "CH_SCORING", "CH_STATS", "ChecksumError", "FLAG_BINARY",
+    "FrameTooLarge", "HandshakeError", "Session", "TransportClient",
+    "TransportConfig", "TransportError", "TransportServer", "crc32c",
+    "parse_address", "transport_stats",
 ]
 
 # -- protocol constants ------------------------------------------------------
@@ -110,6 +127,12 @@ T_PING = 6        # keepalive probe
 T_PONG = 7        # keepalive answer
 T_ERROR = 8       # typed refusal: {code, detail}; sender closes after
 T_CLOSE = 9       # orderly end of session: no resume expected
+
+#: frame-header flag: the payload is raw bytes, NOT JSON — delivered
+#: to the app ``on_message`` verbatim.  Only valid on T_DATA frames and
+#: only after both peers negotiated ``bin`` at handshake; the scoring
+#: hot path's raw-float32 wire (io/wire.py) rides this flag.
+FLAG_BINARY = 0x0001
 
 #: logical channels — one connection carries all of them
 CH_CONTROL = 0    # session control: app hello, ready beacons, stop
@@ -281,6 +304,11 @@ class TransportConfig:
     reconnect_tries: int = 5
     reconnect_backoff: Tuple[float, float] = (0.1, 2.0)
     connect_timeout_s: float = 10.0
+    #: offer the FLAG_BINARY payload capability in the client HELLO.
+    #: Production leaves this on; the wire-format A/B bench
+    #: (``tools/bench_serving.py --wire json``) pins it off so BOTH
+    #: directions measurably ride the JSON fallback
+    offer_binary: bool = True
     #: chaos hook: wraps every raw socket right after connect/accept
     #: (:class:`~mmlspark_tpu.io.chaos.ChaosTransport` plugs in here)
     socket_wrap: Optional[Callable[[socket.socket], Any]] = None
@@ -292,8 +320,14 @@ def _new_stats() -> StageStats:
               "retransmits", "crc_drops", "dup_drops",
               "backpressure_stalls", "reconnects", "resumes",
               "session_resets", "keepalive_drops", "oversize_rejected",
-              "handshake_rejects"):
+              "handshake_rejects", "bin_frames_sent", "bin_frames_recvd"):
         s.incr(k, 0)
+    # per-channel DATA payload bytes: the wire-format A/B
+    # (tools/bench_serving.py --wire) reads payload volume per channel
+    # straight off a scrape instead of instrumenting call sites
+    for ch in (CH_CONTROL, CH_SCORING, CH_ELASTIC, CH_STATS, CH_METRICS):
+        s.incr(f"payload_bytes_sent_ch{ch}", 0)
+        s.incr(f"payload_bytes_recvd_ch{ch}", 0)
     return s
 
 
@@ -301,6 +335,16 @@ def _new_stats() -> StageStats:
 #: process and federated under the ``transport`` namespace so every
 #: ``/metrics`` scrape carries them
 transport_stats = _new_stats()
+# JSON wire codec timers, resolved once (timer() locks per call — a
+# measurable tax at per-frame rates; the binary codec in io/wire.py
+# caches its timers the same way, so the A/B stays apples-to-apples)
+_ENC_JSON = transport_stats.timer("encode_json")
+_DEC_JSON = transport_stats.timer("decode_json")
+# per-channel payload-byte counter KEYS, precomputed for the same
+# reason (no per-frame f-string build; channels above the table fall
+# back to on-the-fly names)
+_PB_SENT = tuple(f"payload_bytes_sent_ch{c}" for c in range(8))
+_PB_RECVD = tuple(f"payload_bytes_recvd_ch{c}" for c in range(8))
 _stats_registered = threading.Event()
 
 
@@ -315,6 +359,7 @@ def _ensure_registered() -> None:
 
 def encode_frame(ftype: int, channel: int, payload: bytes, *,
                  seq: int = 0, ack: int = 0, deadline_ms: int = 0,
+                 flags: int = 0,
                  max_frame_bytes: int = 8 << 20) -> bytes:
     """One wire frame: u32 length, 28-byte header, payload."""
     size = HEADER_BYTES + len(payload)
@@ -322,7 +367,7 @@ def encode_frame(ftype: int, channel: int, payload: bytes, *,
         raise FrameTooLarge(
             f"frame of {size} bytes exceeds max_frame_bytes="
             f"{max_frame_bytes}")
-    prefix = _HPREFIX.pack(ftype, channel, 0, seq, ack,
+    prefix = _HPREFIX.pack(ftype, channel, flags, seq, ack,
                            min(int(deadline_ms), 0xFFFFFFFF))
     crc = crc32c(payload, crc32c(prefix))
     return _LEN.pack(size) + prefix + _CRC.pack(crc) + payload
@@ -356,8 +401,8 @@ def _recv_exact(sock, n: int) -> bytes:
 
 
 def read_frame(sock, max_frame_bytes: int
-               ) -> Tuple[int, int, int, int, int, bytes]:
-    """Read one frame: ``(type, channel, seq, ack, deadline_ms,
+               ) -> Tuple[int, int, int, int, int, int, bytes]:
+    """Read one frame: ``(type, channel, flags, seq, ack, deadline_ms,
     payload)``.  Oversized frames raise :class:`FrameTooLarge` (the
     link must be closed — the stream cannot be re-synced); CRC
     mismatches raise :class:`ChecksumError`."""
@@ -370,7 +415,7 @@ def read_frame(sock, max_frame_bytes: int
     if size < HEADER_BYTES:
         raise _ProtocolError(f"frame shorter than header ({size} bytes)")
     buf = _recv_exact(sock, size)
-    ftype, channel, _flags, seq, ack, deadline_ms = \
+    ftype, channel, flags, seq, ack, deadline_ms = \
         _HPREFIX.unpack_from(buf)
     crc = _CRC.unpack_from(buf, _HPREFIX.size)[0]
     payload = buf[HEADER_BYTES:]
@@ -380,7 +425,7 @@ def read_frame(sock, max_frame_bytes: int
             f"frame CRC32C mismatch on channel {channel} (seq {seq})")
     transport_stats.incr("frames_recvd")
     transport_stats.incr("bytes_recvd", 4 + size)
-    return ftype, channel, seq, ack, deadline_ms, payload
+    return ftype, channel, flags, seq, ack, deadline_ms, payload
 
 
 # -- session -----------------------------------------------------------------
@@ -409,14 +454,18 @@ class Session:
         self.on_message = on_message
         #: app scratch (the serving driver stores the worker slot here)
         self.meta: Dict[str, Any] = {}
+        #: the peer negotiated :data:`FLAG_BINARY` payloads at handshake
+        #: (``bin: 1`` in HELLO/HELLO_ACK); gates :meth:`send_bytes` so
+        #: a version-skewed peer keeps getting the JSON wire
+        self.peer_binary = False
         self._sock: Any = None
         self._slock = threading.Lock()      # wire write serialization
         self._cv = threading.Condition()    # credits + connect state
         self._credits = 0
         self._next_seq = 0                  # last DATA seq assigned
         self._peer_ack = 0                  # highest seq peer confirmed
-        #: seq -> (channel, payload, abs_deadline_monotonic|None)
-        self._unacked: "OrderedDict[int, Tuple[int, bytes, Optional[float]]]" = OrderedDict()
+        #: seq -> (channel, payload, abs_deadline_monotonic|None, flags)
+        self._unacked: "OrderedDict[int, Tuple[int, bytes, Optional[float], int]]" = OrderedDict()
         self._recv_seq = 0                  # highest contiguous seq seen
         self._since_ack = 0
         self._since_credit = 0
@@ -523,7 +572,37 @@ class Session:
         if tid:
             obj = dict(obj)
             obj["_tc"] = {"tid": tid, "sts": round(time.time(), 6)}
+        t0 = time.perf_counter()
         payload = json.dumps(obj).encode("utf-8")
+        _ENC_JSON.record(time.perf_counter() - t0)
+        return self._enqueue(channel, payload, 0, deadline_ms,
+                             timeout, tid)
+
+    def send_bytes(self, channel: int, data, *,
+                   deadline_ms: Optional[float] = None,
+                   timeout: Optional[float] = None) -> int:
+        """Send one RAW binary message on ``channel`` — the payload
+        bytes reach the peer's ``on_message`` verbatim (no JSON on
+        either side; :data:`FLAG_BINARY` rides the frame header).
+        Requires the peer to have negotiated binary payloads at
+        handshake (:attr:`peer_binary`) — callers gate on that flag and
+        fall back to :meth:`send`; calling without it is a programming
+        error and raises :class:`TransportError` rather than feeding a
+        peer frames it would misparse.  Same credit/backpressure/replay
+        semantics as :meth:`send`."""
+        if not self.peer_binary:
+            raise TransportError(
+                f"{self.name}: peer did not negotiate binary payloads "
+                "(send_bytes requires the handshake 'bin' capability)")
+        payload = bytes(data)
+        transport_stats.incr("bin_frames_sent")
+        return self._enqueue(channel, payload, FLAG_BINARY, deadline_ms,
+                             timeout, None)
+
+    def _enqueue(self, channel: int, payload: bytes, flags: int,
+                 deadline_ms: Optional[float],
+                 timeout: Optional[float],
+                 tid: Optional[str]) -> int:
         if HEADER_BYTES + len(payload) > self.cfg.max_frame_bytes:
             raise FrameTooLarge(
                 f"message of {len(payload)} bytes exceeds "
@@ -552,9 +631,12 @@ class Session:
             seq = self._next_seq
             abs_deadline = (time.monotonic() + deadline_ms / 1e3
                             if deadline_ms else None)
-            self._unacked[seq] = (channel, payload, abs_deadline)
+            self._unacked[seq] = (channel, payload, abs_deadline, flags)
             if tid:
                 self._traced[seq] = tid
+        transport_stats.incr(
+            _PB_SENT[channel] if channel < len(_PB_SENT)
+            else f"payload_bytes_sent_ch{channel}", len(payload))
         if tid:
             get_journal().emit("hop_enqueue", tid=tid, channel=channel,
                                seq=seq, session=self.name)
@@ -579,7 +661,7 @@ class Session:
                     entry = self._unacked.get(nxt)
                 if sock is None or entry is None:
                     return n
-                channel, payload, abs_deadline = entry
+                channel, payload, abs_deadline, flags = entry
                 remaining = 0
                 if abs_deadline is not None:
                     remaining = max(
@@ -587,6 +669,7 @@ class Session:
                 frame = encode_frame(
                     T_DATA, channel, payload, seq=nxt,
                     ack=self._recv_seq, deadline_ms=remaining,
+                    flags=flags,
                     max_frame_bytes=self.cfg.max_frame_bytes)
                 try:
                     sock.sendall(frame)
@@ -663,8 +746,8 @@ class Session:
 
     # ---- receiving ----
 
-    def on_data_frame(self, channel: int, seq: int, deadline_ms: int,
-                      payload: bytes) -> None:
+    def on_data_frame(self, channel: int, flags: int, seq: int,
+                      deadline_ms: int, payload: bytes) -> None:
         """Sequence-check one inbound DATA frame and deliver it.
         Duplicates (replay overlap after a resume) are dropped by seq;
         a sequence GAP means the stream lost frames the resume protocol
@@ -691,7 +774,18 @@ class Session:
                 self._wire_send(T_ACK, CH_CONTROL, b"")
             except OSError:
                 pass
-        obj = json.loads(payload.decode("utf-8"))
+        transport_stats.incr(
+            _PB_RECVD[channel] if channel < len(_PB_RECVD)
+            else f"payload_bytes_recvd_ch{channel}", len(payload))
+        if flags & FLAG_BINARY:
+            # raw payload: hand the bytes to the app verbatim — the
+            # scoring wire's whole point is that NOTHING decodes here
+            transport_stats.incr("bin_frames_recvd")
+            obj: Any = payload
+        else:
+            t0 = time.perf_counter()
+            obj = json.loads(payload.decode("utf-8"))
+            _DEC_JSON.record(time.perf_counter() - t0)
         if isinstance(obj, dict) and "_tc" in obj:
             # reserved trace-context key: strip it before the app sees
             # the payload, journal the delivery hop with the send→recv
@@ -733,13 +827,14 @@ class Session:
         the caller decides whether to resume."""
         try:
             while not self.closed:
-                (ftype, channel, seq, ack, deadline_ms,
+                (ftype, channel, flags, seq, ack, deadline_ms,
                  payload) = read_frame(sock, self.cfg.max_frame_bytes)
                 self.last_recv = time.monotonic()
                 if ack:
                     self.acknowledge(ack)
                 if ftype == T_DATA:
-                    self.on_data_frame(channel, seq, deadline_ms, payload)
+                    self.on_data_frame(channel, flags, seq, deadline_ms,
+                                       payload)
                 elif ftype == T_CREDIT:
                     self.grant(seq)
                 elif ftype == T_PING:
@@ -956,7 +1051,7 @@ class TransportServer:
                          f"server speaks v{VERSION}, "
                          f"peer sent v{preamble[len(MAGIC)]}")
             return None
-        ftype, _ch, _seq, _ack, _dl, payload = read_frame(
+        ftype, _ch, _fl, _seq, _ack, _dl, payload = read_frame(
             conn, self.cfg.max_frame_bytes)
         if ftype != T_HELLO:
             transport_stats.incr("handshake_rejects")
@@ -985,6 +1080,9 @@ class TransportServer:
                                   name=f"{self.name}:{sid[:8]}")
                 self.sessions[sid] = session
             self._dc_since.pop(sid, None)
+        # binary-payload capability: negotiated per HANDSHAKE (a resume
+        # from an upgraded or downgraded peer re-evaluates it)
+        session.peer_binary = bool(hello.get("bin"))
         if resumed:
             session.detach()   # a takeover replaces any stale link
         return session, resumed, peer_last, peer_credits
@@ -1028,7 +1126,8 @@ class TransportServer:
             ack_payload = json.dumps({
                 "session": session.sid, "resumed": resumed,
                 "last_recv": session._recv_seq,
-                "credits": self.cfg.initial_credits}).encode("utf-8")
+                "credits": self.cfg.initial_credits,
+                "bin": 1 if session.peer_binary else 0}).encode("utf-8")
             session._wire_send(T_HELLO_ACK, CH_CONTROL, ack_payload)
         except (OSError, ValueError, KeyError):
             # pre-auth timeout, torn handshake, garbage peer — nothing
@@ -1165,6 +1264,13 @@ class TransportClient:
         return self.session.send(channel, obj, deadline_ms=deadline_ms,
                                  timeout=timeout, tc=tc)
 
+    def send_bytes(self, channel: int, data, *,
+                   deadline_ms: Optional[float] = None,
+                   timeout: Optional[float] = None) -> int:
+        return self.session.send_bytes(channel, data,
+                                       deadline_ms=deadline_ms,
+                                       timeout=timeout)
+
     def connect(self, *, retries: Optional[int] = None
                 ) -> "TransportClient":
         """Dial and handshake; raises on failure after the bounded
@@ -1214,11 +1320,13 @@ class TransportClient:
             hello = json.dumps({
                 "token": self.token, "session": self.session.sid,
                 "last_recv": self.session._recv_seq,
-                "credits": self.cfg.initial_credits}).encode("utf-8")
+                "credits": self.cfg.initial_credits,
+                "bin": 1 if self.cfg.offer_binary else 0
+                }).encode("utf-8")
             sock.sendall(encode_frame(
                 T_HELLO, CH_CONTROL, hello,
                 max_frame_bytes=self.cfg.max_frame_bytes))
-            ftype, _ch, _seq, _ack, _dl, payload = read_frame(
+            ftype, _ch, _fl, _seq, _ack, _dl, payload = read_frame(
                 sock, self.cfg.max_frame_bytes)
             if ftype == T_ERROR:
                 err = json.loads(payload.decode("utf-8"))
@@ -1233,6 +1341,9 @@ class TransportClient:
             resumed = bool(ack.get("resumed"))
             credits = int(ack.get("credits",
                                   self.cfg.initial_credits))
+            # binary capability confirmed by the server (an old server
+            # omits the key → JSON wire everywhere)
+            self.session.peer_binary = bool(ack.get("bin"))
             sock.settimeout(None)
         except BaseException:
             try:

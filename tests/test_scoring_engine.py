@@ -417,6 +417,98 @@ class TestColumnPlan:
         assert np.array_equal(plan.decode_table(t),
                               plan.decode([p for _, p in batch]))
 
+    def test_decode_binary_views_single_row_zero_copy(self):
+        """Binary wire (ISSUE 11): a one-entry batch passes the
+        frombuffer view STRAIGHT through — no copy, no JSON path."""
+        from mmlspark_tpu.io import wire
+        plan = ColumnPlan("features", 4)
+        row = np.arange(4, dtype=np.float32).reshape(1, 4)
+        _k, _rid, view = wire.unpack_matrix(
+            wire.pack_matrix("r", row))
+        X = plan.decode([view])
+        assert X is view                       # zero-copy
+        assert np.array_equal(X, row)
+
+    def test_decode_binary_batch_concatenates(self):
+        from mmlspark_tpu.io.wire import BinaryReq
+        plan = ColumnPlan("features", 3)
+        rows = [np.full((1, 3), i, np.float32) for i in range(5)]
+        rows[2] = BinaryReq(rows[2], 1000.0)   # deadline-wrapped entry
+        X = plan.decode(rows)
+        assert X.shape == (5, 3) and X.dtype == np.float32
+        assert np.array_equal(X[:, 0], np.arange(5, dtype=np.float32))
+
+    def test_decode_binary_width_mismatch_raises(self):
+        plan = ColumnPlan("features", 4)
+        with pytest.raises(ValueError, match="expects"):
+            plan.decode([np.ones((1, 2), np.float32)])
+
+    def test_request_table_reconstitutes_binary_payloads(self):
+        """Transform-mode engines behind the binary exchange keep
+        their column contract: binary row views come back as a
+        ``features`` column in request_table."""
+        from mmlspark_tpu.io.serving import request_table
+        from mmlspark_tpu.io.wire import BinaryReq
+        batch = [("a", np.asarray([[1.0, 2.0]], np.float32)),
+                 ("b", BinaryReq(np.asarray([[3.0, 4.0]], np.float32),
+                                 1000.0)),
+                 ("c", {"features": [5.0, 6.0]})]
+        t = request_table(batch)
+        assert np.allclose(t["features"],
+                           [[1, 2], [3, 4], [5, 6]])
+        assert list(t["id"]) == ["a", "b", "c"]
+
+    def test_binary_wire_scores_match_json_wire(self, model_and_data):
+        """Bit-exact parity between the two wires: the SAME rows
+        decoded from JSON payloads and from packed float32 blocks
+        produce identical margins (and both equal predict_margin)."""
+        from mmlspark_tpu.io import wire
+        b, X = model_and_data
+        plan = ColumnPlan("features", X.shape[1])
+        pred = b.predictor()
+        rows = X[:32]
+        Xj = plan.decode([{"features": r.tolist()} for r in rows])
+        views = [wire.unpack_matrix(
+            wire.pack_matrix(str(i), rows[i:i + 1]))[2]
+            for i in range(32)]
+        Xb = plan.decode(views)
+        assert np.array_equal(Xj, Xb)
+        mj = np.asarray(pred(Xj))
+        mb = np.asarray(pred(Xb))
+        want = np.asarray(b.predict_margin(rows)).astype(np.float32)
+        assert np.array_equal(mj, mb)
+        assert np.allclose(mj, want, rtol=1e-6, atol=1e-6)
+
+
+class TestBinaryReplyMode:
+    def test_engine_skips_tolist_for_binary_wire_server(
+            self, model_and_data):
+        """A binary_wire exchange gets numpy values straight off the
+        margin ndarray (no per-row tolist/_json_value build)."""
+        from mmlspark_tpu.io.scoring import ScoringEngine
+        b, X = model_and_data
+
+        class BinServer(FakeServer):
+            binary_wire = True
+
+        srv = BinServer()
+        eng = ScoringEngine(srv, predictor=b.predictor(),
+                            plan=ColumnPlan("features", X.shape[1]))
+        batch = [(str(i), {"features": X[i].tolist()})
+                 for i in range(8)]
+        pairs = eng._score_predictor(batch)
+        want = np.asarray(b.predict_margin(X[:8])).astype(np.float32)
+        for i, (rid, v) in enumerate(pairs):
+            assert isinstance(v, np.floating), type(v)
+            assert v == want[i]
+        # the JSON-wire engine keeps returning plain floats
+        eng2 = ScoringEngine(FakeServer(), predictor=b.predictor(),
+                             plan=ColumnPlan("features", X.shape[1]))
+        pairs2 = eng2._score_predictor(batch)
+        assert all(isinstance(v, float) for _r, v in pairs2)
+        assert [float(v) for _r, v in pairs] \
+            == [v for _r, v in pairs2]
+
 
 class TestServingSmoke:
     def test_http_end_to_end_concurrent_senders(self, model_and_data):

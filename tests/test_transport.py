@@ -71,10 +71,31 @@ class TestFrameCodec:
             frame = encode_frame(tp.T_DATA, CH_SCORING, b'{"x": 1}',
                                  seq=7, ack=3, deadline_ms=1500)
             p.a.sendall(frame)
-            ftype, ch, seq, ack, dl, payload = read_frame(p.b, 1 << 20)
-            assert (ftype, ch, seq, ack, dl) == (tp.T_DATA, CH_SCORING,
-                                                 7, 3, 1500)
+            (ftype, ch, flags, seq, ack, dl,
+             payload) = read_frame(p.b, 1 << 20)
+            assert (ftype, ch, flags, seq, ack, dl) == (
+                tp.T_DATA, CH_SCORING, 0, 7, 3, 1500)
             assert payload == b'{"x": 1}'
+        finally:
+            p.close()
+
+    def test_binary_flag_roundtrip(self):
+        """FLAG_BINARY rides the header flags field and the payload
+        bytes come back verbatim (no JSON anywhere near them)."""
+        import numpy as np
+        p = _Pipe()
+        try:
+            block = np.arange(6, dtype=np.float32).tobytes()
+            frame = encode_frame(tp.T_DATA, CH_SCORING, block, seq=1,
+                                 flags=tp.FLAG_BINARY)
+            p.a.sendall(frame)
+            (ftype, ch, flags, seq, _ack, _dl,
+             payload) = read_frame(p.b, 1 << 20)
+            assert flags & tp.FLAG_BINARY
+            assert payload == block
+            assert np.array_equal(
+                np.frombuffer(payload, np.float32),
+                np.arange(6, dtype=np.float32))
         finally:
             p.close()
 
@@ -487,6 +508,226 @@ class TestTelemetryWiring:
                 assert f'event="{name}"' in text
         finally:
             srv.stop()
+
+
+class TestBinaryWire:
+    """ISSUE 11: the negotiated raw-binary payload type — capability
+    handshake, send_bytes round-trip, and the JSON fallback for peers
+    without the capability."""
+
+    def test_negotiated_and_bytes_roundtrip(self):
+        import numpy as np
+        got = []
+
+        def on_msg(sess, ch, obj, dl):
+            if isinstance(obj, (bytes, memoryview)):
+                # echo the raw block back, still binary
+                sess.send_bytes(ch, bytes(obj))
+
+        srv = TransportServer(token="t", on_message=on_msg,
+                              name="binsrv").start()
+        try:
+            c = TransportClient(srv.address, token="t",
+                                on_message=lambda s, ch, o, d:
+                                got.append(o)).connect()
+            assert c.session.peer_binary, \
+                "both in-repo endpoints must negotiate binary"
+            blocks = [np.arange(i + 1, dtype=np.float32).tobytes()
+                      for i in range(10)]
+            for b in blocks:
+                c.send_bytes(CH_SCORING, b)
+            assert _drain(got, 10) == 10
+            assert [bytes(o) for o in got] == blocks   # bit-exact
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_send_bytes_refused_without_negotiation(self):
+        s = Session("sid", TransportConfig())
+        assert not s.peer_binary
+        with pytest.raises(tp.TransportError, match="negotiate"):
+            s.send_bytes(CH_SCORING, b"\x00\x01")
+
+    def test_old_peer_without_bin_capability_gets_json_wire(self):
+        """A HELLO missing the 'bin' key (version-skewed peer) must
+        leave peer_binary False on the server session and answer
+        bin=0 — the fallback stays JSON in both directions."""
+        srv = TransportServer(token="t", name="oldpeer").start()
+        try:
+            sock = socket.create_connection(srv.address, timeout=5)
+            sock.sendall(tp.MAGIC + bytes([tp.VERSION]))
+            hello = json.dumps({"token": "t", "session": "old1",
+                                "last_recv": 0,
+                                "credits": 8}).encode()
+            sock.sendall(encode_frame(tp.T_HELLO, CH_CONTROL, hello))
+            ftype, _ch, _fl, _seq, _ack, _dl, payload = read_frame(
+                sock, 1 << 20)
+            assert ftype == tp.T_HELLO_ACK
+            ack = json.loads(payload.decode())
+            assert ack.get("bin") == 0
+            deadline = time.time() + 5
+            while "old1" not in srv.sessions and time.time() < deadline:
+                time.sleep(0.01)
+            assert not srv.sessions["old1"].peer_binary
+            sock.close()
+        finally:
+            srv.stop()
+
+    def test_binary_payload_bytes_counters_move(self):
+        sent_key = f"payload_bytes_sent_ch{CH_SCORING}"
+        got = []
+
+        srv = TransportServer(token="t", on_message=lambda s, c, o, d:
+                              got.append(o), name="cnt").start()
+        try:
+            before = tp.transport_stats.snapshot()["counters"]
+            c = TransportClient(srv.address, token="t").connect()
+            c.send_bytes(CH_SCORING, b"\x00" * 64)
+            assert _drain(got, 1) == 1
+            after = tp.transport_stats.snapshot()["counters"]
+            assert after[sent_key] >= before[sent_key] + 64
+            assert after["bin_frames_sent"] > before["bin_frames_sent"]
+            assert after["bin_frames_recvd"] \
+                > before["bin_frames_recvd"]
+            c.close()
+        finally:
+            srv.stop()
+
+
+class TestBinaryChaos:
+    """ISSUE 11 satellite: chaos on binary frames.  Bitflips inside a
+    float32 block must be caught by the frame CRC and the resume
+    replay must deliver every block bit-exact; seeded mid-frame link
+    kills likewise — zero lost, zero duplicated, bit-identical
+    float32 payloads."""
+
+    def _run_chaos_echo(self, wrap, n_blocks=40, seed_cfg=None):
+        import numpy as np
+
+        def on_msg(sess, ch, obj, dl):
+            if isinstance(obj, (bytes, memoryview)):
+                sess.send_bytes(ch, bytes(obj))
+
+        scfg = TransportConfig(socket_wrap=wrap)
+        ccfg = seed_cfg or TransportConfig(
+            reconnect_backoff=(0.05, 0.2), ack_every=4)
+        srv = TransportServer(token="t", cfg=scfg, on_message=on_msg,
+                              name="binchaos").start()
+        got = []
+        try:
+            c = TransportClient(srv.address, token="t", cfg=ccfg,
+                                on_message=lambda s, ch, o, d:
+                                got.append(bytes(o))).connect()
+            rng = np.random.default_rng(7)
+            blocks = [rng.normal(size=16).astype(np.float32).tobytes()
+                      for _ in range(n_blocks)]
+            for b in blocks:
+                c.send_bytes(CH_SCORING, b, timeout=10.0)
+                time.sleep(0.002)     # let faults land mid-traffic
+            assert _drain(got, n_blocks, timeout=20.0) == n_blocks, \
+                f"lost binary blocks: {len(got)}/{n_blocks}"
+            assert len(got) == n_blocks            # zero duplicates
+            assert got == blocks                   # bit-exact float32
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_bitflip_in_float32_block_crc_drop_then_bit_exact(self):
+        plan = ChaosPlan(seed=77)
+        conn_n = [0]
+
+        def wrap(sock):
+            conn_n[0] += 1
+            if conn_n[0] <= 2:
+                return ChaosTransport(sock, plan, bitflip_rate=0.08,
+                                      name=f"binflip{conn_n[0]}")
+            return sock
+
+        crc0 = tp.transport_stats.snapshot()["counters"]["crc_drops"]
+        self._run_chaos_echo(wrap)
+        assert tp.transport_stats.snapshot()["counters"]["crc_drops"] \
+            > crc0, "no bitflip was caught — injection did not fire"
+        assert conn_n[0] > 1       # the poisoned link actually died
+
+    def test_mid_frame_kill_inside_block_resume_replays(self):
+        plan = ChaosPlan(seed=88)
+        conn_n = [0]
+
+        def wrap(sock):
+            conn_n[0] += 1
+            if conn_n[0] <= 3:
+                return ChaosTransport(sock, plan, kill_on_sends={9},
+                                      name=f"binkill{conn_n[0]}")
+            return sock
+
+        self._run_chaos_echo(wrap)
+        assert conn_n[0] > 1
+
+
+class TestNoJSONOnScoringHotPath:
+    """Tier-1 guard (ISSUE 11 satellite): the SCORING hot path is
+    JSON-free.  Every ``json.loads``/``json.dumps`` call site in the
+    wire-facing io modules must sit inside an explicitly allowlisted
+    fallback/admission/error function — a new JSON call anywhere else
+    (the binary codec, the fleet reduce, the engine decode/reply path)
+    fails the suite."""
+
+    #: (module, enclosing function) pairs where JSON is ALLOWED:
+    #: the negotiated JSON fallback wire, the handshake/admission
+    #: path, error refusals, and the HTTP edge (client-facing JSON)
+    ALLOWED = {
+        "transport.py": {
+            "send",            # negotiated JSON wire (fallback)
+            "on_data_frame",   # negotiated JSON wire (fallback)
+            "_handshake", "_refuse", "_serve_conn",   # admission
+            "_dial_once",                             # admission
+        },
+        "serving.py": {
+            "_send_json", "do_GET",   # HTTP edge (client JSON)
+            "do_POST",                # HTTP edge parse + egress
+        },
+        # the binary codec, the engine, and the fleet must be 100%
+        # JSON-free — they ARE the hot path
+        "wire.py": set(),
+        "scoring.py": set(),
+        "fleet.py": set(),
+    }
+
+    def _json_sites(self, path):
+        import ast
+        tree = ast.parse(open(path, encoding="utf-8").read())
+        sites = []
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                nxt = stack
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    nxt = stack + [child.name]
+                if (isinstance(child, ast.Attribute)
+                        and isinstance(child.value, ast.Name)
+                        and child.value.id == "json"
+                        and child.attr in ("loads", "dumps")):
+                    sites.append((stack[-1] if stack else "<module>",
+                                  child.lineno))
+                walk(child, nxt)
+
+        walk(tree, [])
+        return sites
+
+    def test_json_only_in_negotiated_fallback_and_admission(self):
+        io_dir = os.path.join(REPO, "mmlspark_tpu", "io")
+        offenders = []
+        for fname, allowed in self.ALLOWED.items():
+            for func, lineno in self._json_sites(
+                    os.path.join(io_dir, fname)):
+                if func not in allowed:
+                    offenders.append(f"io/{fname}:{lineno} in "
+                                     f"{func}()")
+        assert not offenders, (
+            "json.loads/json.dumps crept onto the scoring hot path "
+            f"(outside the negotiated fallback / admission / error "
+            f"allowlist): {offenders}")
 
 
 class TestNoBespokeFraming:
