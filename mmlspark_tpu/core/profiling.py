@@ -235,6 +235,15 @@ class StageStats:
                 stats = self._stages[stage] = LatencyStats()
             return stats
 
+    def adopt(self, stage: str, stats: LatencyStats) -> None:
+        """Expose an EXISTING :class:`LatencyStats` under ``stage`` —
+        the histogram object is SHARED, not copied, so records made by
+        its original owner show up here with zero extra hot-path work
+        (the profiler's alias mechanism, ISSUE 12).  Replaces any
+        previous timer of that name."""
+        with self._lock:
+            self._stages[stage] = stats
+
     @contextmanager
     def time(self, stage: str):
         t0 = time.perf_counter()

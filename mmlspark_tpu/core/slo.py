@@ -107,6 +107,15 @@ def default_objectives() -> Tuple[SLObjective, ...]:
             "heartbeat_freshness", 0.99,
             "fraction of time the worst peer heartbeat stays fresh",
             gauge=("elastic", "heartbeat_age_ms"), threshold=2000.0),
+        SLObjective(
+            "perf_latency_budget", 0.99,
+            "perf-sentinel worst stage-vs-baseline ratio staying "
+            "inside the latency budget (tools/perf_sentinel.py "
+            "publishes the gauge; silent until a sentinel ran).  The "
+            "threshold MATCHES the sentinel's relative regression "
+            "gate (--rel, default 1.8) — a stricter SLO would breach "
+            "on runs the sentinel itself calls healthy",
+            gauge=("perf", "worst_regression_ratio"), threshold=1.8),
     )
 
 

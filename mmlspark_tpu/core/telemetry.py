@@ -597,6 +597,14 @@ def record_flight(reason: str, context: Optional[dict] = None,
             metrics = get_registry().render_prometheus()
         except Exception:  # noqa: BLE001
             metrics = "# metrics render failed\n"
+        try:
+            # the profiler lives one import down (it imports this
+            # module); a flight record carries its snapshot so a
+            # post-mortem has the cost attribution at crash time too
+            from .profiler import get_profiler
+            profile = get_profiler().snapshot()
+        except Exception:  # noqa: BLE001 - recorder must not fail
+            profile = None
         rec = {
             "reason": reason,
             "ts": round(now, 6),
@@ -607,6 +615,7 @@ def record_flight(reason: str, context: Optional[dict] = None,
             "fit_span": current_fit_span(),
             "journal_tail": get_journal().tail(journal_tail),
             "metrics_exposition": metrics,
+            "profile": profile,
             "threads": _thread_stacks(),
         }
         safe = "".join(c if c.isalnum() or c in "-_" else "-"
@@ -642,6 +651,21 @@ def record_flight(reason: str, context: Optional[dict] = None,
 
 
 # -- trace identity ----------------------------------------------------------
+
+
+def host_info() -> dict:
+    """Host CPU readings for bench/sentinel artifacts (ISSUE 12):
+    ``cores_effective`` is what this process may actually RUN on —
+    ``sched_getaffinity`` sees cgroup/affinity caps the advertised
+    ``cpu_count`` does not.  ONE definition so the fleet-scaling gate,
+    the bench host block, and the perf sentinel can never diverge on
+    what "a core" means."""
+    return {
+        "cpu_count": os.cpu_count(),
+        "cores_effective": (len(os.sched_getaffinity(0))
+                            if hasattr(os, "sched_getaffinity")
+                            else os.cpu_count()),
+    }
 
 
 def new_trace_id() -> str:

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..core import debug as _debug
 from ..core import telemetry as _tm
+from ..core.profiler import get_profiler, install_jax_hooks
 from ..core.profiling import StageStats
 from .binning import BinMapper, fit_bin_mapper
 from .booster import Booster, HostTree, host_tree_from_arrays
@@ -277,6 +278,10 @@ del _k
 # trains (or a training controller with a debug HTTP server) exposes
 # these on /metrics next to the scoring stats (ISSUE 5)
 _tm.get_registry().register("train", train_stats)
+# compile-event attribution (ISSUE 12): jax is imported by this module,
+# so the profiler's jax.monitoring listener can install here — every
+# backend compile from now on lands in the compile ledger
+install_jax_hooks()
 
 
 def _fit_resolution_exposition() -> str:
@@ -1639,6 +1644,8 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
             # per-iteration telemetry (custom-gradient host loop):
             # objective=None — the override replaces the objective's
             # gradient, so its train_loss would not describe this fit
+            get_profiler().record_phase(
+                "train.host_iter", time.perf_counter() - t_iter)
             _monitor_chunk(it, it + 1, time.perf_counter() - t_iter,
                            n, K, cfg.hist_method)
             if has_val:
@@ -1894,12 +1901,27 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
                                 jax.random.PRNGKey(params.bagging_seed),
                                 params.num_iterations)
             else:
+                # profiler dispatch bracketing (ISSUE 12): host glue
+                # until the jitted chunk returns vs device wait until
+                # its results materialize, with the compile-seq delta
+                # classifying the dispatch as cache hit or miss
+                _p = get_profiler()
+                _seq0 = _p.compile_seq()
                 trees_st, scores, val_scores, val_hist = run_chunk(
                     scores, val_scores)
+                _t_host = time.perf_counter()
                 # sync for honest chunk timing; the host needs these
                 # results before the next chunk (or the final fetch)
                 # anyway, so this moves a wait, it does not add one
                 jax.block_until_ready(trees_st)
+                _t_done = time.perf_counter()
+                _p.dispatch("train.boost_chunk", _t_host - t_chunk,
+                            _t_done - _t_host,
+                            _p.compile_seq() - _seq0)
+                _p.span("train.boost_chunk", _t_done - t_chunk,
+                        journal=True, it=int(it),
+                        host_ms=round((_t_host - t_chunk) * 1e3, 3),
+                        device_ms=round((_t_done - _t_host) * 1e3, 3))
             trees_chunks.append(trees_st)
             _monitor_chunk(it, it + C, time.perf_counter() - t_chunk,
                            n, K, cfg.hist_method, objective, scores,
@@ -2964,9 +2986,19 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
                         bags = jnp.asarray(bags_host)
                     fi_stack = jnp.asarray(fi_host)
         else:
+            _p = get_profiler()
+            _seq0 = _p.compile_seq()
             trees_st, scores, val_scores, val_hist = run_step(
                 scores, val_scores)
+            _t_host = time.perf_counter()
             jax.block_until_ready(trees_st)
+            _t_done = time.perf_counter()
+            _p.dispatch("train.boost_chunk", _t_host - t_chunk,
+                        _t_done - _t_host, _p.compile_seq() - _seq0)
+            _p.span("train.boost_chunk", _t_done - t_chunk,
+                    journal=True, it=int(it), mesh=True,
+                    host_ms=round((_t_host - t_chunk) * 1e3, 3),
+                    device_ms=round((_t_done - _t_host) * 1e3, 3))
         chunks.append(trees_st)
         # objective=None: the gang's score vector is sharded (not fully
         # addressable on any one controller), so train loss is skipped

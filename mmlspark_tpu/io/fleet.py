@@ -50,6 +50,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core.profiler import get_profiler
 from ..core.profiling import StageStats
 from ..core.telemetry import get_registry
 from . import wire
@@ -344,8 +345,20 @@ class PredictorFleet:
         for k in ("requests", "partials", "timeouts", "shard_errors",
                   "worker_respawns"):
             self.stats.incr(k, 0)
-        # resolved once: timer() locks per call — per-request tax
+        # resolved once: timer() locks per call — per-request tax.
+        # All four are fleet-owned and ALIASED into the profile view
+        # (newest fleet wins, like the scoring engine's stages) so the
+        # perf_report phase table never mixes a per-instance e2e with
+        # process-lifetime accumulators
         self._rtt = self.stats.timer("fleet_rtt")
+        self._pt_fanout = self.stats.timer("fanout")
+        self._pt_wait = self.stats.timer("wait")
+        self._pt_reduce = self.stats.timer("reduce")
+        prof = get_profiler()
+        prof.alias("fleet.request", self._rtt)
+        prof.alias("fleet.fanout", self._pt_fanout)
+        prof.alias("fleet.wait", self._pt_wait)
+        prof.alias("fleet.reduce", self._pt_reduce)
 
     @property
     def mode(self) -> str:
@@ -590,6 +603,7 @@ class PredictorFleet:
         with self._lock:
             self._calls[rid] = call
         self.stats.incr("requests")
+        prof = get_profiler()
         t0 = time.perf_counter()
         try:
             buf = None
@@ -605,6 +619,8 @@ class PredictorFleet:
                                  {"op": "score", "rid": rid,
                                   "X": X.tolist()},
                                  timeout=self._timeout)
+            self._pt_fanout.record(time.perf_counter() - t0)
+            t_wait = time.perf_counter()
             if not call.event.wait(self._timeout):
                 self.stats.incr("timeouts")
                 raise TransportError(
@@ -617,7 +633,8 @@ class PredictorFleet:
         finally:
             with self._lock:
                 self._calls.pop(rid, None)
-        self._rtt.record(time.perf_counter() - t0)
+        self._pt_wait.record(time.perf_counter() - t_wait)
+        t_red = time.perf_counter()
         if self.routing == "replica":
             out = call.parts[targets[0]]
         else:
@@ -628,4 +645,12 @@ class PredictorFleet:
             out = call.parts[order[0]]
             for s in order[1:]:
                 out = out + call.parts[s]
+        self._pt_reduce.record(time.perf_counter() - t_red)
+        # the request window covers fanout+wait+reduce — it is the
+        # fleet's e2e and the aliased fleet.request denominator; slow
+        # fan-outs also land on the trace timeline (rid doubles as the
+        # trace id for fleet-internal requests)
+        req_s = time.perf_counter() - t0
+        self._rtt.record(req_s)
+        prof.span("fleet.request", req_s, tid=rid, record=False)
         return out[:, 0] if self._K == 1 else out

@@ -96,6 +96,7 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..core.profiler import get_profiler
 from ..core.profiling import StageStats
 from ..core.telemetry import get_journal, get_registry
 
@@ -340,6 +341,14 @@ transport_stats = _new_stats()
 # caches its timers the same way, so the A/B stays apples-to-apples)
 _ENC_JSON = transport_stats.timer("encode_json")
 _DEC_JSON = transport_stats.timer("decode_json")
+# the continuous profiler's unified phase view (ISSUE 12): the codec
+# timers are ALIASED (shared histogram objects — zero extra work per
+# frame); only the wire-write phase records explicitly, on a timer
+# resolved once
+_PROF = get_profiler()
+_PROF.alias("transport.encode_json", _ENC_JSON)
+_PROF.alias("transport.decode_json", _DEC_JSON)
+_PT_WIRE = _PROF.timer("transport.wire_write")
 # per-channel payload-byte counter KEYS, precomputed for the same
 # reason (no per-frame f-string build; channels above the table fall
 # back to on-the-fly names)
@@ -671,10 +680,13 @@ class Session:
                     ack=self._recv_seq, deadline_ms=remaining,
                     flags=flags,
                     max_frame_bytes=self.cfg.max_frame_bytes)
+                t_w = time.perf_counter()
                 try:
                     sock.sendall(frame)
                 except OSError:
                     return n   # link died; resume re-flushes the rest
+                if _PROF.enabled:
+                    _PT_WIRE.record(time.perf_counter() - t_w)
                 with self._cv:
                     self._wired = nxt
                     tid = self._traced.get(nxt)

@@ -46,6 +46,7 @@ from typing import Any, List, Sequence, Tuple
 
 import numpy as np
 
+from ..core.profiler import get_profiler
 from .transport import transport_stats
 
 __all__ = [
@@ -74,6 +75,10 @@ MAX_COLS = 1 << 20
 # call, a measurable tax at per-frame rates on the hot path
 _ENC = transport_stats.timer("encode_binary")
 _DEC = transport_stats.timer("decode_binary")
+# profile-view aliases (ISSUE 12): shared histogram objects, so the
+# binary codec phases cost nothing extra per frame
+get_profiler().alias("transport.encode_binary", _ENC)
+get_profiler().alias("transport.decode_binary", _DEC)
 
 
 class WireError(ValueError):

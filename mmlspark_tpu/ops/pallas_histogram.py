@@ -43,6 +43,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..core.telemetry import get_registry as _get_registry
+
 log = logging.getLogger(__name__)
 
 LO = 16          # low-nibble width
@@ -214,6 +216,37 @@ _COMPILE_CACHE: dict = {}
 #: (Kept as the authoritative slot for ``pallas_fused`` — tests reset it
 #: to None to force a re-probe; ``_COMPILE_CACHE`` mirrors it.)
 _FUSED_COMPILE_OK: Optional[bool] = None
+
+
+def probe_exposition() -> str:
+    """Info-style ``/metrics`` family naming every compile-probe
+    verdict this process has cached (ISSUE 12 satellite): a silent
+    ``pallas_ring → pallas`` downgrade is a 0-valued sample in any
+    scrape instead of one log line at fit time.  Value 1 = the kernel
+    compiled on this backend, 0 = the probe failed and callers
+    downgraded.  Empty until the first probe runs (no fit has resolved
+    a Pallas method yet)."""
+    rows = dict(_COMPILE_CACHE)
+    if _FUSED_COMPILE_OK is not None:
+        rows.setdefault((jax.default_backend(), "pallas_fused"),
+                        _FUSED_COMPILE_OK)
+    rows = {k: v for k, v in rows.items() if v is not None}
+    if not rows:
+        return ""
+    name = "mmlspark_tpu_compile_probe_ok"
+    lines = [f"# HELP {name} Compile-probe verdict per (backend, "
+             "kernel method): 1 = compiles, 0 = probe failed "
+             "(callers downgraded).",
+             f"# TYPE {name} gauge"]
+    for (backend, method), ok in sorted(rows.items()):
+        lines.append(f'{name}{{backend="{backend}",'
+                     f'method="{method}"}} {1 if ok else 0}')
+    return "\n".join(lines) + "\n"
+
+
+# join every /metrics scrape through the registry's provider hook (the
+# registry skips a failing provider, never the scrape)
+_get_registry().register_exposition("compile_probes", probe_exposition)
 
 
 def probe_cached(method: str, probe_fn, probe: bool = True

@@ -663,7 +663,9 @@ class TestMetricsHTTPMultiprocess:
 
 class TestToolArtifactSchema:
     def _assert_block(self, block):
-        assert set(block) == {"metrics_exposition", "journal_excerpt"}
+        assert {"metrics_exposition", "journal_excerpt"} <= set(block)
+        assert set(block) <= {"metrics_exposition", "journal_excerpt",
+                              "profile"}
         assert isinstance(block["metrics_exposition"], str)
         parse_prometheus(block["metrics_exposition"])   # must be valid
         assert isinstance(block["journal_excerpt"], list)
@@ -678,6 +680,11 @@ class TestToolArtifactSchema:
         # the exposition carries the train namespace at minimum (the
         # registry registers it at gbdt.engine import)
         assert 'ns="train"' in block["metrics_exposition"]
+        # ISSUE 12: the bench artifact carries the continuous
+        # profiler's snapshot for tools/perf_report.py
+        assert isinstance(block["profile"], dict)
+        assert "phases" in block["profile"]
+        assert "dispatch" in block["profile"]
 
     def test_chaos_training_telemetry_block(self):
         chaos = _load_tool("chaos_training")
@@ -846,8 +853,11 @@ class TestJournalRotation:
 class TestMetricFamilyDocGuard:
     def _rendered_names(self):
         """Families + sample names from a REPRESENTATIVE exposition:
-        a stage histogram, counters, gauges, rows, and the SLO monitor
-        families."""
+        a stage histogram, counters, gauges, rows, the SLO monitor
+        families, the continuous profiler's families (seeded so every
+        family renders — ISSUE 12), and the compile-probe info
+        family."""
+        from mmlspark_tpu.core.profiler import Profiler
         from mmlspark_tpu.core.slo import SLOMonitor
         reg = MetricsRegistry()
         s = StageStats()
@@ -858,7 +868,25 @@ class TestMetricFamilyDocGuard:
         reg.register("scoring", s)
         mon = SLOMonitor(registry=reg)
         reg.register_exposition("slo", mon.render_prometheus)
-        text = reg.render_prometheus()
+        prof = Profiler(enabled=True)
+        prof.record_phase("scoring.score", 0.002)
+        prof.dispatch("scoring", 1e-4, 2e-4, 1)
+        prof._on_jax_duration(
+            "/jax/core/compile/backend_compile_duration", 0.01)
+        prof.record_memory("tpu:0", "bytes_in_use", 1 << 20)
+        reg.register_exposition("profile", prof.render_prometheus)
+        # the ops compile-probe info family, rendered off a seeded
+        # cache the way ops/pallas_histogram publishes the real one
+        import mmlspark_tpu.ops.pallas_histogram as ph
+        seeded = dict(ph._COMPILE_CACHE)
+        ph._COMPILE_CACHE[("cpu", "_docguard_probe")] = True
+        try:
+            reg.register_exposition("compile_probes",
+                                    ph.probe_exposition)
+            text = reg.render_prometheus()
+        finally:
+            ph._COMPILE_CACHE.clear()
+            ph._COMPILE_CACHE.update(seeded)
         families = set(re.findall(r"^# TYPE (\S+) \S+$", text,
                                   re.MULTILINE))
         samples = set(re.findall(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)\{", text,

@@ -292,6 +292,65 @@ class TestFlightRecorder:
         finally:
             self._restore(old)
 
+    def test_concurrent_triggers_throttled_and_untorn(self, tmp_path):
+        """ISSUE 12 satellite: two threads hammering ``record_flight``
+        concurrently must respect the per-reason throttle (same reason
+        → one record per interval), never exceed the rotation cap, and
+        never leave torn/interleaved JSON on disk (tmp+rename keeps
+        every surviving file parseable)."""
+        old = self._configured(tmp_path, cap=3)
+        try:
+            # same reason + real throttle window from two threads:
+            # exactly ONE record may win the race
+            with telemetry._flight_lock:
+                telemetry._flight_cfg["min_interval_s"] = 60.0
+                telemetry._flight_last.clear()
+            wrote = []
+            start = threading.Barrier(2)
+
+            def same_reason():
+                start.wait()
+                p = record_flight("concurrent_reason")
+                if p is not None:
+                    wrote.append(p)
+
+            ts = [threading.Thread(target=same_reason)
+                  for _ in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            assert len(wrote) == 1, wrote
+
+            # throttle off: a two-thread burst of distinct reasons
+            # stays under the cap and every survivor parses cleanly
+            with telemetry._flight_lock:
+                telemetry._flight_cfg["min_interval_s"] = 0.0
+            start2 = threading.Barrier(2)
+
+            def hammer(tag):
+                start2.wait()
+                for i in range(6):
+                    record_flight(f"burst_{tag}_{i}")
+
+            ts = [threading.Thread(target=hammer, args=(tag,))
+                  for tag in ("a", "b")]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            left = glob.glob(str(tmp_path / "flightrec_*.json"))
+            assert len(left) <= 3, left        # rotation cap held
+            assert not glob.glob(str(tmp_path / "*.tmp")), \
+                "torn temp files left behind"
+            for p in left:
+                rec = json.load(open(p))       # parses = not torn
+                assert rec["reason"].startswith(("burst_",
+                                                 "concurrent_"))
+                assert rec["pid"] == os.getpid()
+        finally:
+            self._restore(old)
+
     def test_throttle_suppresses_repeats(self, tmp_path):
         old = self._configured(tmp_path)
         try:

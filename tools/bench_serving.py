@@ -748,8 +748,12 @@ def scenario_fleet_sweep(args):
     # topology gains horizontal scale-out.  On >=2 cores the same
     # sweep's curve is the scaling evidence and `best_scaling` is the
     # gate.
-    out["cores"] = len(os.sched_getaffinity(0)) \
-        if hasattr(os, "sched_getaffinity") else os.cpu_count()
+    # both readings recorded (ISSUE 12 satellite): `cores` is the
+    # EFFECTIVE count (sched_getaffinity — cgroup/affinity caps seen),
+    # which is what the gate keys off; cpu_count is the advertised one
+    host = host_block()
+    out["cores"] = host["cores_effective"]
+    out["cpu_count"] = host["cpu_count"]
     out["scaling_physically_possible"] = out["cores"] >= 2
     out["fleet_tax_vs_1_shard"] = round(
         min(v for _s, v in curve) / base, 3)
@@ -764,13 +768,29 @@ def telemetry_block(journal_tail=40):
     (the last engine's stage latencies and resilience counters are
     registered under ``ns="scoring"``) plus a journal excerpt — so a
     perf regression review can read the claimed numbers straight from
-    telemetry instead of ad-hoc prints.  Schema is pinned by
-    tests/test_telemetry.py."""
+    telemetry instead of ad-hoc prints — plus the continuous
+    profiler's snapshot (ISSUE 12: the phase/compile/dispatch
+    attribution ``tools/perf_report.py`` consumes).  Schema is pinned
+    by tests/test_telemetry.py."""
+    from mmlspark_tpu.core.profiler import get_profiler
     from mmlspark_tpu.core.telemetry import get_journal, get_registry
     return {
         "metrics_exposition": get_registry().render_prometheus(),
         "journal_excerpt": get_journal().tail(journal_tail),
+        "profile": get_profiler().snapshot(),
     }
+
+
+def host_block():
+    """Core detection for the artifact (ISSUE 12 satellite):
+    ``cores_effective`` is what this process may actually RUN on
+    (cgroup/affinity caps included — the truth the fleet-scaling gate
+    must key off), ``cpu_count`` is what the box advertises.  On the
+    r11 1-core lease these differed exactly the way that matters.
+    Single definition in core.telemetry — the sentinel reads the
+    same one."""
+    from mmlspark_tpu.core.telemetry import host_info
+    return host_info()
 
 
 def check_correctness(b, X):
@@ -914,6 +934,7 @@ def main():
         "unit": "rows/s",
         "vs_baseline": detail["open_jit"]["ratio_slo_goodput"],
         "accept_ratio_ge_3": detail["open_jit"]["ratio_slo_goodput"] >= 3.0,
+        "host": host_block(),
         "telemetry": telemetry_block(),
         # burn-rate verdict over the whole bench: pass/fail context for
         # the goodput number (a bench that "won" while torching its

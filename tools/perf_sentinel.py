@@ -1,0 +1,487 @@
+"""Perf-regression sentinel (ISSUE 12): run the micro/serving bench
+stages, compare against committed baselines with noise-aware
+thresholds, and fail CI when a stage regressed.
+
+The committed BENCH/``artifacts/bench_serving_*`` numbers were, until
+now, only ever re-checked by a human re-running the full bench.  This
+sentinel is the automated guard:
+
+* **Stages** — fast (seconds-each) re-measurements of the hot paths
+  the benches commit: the per-row JSON and binary wire codecs
+  (identical methodology to ``bench_serving``'s ``codec_micro``), a
+  closed-loop scoring-engine burst (client-observed p50), and a tiny
+  training fit (ms/tree).  Every stage runs ``--k`` times and the
+  MEDIAN is compared — a single descheduled run cannot fire the alarm.
+* **Noise-aware thresholds** — a stage regresses only when the median
+  exceeds the baseline by BOTH the relative factor (``--rel``,
+  default 1.8x) and an absolute floor (per-unit: µs-scale stages need
+  µs of real slowdown, not scheduler jitter).  A 2x real slowdown
+  fires; machine-to-machine variance under ~80% does not.
+* **Baselines** — a prior sentinel artifact (``--baseline``), or a
+  committed ``bench_serving_r*.json`` (its ``codec_micro`` block maps
+  onto the codec stages).  ``--calibrate`` records a fresh baseline
+  without gating — the first run on a new box.
+* **Verdict plumbing** — each regression journals a
+  ``perf_regression`` event, the worst stage-vs-baseline ratio is
+  published as the ``ns="perf"`` gauge ``worst_regression_ratio``
+  (read by the ``perf_latency_budget`` SLO objective in
+  ``core/slo.py``), the artifact embeds the SLO report, and the
+  process exits NONZERO — the CI hook.
+* **Profiler overhead A/B** — the always-on profiler's enabled-vs-
+  disabled p50 delta on the closed-loop burst, recorded in the
+  artifact (acceptance: < 3%).
+
+Seeded-fault hook: ``MMLSPARK_TPU_PERF_SLOWDOWN="stage=factor[,..]"``
+stretches the named stage's measured region by real wall-clock sleeps
+(the detection path sees a genuine slowdown, not a doctored number) —
+the tier-1 sentinel test injects ``2.0`` and asserts the alarm fires.
+
+CLI::
+
+    python tools/perf_sentinel.py --baseline artifacts/bench_serving_r12.json \
+        [--out artifacts/perf_sentinel_r12.json] [--k 5] [--rel 1.6] \
+        [--stages codec_json,codec_binary,scoring_engine,train_micro] \
+        [--calibrate] [--skip-overhead]
+"""
+
+import argparse
+import json
+import os
+import queue
+import statistics
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+SCHEMA = "mmlspark_tpu.perf_sentinel/v1"
+SLOWDOWN_ENV = "MMLSPARK_TPU_PERF_SLOWDOWN"
+
+#: absolute regression floors per unit — below these, a delta is
+#: scheduler noise no matter the ratio
+UNIT_FLOORS = {"us": 3.0, "ms": 0.3}
+
+
+def _slowdowns():
+    """Parse the seeded-fault env: ``{"stage": factor}``."""
+    out = {}
+    raw = os.environ.get(SLOWDOWN_ENV, "")
+    for part in raw.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, factor = part.partition("=")
+        try:
+            out[name.strip()] = float(factor)
+        except ValueError:
+            continue
+    return out
+
+
+def _stretch(t0: float, stage: str) -> None:
+    """Apply the seeded slowdown to a measured region that started at
+    ``t0``: sleep the extra wall time a genuinely ``factor``-times
+    slower stage would have taken.  No-op without the env hook."""
+    factor = _slowdowns().get(stage, 1.0)
+    if factor > 1.0:
+        time.sleep((time.perf_counter() - t0) * (factor - 1.0))
+
+
+# ---------------------------------------------------------------- stages
+
+
+def stage_codec_json(args):
+    """µs/row: JSON park-message encode+decode (the JSON wire's
+    per-row codec bill; methodology identical to bench_serving's
+    ``codec_micro``)."""
+    import numpy as np
+    row = np.random.default_rng(3).normal(
+        size=args.codec_features).astype(np.float32)
+    payload = {"features": row.tolist()}
+    reps = args.codec_reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        json.loads(json.dumps({"op": "park", "rid": "r",
+                               "payload": payload}))
+    _stretch(t0, "codec_json")
+    return (time.perf_counter() - t0) / reps * 1e6, "us"
+
+
+def stage_codec_binary(args):
+    """µs/row: raw-float32 pack+unpack (the binary wire codec)."""
+    import numpy as np
+    from mmlspark_tpu.io import wire
+    row = np.random.default_rng(3).normal(
+        size=args.codec_features).astype(np.float32).reshape(1, -1)
+    reps = args.codec_reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        wire.unpack_matrix(wire.pack_matrix("r", row))
+    _stretch(t0, "codec_binary")
+    return (time.perf_counter() - t0) / reps * 1e6, "us"
+
+
+class _BurstServer:
+    """Minimal closed-loop exchange harness (the LoopServer shape):
+    every reply immediately re-arms a request, keeping the engine
+    saturated; client-observed latencies accumulate in ``lat``."""
+
+    def __init__(self, X, outstanding):
+        self.X = X
+        self.request_queue = queue.Queue()
+        self.lock = threading.Lock()
+        self.lat = []
+        self.t_sent = {}
+        self.outstanding = outstanding
+        self.n = 0
+
+    def pump(self):
+        for _ in range(self.outstanding):
+            self.send()
+
+    def send(self):
+        with self.lock:
+            rid = str(self.n)
+            self.n += 1
+            self.t_sent[rid] = time.perf_counter()
+        self.request_queue.put(
+            (rid, {"features": self.X[self.n % len(self.X)].tolist()}))
+
+    def _account(self, rid, now):
+        t0 = self.t_sent.pop(rid, None)
+        if t0 is not None:
+            self.lat.append(now - t0)
+
+    def reply(self, rid, val, status=200):
+        with self.lock:
+            self._account(rid, time.perf_counter())
+        self.send()
+        return True
+
+    def reply_many(self, entries):
+        now = time.perf_counter()
+        with self.lock:
+            for rid, _v, _s in entries:
+                self._account(rid, now)
+        for _ in entries:
+            self.send()
+        return len(entries)
+
+
+_MODEL_CACHE = {}
+
+
+def _model(args):
+    """Train the sentinel's small scoring model once per process."""
+    if "booster" in _MODEL_CACHE:
+        return _MODEL_CACHE["booster"], _MODEL_CACHE["X"]
+    import numpy as np
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(800, 16)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2]).astype(np.float64)
+    b = LightGBMRegressor(numIterations=args.model_trees, numLeaves=31,
+                          parallelism="serial", verbosity=0).fit(
+        {"features": X, "label": y}).getModel()
+    _MODEL_CACHE["booster"] = b
+    _MODEL_CACHE["X"] = X
+    return b, X
+
+
+def scoring_burst_p50(args, duration=None, warm_s=0.4):
+    """One closed-loop burst through a real ScoringEngine; returns the
+    client-observed p50 in ms.  Shared by the ``scoring_engine`` stage
+    and the profiler-overhead A/B (and the tier-1 overhead test)."""
+    import numpy as np
+    from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
+    b, X = _model(args)
+    srv = _BurstServer(X, args.outstanding)
+    predictor = b.predictor(backend="auto")
+    factor = _slowdowns().get("scoring_engine", 1.0)
+    if factor > 1.0:
+        # seeded fault: a genuinely slower scorer (every call pays the
+        # extra wall time), so detection rides the normal path
+        inner = predictor
+
+        def predictor(Xm, _inner=inner, _f=factor):
+            t0 = time.perf_counter()
+            out = _inner(Xm)
+            time.sleep((time.perf_counter() - t0) * (_f - 1.0))
+            return out
+
+    eng = ScoringEngine(srv, predictor=predictor,
+                        plan=ColumnPlan("features", X.shape[1]),
+                        max_rows=64, latency_budget_ms=2.0,
+                        num_scorers=1, num_repliers=0).start()
+    try:
+        srv.pump()
+        time.sleep(warm_s)
+        with srv.lock:
+            srv.lat.clear()
+        time.sleep(duration if duration is not None
+                   else args.burst_duration)
+        with srv.lock:
+            lat = list(srv.lat)
+    finally:
+        eng.stop()
+    if not lat:
+        return float("nan")
+    return float(np.percentile(np.asarray(lat), 50) * 1e3)
+
+
+def stage_scoring_engine(args):
+    """ms: closed-loop scoring-engine p50 (the serving hot path)."""
+    return scoring_burst_p50(args), "ms"
+
+
+def stage_train_micro(args):
+    """ms/tree: tiny serial fit (the training hot path; compile cache
+    warm after the first rep, so the median measures the steady
+    state)."""
+    import numpy as np
+    from mmlspark_tpu.gbdt import LightGBMRegressor
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(500, 12)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1]).astype(np.float64)
+    t0 = time.perf_counter()
+    LightGBMRegressor(numIterations=args.train_trees, numLeaves=15,
+                      parallelism="serial", verbosity=0).fit(
+        {"features": X, "label": y})
+    _stretch(t0, "train_micro")
+    return (time.perf_counter() - t0) / args.train_trees * 1e3, "ms"
+
+
+STAGES = {
+    "codec_json": stage_codec_json,
+    "codec_binary": stage_codec_binary,
+    "scoring_engine": stage_scoring_engine,
+    "train_micro": stage_train_micro,
+}
+
+
+# ------------------------------------------------------------ comparison
+
+
+def run_stage(name, args):
+    """Median-of-K measurement of one stage."""
+    vals, unit = [], None
+    for _ in range(args.k):
+        v, unit = STAGES[name](args)
+        vals.append(v)
+    return {"median": round(statistics.median(vals), 4),
+            "runs": [round(v, 4) for v in vals], "unit": unit}
+
+
+def load_baselines(path):
+    """Baseline medians per stage from a prior sentinel artifact OR a
+    committed bench_serving artifact (its ``codec_micro`` block maps
+    onto the codec stages).  Returns ``({stage: median}, kind)``."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") == SCHEMA:
+        return ({name: ent["median"]
+                 for name, ent in (doc.get("stages") or {}).items()
+                 if isinstance(ent, dict) and "median" in ent},
+                "perf_sentinel")
+    micro = (doc.get("detail") or {}).get("codec_micro") or {}
+    out = {}
+    if "json_us_per_row" in micro:
+        out["codec_json"] = float(micro["json_us_per_row"])
+    if "binary_us_per_row" in micro:
+        out["codec_binary"] = float(micro["binary_us_per_row"])
+    return out, "bench_serving"
+
+
+def compare(measured, baselines, rel, abs_frac=0.10):
+    """The noise-aware verdict: a stage regresses when its median is
+    over ``baseline * rel`` AND over the absolute floor (the larger of
+    the per-unit floor and ``abs_frac`` of the baseline)."""
+    regressions, checks = [], {}
+    for name, ent in measured.items():
+        base = baselines.get(name)
+        if base is None:
+            checks[name] = {"baseline": None, "ratio": None,
+                            "regressed": False, "gated": False}
+            continue
+        floor = max(UNIT_FLOORS.get(ent["unit"], 0.0), abs_frac * base)
+        ratio = ent["median"] / max(base, 1e-12)
+        regressed = (ent["median"] > base * rel
+                     and ent["median"] - base > floor)
+        # the gauge-facing ratio: a sub-floor delta is scheduler noise
+        # on a µs-scale stage, so it reads 1.0 — otherwise the
+        # perf_latency_budget SLO would breach on a run this very
+        # verdict calls healthy
+        effective = (ratio if ratio <= 1.0
+                     or ent["median"] - base > floor else 1.0)
+        checks[name] = {"baseline": round(base, 4),
+                        "ratio": round(ratio, 3),
+                        "effective_ratio": round(effective, 3),
+                        "abs_floor": round(floor, 4),
+                        "regressed": regressed, "gated": True}
+        if regressed:
+            regressions.append({"stage": name,
+                                "median": ent["median"],
+                                "baseline": round(base, 4),
+                                "ratio": round(ratio, 3),
+                                "unit": ent["unit"]})
+    return regressions, checks
+
+
+def measure_profiler_overhead(args):
+    """Enabled-vs-disabled A/B of the always-on profiler on the
+    closed-loop scoring burst: interleaved reps, median p50 per arm.
+    Restores the profiler's enabled state afterwards."""
+    import statistics as st
+    from mmlspark_tpu.core.profiler import get_profiler
+    prof = get_profiler()
+    was = prof.enabled
+    p50 = {True: [], False: []}
+    try:
+        for _ in range(args.overhead_reps):
+            for enabled in (True, False):
+                prof.configure(enabled=enabled)
+                p50[enabled].append(scoring_burst_p50(
+                    args, duration=args.overhead_duration))
+    finally:
+        prof.configure(enabled=was)
+    on, off = st.median(p50[True]), st.median(p50[False])
+    pct = (on - off) / off * 100.0 if off > 0 else float("nan")
+    return {"p50_ms_enabled": round(on, 4),
+            "p50_ms_disabled": round(off, 4),
+            "overhead_pct": round(pct, 2),
+            "runs_enabled": [round(v, 4) for v in p50[True]],
+            "runs_disabled": [round(v, 4) for v in p50[False]],
+            "accept_overhead_lt_3pct": pct < 3.0}
+
+
+# ---------------------------------------------------------------- main
+
+
+def run(args):
+    from mmlspark_tpu.core.profiling import StageStats
+    from mmlspark_tpu.core.slo import get_monitor
+    from mmlspark_tpu.core.telemetry import (get_journal, get_registry,
+                                             host_info)
+
+    stages = [s.strip() for s in args.stages.split(",") if s.strip()]
+    unknown = [s for s in stages if s not in STAGES]
+    if unknown:
+        raise SystemExit(f"unknown stage(s) {unknown}; "
+                         f"have {sorted(STAGES)}")
+    measured = {}
+    for name in stages:
+        measured[name] = run_stage(name, args)
+        print(f"  {name}: {measured[name]['median']}"
+              f"{measured[name]['unit']} (runs "
+              f"{measured[name]['runs']})", flush=True)
+
+    baselines, baseline_kind = {}, None
+    if args.baseline and not args.calibrate:
+        baselines, baseline_kind = load_baselines(args.baseline)
+    regressions, checks = compare(measured, baselines, args.rel)
+
+    # verdict plumbing: the ns="perf" gauges feed the
+    # perf_latency_budget SLO objective; every regression is journaled
+    perf_stats = StageStats()
+    worst = max((c["effective_ratio"] for c in checks.values()
+                 if c.get("effective_ratio") is not None), default=0.0)
+    perf_stats.set_gauge("worst_regression_ratio", worst)
+    perf_stats.incr("perf_regressions", len(regressions))
+    perf_stats.incr("perf_checks",
+                    sum(1 for c in checks.values() if c["gated"]))
+    for name, c in checks.items():
+        if c.get("ratio") is not None:
+            perf_stats.set_gauge(f"{name}_ratio", c["ratio"])
+    get_registry().register("perf", perf_stats)
+    for r in regressions:
+        get_journal().emit("perf_regression", **r)
+        print(f"PERF REGRESSION: {r['stage']} {r['median']}{r['unit']} "
+              f"vs baseline {r['baseline']}{r['unit']} "
+              f"({r['ratio']}x)", flush=True)
+
+    overhead = None
+    if not args.skip_overhead:
+        print("== profiler overhead A/B ==", flush=True)
+        overhead = measure_profiler_overhead(args)
+        print(json.dumps(overhead), flush=True)
+
+    # sample the monitor twice so the gauge objective gets a window
+    mon = get_monitor()
+    mon.sample()
+    time.sleep(0.05)
+    slo = mon.report()
+
+    artifact = {
+        "schema": SCHEMA,
+        "stages": measured,
+        "checks": checks,
+        "regressions": regressions,
+        "baseline_source": args.baseline if baselines else None,
+        "baseline_kind": baseline_kind,
+        "calibrate": bool(args.calibrate),
+        "rel_threshold": args.rel,
+        "profiler_overhead": overhead,
+        "host": host_info(),
+        "slo": {"healthy": slo["healthy"],
+                "breaching": slo["breaching"],
+                "perf_latency_budget":
+                    slo["objectives"].get("perf_latency_budget")},
+        "healthy": not regressions,
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"artifact -> {args.out}", flush=True)
+    print(json.dumps({"healthy": artifact["healthy"],
+                      "regressions": [r["stage"] for r in regressions],
+                      "worst_ratio": worst}), flush=True)
+    return artifact
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="perf-regression sentinel over the committed "
+                    "bench baselines (nonzero exit on regression)")
+    ap.add_argument("--baseline",
+                    default=os.path.join(_REPO, "artifacts",
+                                         "perf_sentinel_r12.json"),
+                    help="prior sentinel artifact or committed "
+                         "bench_serving artifact (a bench artifact "
+                         "gates only the codec stages its codec_micro "
+                         "block covers; the committed sentinel "
+                         "artifact carries ALL stage medians — "
+                         "baselines are BOX-relative, so --calibrate "
+                         "and re-point this when hardware changes)")
+    ap.add_argument("--out", default=None, help="artifact JSON path")
+    ap.add_argument("--stages",
+                    default="codec_json,codec_binary,scoring_engine,"
+                            "train_micro")
+    ap.add_argument("--k", type=int, default=5,
+                    help="median-of-K runs per stage")
+    ap.add_argument("--rel", type=float, default=1.8,
+                    help="relative regression threshold")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="record a baseline, gate nothing")
+    ap.add_argument("--codec-reps", type=int, default=4000)
+    ap.add_argument("--codec-features", type=int, default=64)
+    ap.add_argument("--model-trees", type=int, default=60)
+    ap.add_argument("--train-trees", type=int, default=10)
+    ap.add_argument("--outstanding", type=int, default=32)
+    ap.add_argument("--burst-duration", type=float, default=1.0)
+    ap.add_argument("--overhead-reps", type=int, default=3)
+    ap.add_argument("--overhead-duration", type=float, default=1.0)
+    ap.add_argument("--skip-overhead", action="store_true")
+    args = ap.parse_args(argv)
+    if args.calibrate and not args.out:
+        raise SystemExit("--calibrate records a baseline: pass --out "
+                         "PATH or the measurement is discarded")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    artifact = run(args)
+    return 0 if artifact["healthy"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,13 @@ timeline:
 CLI::
 
     python tools/trace_report.py JOURNAL.jsonl [more.jsonl ...] \
-        [--trace-id TID] [--fit SPAN | --fit latest]
+        [--trace-id TID] [--fit SPAN | --fit latest] \
+        [--format text|json]
+
+``--format json`` (ISSUE 12 satellite) emits ONE machine-readable
+document in the stable ``mmlspark_tpu.trace_timeline/v1`` schema (see
+:func:`timeline_report`) — the shape ``tools/perf_report.py`` consumes
+to put a per-hop cost breakdown under every timeline.
 
 Multiple journal files (e.g. the driver's plus each worker's
 ``MMLSPARK_TPU_JOURNAL_DIR`` mirror, or one per controller of a gang)
@@ -163,6 +169,44 @@ def fit_timeline(events: Iterable[dict],
     }
 
 
+#: machine-readable schema tag; bump the suffix on ANY key change —
+#: perf_report and external consumers key off it
+TIMELINE_SCHEMA = "mmlspark_tpu.trace_timeline/v1"
+
+
+def timeline_report(events, trace_id: Optional[str] = None,
+                    fit: Optional[str] = None) -> dict:
+    """The stable machine-readable timeline document (``--format
+    json``).  Keys are FIXED for the schema version:
+
+    * ``schema`` — :data:`TIMELINE_SCHEMA`.
+    * ``events_total`` — merged event count across the journals.
+    * ``event_counts`` — ``{ev: count}`` over every merged event.
+    * ``fits`` — fit span ids in first-seen order.
+    * ``request`` — :func:`request_timeline` output for ``trace_id``
+      (``null`` when no trace id was asked for).
+    * ``fit`` — :func:`fit_timeline` output (``null`` unless asked;
+      ``fit="latest"`` picks the newest ``fit_begin``).
+
+    Every value is JSON-native (the journal records already are), so
+    ``json.loads(json.dumps(report)) == report`` — the round-trip the
+    tier-1 schema test pins."""
+    events = list(events)
+    kinds: Dict[str, int] = {}
+    for e in events:
+        kinds[e.get("ev", "?")] = kinds.get(e.get("ev", "?"), 0) + 1
+    return {
+        "schema": TIMELINE_SCHEMA,
+        "events_total": len(events),
+        "event_counts": kinds,
+        "fits": list_fits(events),
+        "request": (request_timeline(events, trace_id)
+                    if trace_id else None),
+        "fit": (fit_timeline(events, None if fit == "latest" else fit)
+                if fit else None),
+    }
+
+
 def _fmt_event(e: dict, t0: float) -> str:
     extras = {k: v for k, v in e.items()
               if k not in ("ts", "seq", "ev", "rids", "trace_ids",
@@ -207,8 +251,17 @@ def main(argv=None) -> int:
     ap.add_argument("--fit", default=None,
                     help="fit span id to report ('latest' for the "
                          "newest fit in the journal)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text",
+                    help="json: one stable machine-readable timeline "
+                         "document (mmlspark_tpu.trace_timeline/v1)")
     args = ap.parse_args(argv)
     events = load_events(args.journals)
+    if args.format == "json":
+        print(json.dumps(timeline_report(events, args.trace_id,
+                                         args.fit),
+                         sort_keys=True))
+        return 0
     print(f"{len(events)} events from {len(args.journals)} journal(s)")
     did = False
     if args.trace_id:
