@@ -17,6 +17,7 @@ its known scoring sore point (SURVEY.md §3.2).
 from __future__ import annotations
 
 import functools
+import hashlib
 import io
 import re
 from dataclasses import dataclass, field
@@ -28,6 +29,56 @@ import numpy as np
 
 from .grower import TreeArrays
 from .binning import BinMapper
+
+#: content-digest header (ISSUE 14 satellite): ``save_native_model``
+#: prepends ONE comment line ``# mmlspark_tpu.digest.sha256=<hex>``
+#: hashing everything after it, so model-file corruption (torn write,
+#: bit rot) is detected at load EVERYWHERE — the registry, the fleet's
+#: spawn-mode model handoff, a bare ``load_native_model`` — not only
+#: where a registry manifest happens to carry a second digest.
+#: Digest-less files (stock LightGBM exports, pre-ISSUE-14 saves) load
+#: unchanged; the model-string API stays byte-identical to the
+#: reference's text format for interop.
+DIGEST_HEADER = "# mmlspark_tpu.digest.sha256="
+
+
+class ModelDigestError(ValueError):
+    """A native-model file's content no longer hashes to its embedded
+    digest header — refuse to build a Booster from corrupt bytes."""
+
+
+def with_digest_header(text: str) -> str:
+    """Prepend the digest header line (idempotent: an already-stamped
+    text is re-verified and returned unchanged)."""
+    if text.startswith(DIGEST_HEADER):
+        split_native_digest(text)     # re-verify, raises on mismatch
+        return text
+    h = hashlib.sha256(text.encode("utf-8")).hexdigest()
+    return f"{DIGEST_HEADER}{h}\n{text}"
+
+
+def split_native_digest(text: str) -> str:
+    """Strip and VERIFY the digest header when present; return the
+    bare model text.  Digest-less input passes through untouched
+    (backward compatibility with stock LightGBM files)."""
+    if not text.startswith(DIGEST_HEADER):
+        # a bit-flipped HEADER must not demote the file to "digest-less"
+        # and load unverified: any first line still recognisable as a
+        # digest stamp but not byte-exact is corruption
+        if ".digest.sha256=" in text[:len(DIGEST_HEADER) + 16]:
+            raise ModelDigestError(
+                "native model digest header is mangled (bit-flipped "
+                "header line); refusing to load")
+        return text
+    line, _, body = text.partition("\n")
+    want = line[len(DIGEST_HEADER):].strip()
+    got = hashlib.sha256(body.encode("utf-8")).hexdigest()
+    if got != want:
+        raise ModelDigestError(
+            f"native model content fails its embedded digest (want "
+            f"sha256:{want[:12]}…, got sha256:{got[:12]}…): the file "
+            "is torn or bit-flipped; refusing to load")
+    return body
 
 
 @dataclass
@@ -443,11 +494,19 @@ class Booster:
         return buf.getvalue()
 
     def save_native_model(self, path: str) -> None:
+        """Write the native-model text with the content-digest header
+        (:data:`DIGEST_HEADER`) prepended, so any later load detects a
+        torn or bit-flipped file instead of serving it.  The header is
+        one comment line; ``save_native_model_string`` stays the bare
+        interop text."""
         with open(path, "w") as f:
-            f.write(self.save_native_model_string())
+            f.write(with_digest_header(self.save_native_model_string()))
 
     @classmethod
     def load_native_model_string(cls, text: str) -> "Booster":
+        # digest header (when present) is verified and stripped FIRST:
+        # corrupt bytes raise ModelDigestError before any parsing
+        text = split_native_digest(text)
         header, _, rest = text.partition("Tree=")
         head = _parse_kv(header)
         num_class = int(head.get("num_class", 1))
@@ -524,8 +583,13 @@ class Booster:
 
     @classmethod
     def load_native_model(cls, path: str) -> "Booster":
-        with open(path) as f:
-            return cls.load_native_model_string(f.read())
+        # binary read + replacing decode: a bit-flip that breaks UTF-8
+        # must surface as the digest verdict (ModelDigestError), not a
+        # UnicodeDecodeError from the file read — the replacement
+        # characters change the body, so the digest check catches it
+        with open(path, "rb") as f:
+            text = f.read().decode("utf-8", errors="replace")
+        return cls.load_native_model_string(text)
 
 
 class CompiledPredictor:

@@ -24,6 +24,8 @@ from .transport import (Backpressure, ChecksumError, FrameTooLarge,
 from .wire import BinaryReq, WireError
 from .fleet import (ConsistentHashRing, PredictorFleet,
                     ShardedPredictor, shard_tree_ranges)
+from .registry import ModelCorruption, ModelRegistry, RegistryError
+from .rollout import RolloutConfig, RolloutController
 from .binary import BinaryFileReader, read_binary_files
 from .powerbi import PowerBIWriter
 
@@ -42,6 +44,8 @@ __all__ = [
     "BinaryReq", "WireError",
     "ConsistentHashRing", "PredictorFleet", "ShardedPredictor",
     "shard_tree_ranges",
+    "ModelCorruption", "ModelRegistry", "RegistryError",
+    "RolloutConfig", "RolloutController",
     "BinaryFileReader", "read_binary_files",
     "PowerBIWriter",
 ]

@@ -196,17 +196,39 @@ class _FleetCall:
         self.error: Optional[str] = None
 
 
+def _rid_version(rid: str) -> Optional[int]:
+    """The model version a fleet rid is stamped with (``v<N>|...``), or
+    ``None`` for unstamped rids (pre-rollout drivers)."""
+    if rid.startswith("v"):
+        head, sep, _ = rid.partition("|")
+        if sep:
+            try:
+                return int(head[1:])
+            except ValueError:
+                return None
+    return None
+
+
 def _fleet_worker_main(driver_host: str, driver_port: int,
                        shard_id: int, model_path: Optional[str],
                        lo: int, hi: int, backend: str, token: str,
                        replica: bool = False,
-                       booster=None) -> None:
+                       booster=None, version: int = 0) -> None:
     """Fleet worker entrypoint (module-level for spawn pickling; tests
     run it as a thread passing ``booster`` directly).  Holds the shard's
     tree-range partial predictor (or the full model in replica mode),
     answers raw-float32 score requests with packed partial blocks, and
     rides ONE resumable transport session — a link blip replays, it
-    does not rescore."""
+    does not rescore.
+
+    Model rollout (ISSUE 14): the worker holds a VERSIONED predictor
+    map.  ``load_version`` control messages stage a new model from a
+    digest-verified file (the registry's) for this shard's new tree
+    range; ``activate_version`` flips the default atomically and keeps
+    the PREVIOUS version's predictor alive — every score request's rid
+    is stamped with the version the driver fanned it out under, so an
+    in-flight request completes on its own version on every shard and
+    no reduce ever mixes tree-range shards from two models."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if booster is None:
         from ..gbdt.booster import Booster
@@ -216,19 +238,78 @@ def _fleet_worker_main(driver_host: str, driver_port: int,
     else:
         pred = booster.predictor(backend=backend, tree_range=(lo, hi),
                                  include_init_score=(lo == 0))
+    #: version -> predictor; staged entries await activate_version
+    preds: Dict[int, Any] = {int(version): pred}
+    staged: Dict[int, Any] = {}
+    active = {"v": int(version)}
     stop_evt = threading.Event()
     work: "queue.Queue" = queue.Queue()
 
     def on_message(session, channel, msg, deadline_ms):
-        if channel == CH_CONTROL and isinstance(msg, dict) \
-                and msg.get("op") == "stop":
-            stop_evt.set()
-            work.put(None)
+        if channel == CH_CONTROL and isinstance(msg, dict):
+            op = msg.get("op")
+            if op == "stop":
+                stop_evt.set()
+                work.put(None)
+            elif op in ("load_version", "activate_version"):
+                # model loads block (file read + predictor build):
+                # run them on the work queue, never the read pump
+                work.put(msg)
             return
         if channel == CH_SCORING:
             # scoring runs OFF the read pump (a long jit compile must
             # not stall keepalives into a false half-open teardown)
             work.put(msg)
+
+    def handle_version_op(msg) -> None:
+        op, v = msg.get("op"), int(msg.get("version", -1))
+        try:
+            if op == "load_version":
+                from ..gbdt.booster import Booster
+                # digest-verified load: a torn/bit-flipped model file
+                # raises here and the driver aborts the cutover —
+                # never a shard serving garbage
+                b = Booster.load_native_model(msg["path"])
+                if replica:
+                    p = b.predictor(backend=backend)
+                else:
+                    nlo, nhi = int(msg["lo"]), int(msg["hi"])
+                    p = b.predictor(backend=backend,
+                                    tree_range=(nlo, nhi),
+                                    include_init_score=(nlo == 0))
+                staged[v] = p
+                client.send(CH_CONTROL,
+                            {"op": "version_loaded",
+                             "shard": shard_id, "version": v})
+            elif op == "activate_version":
+                p = staged.pop(v, preds.get(v))
+                if p is None:
+                    raise RuntimeError(
+                        f"version {v} was never staged on shard "
+                        f"{shard_id}")
+                prev = active["v"]
+                preds[v] = p
+                active["v"] = v
+                # keep ONLY the previous version for in-flight
+                # requests stamped with it; older ones retire
+                for old in [k for k in preds
+                            if k not in (v, prev)]:
+                    preds.pop(old, None)
+                client.send(CH_CONTROL,
+                            {"op": "version_active",
+                             "shard": shard_id, "version": v})
+        except Exception as e:  # noqa: BLE001 - one failed cutover
+            # step, reported; the worker keeps serving its current
+            # version
+            log.exception("fleet shard %d: %s for version %d failed",
+                          shard_id, op, v)
+            try:
+                client.send(CH_CONTROL,
+                            {"op": "version_op_failed",
+                             "shard": shard_id, "version": v,
+                             "req_op": op, "detail": repr(e)})
+            except OSError:
+                pass
 
     def on_connect(resumed):
         try:
@@ -250,14 +331,31 @@ def _fleet_worker_main(driver_host: str, driver_port: int,
         try:
             if isinstance(msg, (bytes, memoryview)):
                 _kind, rid, X = wire.unpack_matrix(msg)
-            elif isinstance(msg, dict) and msg.get("op") == "score":
+            elif isinstance(msg, dict):
+                if msg.get("op") in ("load_version",
+                                     "activate_version"):
+                    handle_version_op(msg)
+                    return
+                if msg.get("op") != "score":
+                    return
                 # negotiated JSON fallback (peer without the binary
                 # capability)
                 rid = str(msg.get("rid", ""))
                 X = np.asarray(msg["X"], np.float32)
             else:
                 return
-            m = np.asarray(pred(X), np.float32).reshape(X.shape[0], -1)
+            # version pinning: score with the predictor the rid was
+            # stamped for (the driver's fan-out version), falling back
+            # to the active one for unstamped rids — a cutover racing
+            # this request cannot make shards answer from two models
+            rv = _rid_version(rid)
+            p = preds.get(rv if rv is not None else active["v"])
+            if p is None:
+                p = staged.get(rv)
+            if p is None:
+                raise RuntimeError(
+                    f"shard {shard_id} no longer holds version {rv}")
+            m = np.asarray(p(X), np.float32).reshape(X.shape[0], -1)
             if client.session.peer_binary:
                 client.send_bytes(
                     CH_SCORING,
@@ -333,6 +431,16 @@ class PredictorFleet:
         self._ring = ConsistentHashRing(range(self.num_shards))
         self._slot_sid: Dict[int, str] = {}
         self._calls: Dict[str, _FleetCall] = {}
+        # model rollout state (ISSUE 14): per-version shard ranges +
+        # reduce metadata; score() snapshots ONE version per request
+        # and stamps it into the rid, so a cutover mid-fan-out can
+        # never mix tree-range shards from two models in one reduce
+        self._active_version = 0
+        self._version_meta: Dict[int, Dict[str, Any]] = {
+            0: {"ranges": list(self.ranges), "K": self._K,
+                "init_score": self._init_score}}
+        #: (op, version) -> {"event", "acked": set, "failed": dict}
+        self._ctrl_waiters: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._seq = itertools.count()
         self._lock = threading.Lock()
         self._closing = threading.Event()
@@ -343,7 +451,7 @@ class PredictorFleet:
         # fleet telemetry, federated like every other subsystem
         self.stats = StageStats()
         for k in ("requests", "partials", "timeouts", "shard_errors",
-                  "worker_respawns"):
+                  "worker_respawns", "version_cutovers"):
             self.stats.incr(k, 0)
         # resolved once: timer() locks per call — per-request tax.
         # All four are fleet-owned and ALIASED into the profile view
@@ -468,6 +576,12 @@ class PredictorFleet:
 
     def _on_msg(self, session, channel: int, msg, deadline_ms) -> None:
         if channel == CH_CONTROL and isinstance(msg, dict) \
+                and msg.get("op") in ("version_loaded",
+                                      "version_active",
+                                      "version_op_failed"):
+            self._on_version_ack(msg)
+            return
+        if channel == CH_CONTROL and isinstance(msg, dict) \
                 and msg.get("op") == "hello":
             s = msg.get("shard")
             if isinstance(s, int) and 0 <= s < self.num_shards:
@@ -571,6 +685,132 @@ class PredictorFleet:
                 f"fleet shard {shard} has no live session")
         return session
 
+    # ---- versioned cutover (ISSUE 14) ----
+
+    def _on_version_ack(self, msg: dict) -> None:
+        op = {"version_loaded": "load_version",
+              "version_active": "activate_version",
+              "version_op_failed": None}[msg["op"]]
+        v = int(msg.get("version", -1))
+        shard = msg.get("shard")
+        keys = ([(op, v)] if op is not None
+                else [("load_version", v), ("activate_version", v)])
+        with self._lock:
+            for key in keys:
+                w = self._ctrl_waiters.get(key)
+                if w is None:
+                    continue
+                if msg["op"] == "version_op_failed":
+                    w["failed"][shard] = msg.get("detail", "")
+                else:
+                    w["acked"].add(shard)
+                if w["failed"] or len(w["acked"]) >= self.num_shards:
+                    w["event"].set()
+
+    def _version_barrier(self, op: str, version: int, payloads,
+                         timeout: float) -> None:
+        """Send one control message per shard and wait for EVERY shard
+        to ack — the all-or-nothing half of the two-phase cutover."""
+        waiter = {"event": threading.Event(), "acked": set(),
+                  "failed": {}}
+        with self._lock:
+            self._ctrl_waiters[(op, version)] = waiter
+        try:
+            for s in range(self.num_shards):
+                self._session_for(s).send(
+                    CH_CONTROL, payloads[s], timeout=timeout)
+            if not waiter["event"].wait(timeout):
+                missing = sorted(set(range(self.num_shards))
+                                 - waiter["acked"])
+                raise TransportError(
+                    f"fleet {op} v{version}: shards {missing} never "
+                    f"acked within {timeout}s")
+            if waiter["failed"]:
+                raise TransportError(
+                    f"fleet {op} v{version} failed on shards "
+                    f"{waiter['failed']}")
+        finally:
+            with self._lock:
+                self._ctrl_waiters.pop((op, version), None)
+
+    def load_version(self, model_path: str,
+                     version: Optional[int] = None,
+                     timeout: Optional[float] = None) -> int:
+        """Phase 1 of the shard-consistent cutover: stage
+        ``model_path`` (a digest-stamped native-model file — e.g.
+        ``ModelRegistry.model_path(v)``) on EVERY shard under
+        ``version``, each shard building its predictor for the NEW
+        model's tree ranges.  Blocks until all shards acked the load;
+        any shard's failure (digest mismatch included) aborts with the
+        fleet still serving the old version everywhere."""
+        from ..gbdt.booster import Booster
+        timeout = self._join_timeout if timeout is None else timeout
+        # driver-side load verifies the digest once more and yields
+        # the new forest's shape for the per-shard tree ranges
+        b = Booster.load_native_model(model_path)
+        if b.max_feature_idx + 1 > self.num_features:
+            raise ValueError(
+                f"new model wants {b.max_feature_idx + 1} features, "
+                f"fleet clients send {self.num_features}")
+        K = b.num_class
+        ranges = ([(0, len(b.trees))] * self.num_shards
+                  if self.routing == "replica" else
+                  shard_tree_ranges(len(b.trees), self.num_shards, K))
+        with self._lock:
+            if version is None:
+                version = max(self._version_meta) + 1
+            version = int(version)
+            if version in self._version_meta:
+                raise ValueError(
+                    f"fleet already holds version {version}")
+        payloads = [{"op": "load_version", "version": version,
+                     "path": model_path, "lo": lo, "hi": hi}
+                    for lo, hi in ranges]
+        self._version_barrier("load_version", version, payloads,
+                              timeout)
+        with self._lock:
+            self._version_meta[version] = {
+                "ranges": ranges, "K": K,
+                "init_score": float(b.init_score)}
+        return version
+
+    def activate_version(self, version: int,
+                         timeout: Optional[float] = None) -> int:
+        """Phase 2: flip every shard's default to ``version`` (must be
+        staged via :meth:`load_version` first) and then flip the
+        driver's fan-out version atomically.  Requests fanned out
+        before the flip carry the old version in their rids and reduce
+        against the OLD model on every shard; requests after carry the
+        new one — no reduce ever mixes the two."""
+        timeout = self._join_timeout if timeout is None else timeout
+        version = int(version)
+        with self._lock:
+            meta = self._version_meta.get(version)
+            if meta is None:
+                raise ValueError(
+                    f"version {version} was never load_version()ed")
+        payloads = [{"op": "activate_version", "version": version}
+                    for _ in range(self.num_shards)]
+        self._version_barrier("activate_version", version, payloads,
+                              timeout)
+        with self._lock:
+            prev_active = self._active_version
+            self._active_version = version
+            self.ranges = list(meta["ranges"])
+            self._K = meta["K"]
+            self._init_score = meta["init_score"]
+            # drop metadata for versions the workers retired (they
+            # keep only current + previous)
+            for v in [v for v in self._version_meta
+                      if v not in (version, prev_active)]:
+                self._version_meta.pop(v, None)
+        self.stats.incr("version_cutovers")
+        return version
+
+    @property
+    def active_version(self) -> int:
+        return self._active_version
+
     # ---- the predictor contract ----
 
     def __call__(self, X):
@@ -585,17 +825,25 @@ class PredictorFleet:
         X = np.ascontiguousarray(np.asarray(X, np.float32))
         if X.ndim != 2:
             raise ValueError(f"expected (n, f) input, got {X.shape}")
-        rid = f"f{next(self._seq)}"
+        # ONE version snapshot per request, stamped into the rid: every
+        # shard scores this request under exactly this version, and a
+        # cutover racing the fan-out changes only LATER requests — the
+        # shard-consistency contract (docs/rollout.md §Fleet cutover)
+        with self._lock:
+            ver = self._active_version
+            meta = self._version_meta[ver]
+            ranges, K, init_score = (meta["ranges"], meta["K"],
+                                     meta["init_score"])
+        rid = f"v{ver}|f{next(self._seq)}"
         if self.routing == "shard":
-            targets = [s for s, (lo, hi) in enumerate(self.ranges)
+            targets = [s for s, (lo, hi) in enumerate(ranges)
                        if hi > lo]
             if not targets:
                 # a 0-tree forest has no shard to ask: the margin is
                 # the init score — answer immediately instead of
                 # parking a waiter nothing will ever complete
-                out = np.full((X.shape[0], self._K),
-                              np.float32(self._init_score))
-                return out[:, 0] if self._K == 1 else out
+                out = np.full((X.shape[0], K), np.float32(init_score))
+                return out[:, 0] if K == 1 else out
         else:
             targets = [self._ring.route(key if key is not None
                                         else rid)]
@@ -653,4 +901,4 @@ class PredictorFleet:
         req_s = time.perf_counter() - t0
         self._rtt.record(req_s)
         prof.span("fleet.request", req_s, tid=rid, record=False)
-        return out[:, 0] if self._K == 1 else out
+        return out[:, 0] if K == 1 else out

@@ -308,6 +308,15 @@ class ScoringEngine:
             # padding there only scores phantom rows.  Unknown callables
             # (no .mode) are assumed jit-like and padded.
             pad_buckets = getattr(predictor, "mode", "jit") != "native"
+        # rid-routed predictors (the RolloutController's blue/green
+        # traffic splitter, ISSUE 14): the engine hands the batch's
+        # request ids alongside the matrix so the split is per-request
+        # and retry-stable.  Engine-level padding is disabled — padded
+        # phantom rows have no rid to route; the splitter pads each
+        # arm's sub-batch itself.
+        self._routed = bool(getattr(predictor, "routes_by_rid", False))
+        if self._routed:
+            pad_buckets = False
         self._server = server
         self._predictor = predictor
         self._plan = plan
@@ -780,17 +789,24 @@ class ScoringEngine:
 
     # -- scoring -------------------------------------------------------------
 
-    def _score_matrix(self, X: np.ndarray, n: int) -> List[Any]:
+    def _score_matrix(self, X: np.ndarray, n: int,
+                      rids: Optional[List[str]] = None) -> List[Any]:
         """Pad to the power-of-two bucket, score, slice, format.
         Callers own the ``score`` stage bracket (their window also
         covers the per-batch result assembly, so the named phases tile
-        the e2e wall time instead of leaking glue between brackets)."""
+        the e2e wall time instead of leaking glue between brackets).
+        For rid-routed predictors (``routes_by_rid``) the rids ride
+        along so the splitter pins each row to its arm."""
         if self._pad_buckets:
             b = next_pow2(n)
             if b > n:
                 Xp = np.zeros((b, X.shape[1]), np.float32)
                 Xp[:n] = X
                 X = Xp
+        scorer = self._predictor
+        if self._routed and rids is not None:
+            def scorer(M, _p=self._predictor, _r=rids):  # noqa: E731
+                return _p.score_routed(M, _r)
         if self._prof.enabled:
             # dispatch bracketing (ISSUE 12): host time until the
             # scorer call returns vs wait until the result
@@ -799,7 +815,7 @@ class ScoringEngine:
             prof = self._prof
             seq0 = prof._compile_seq
             t0 = time.perf_counter()
-            raw = self._predictor(X)
+            raw = scorer(X)
             t_host = time.perf_counter()
             m = np.asarray(raw)[:n]
             self._pt_disp_host.record(t_host - t0)
@@ -807,7 +823,7 @@ class ScoringEngine:
             prof.count_dispatch("scoring",
                                 prof._compile_seq - seq0)
         else:
-            m = np.asarray(self._predictor(X))[:n]
+            m = np.asarray(scorer(X))[:n]
         if self._reply_fn is not None:
             return self._reply_fn(m)
         if self._ndarray_replies:
@@ -830,7 +846,8 @@ class ScoringEngine:
         if X is None:
             return self._score_predictor_salvage(batch)
         t1 = time.perf_counter()
-        vals = self._score_matrix(X, X.shape[0])
+        vals = self._score_matrix(X, X.shape[0],
+                                  rids=[str(e[0]) for e in batch])
         pairs = [(e[0], vals[i]) for i, e in enumerate(batch)]
         score_s = time.perf_counter() - t1
         self._pt_score.record(score_s)
@@ -866,7 +883,11 @@ class ScoringEngine:
         if rows:
             X = np.ascontiguousarray(np.stack(rows))
             t0 = time.perf_counter()
-            vals = self._score_matrix(X, len(rows))
+            # salvage keeps each surviving row's rid: a routed
+            # predictor re-pins it to the SAME arm the vectorized
+            # attempt would have used (retry-stable routing)
+            vals = self._score_matrix(X, len(rows),
+                                      rids=[str(r) for r in order])
             out += [(rid, vals[i]) for i, rid in enumerate(order)]
             score_s = time.perf_counter() - t0
             self._pt_score.record(score_s)

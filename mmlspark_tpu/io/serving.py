@@ -107,6 +107,12 @@ class _ServingHandler(BaseHTTPRequestHandler):
     def _ready(self) -> bool:
         return False
 
+    def _model_info(self) -> Optional[dict]:
+        """The active model version/digest block ``/readyz`` carries
+        when a rollout controller is installed (ISSUE 14 satellite);
+        ``None`` keeps the legacy ready-only body."""
+        return None
+
     def _metrics(self) -> Optional[str]:
         """Prometheus text for /metrics; ``None`` -> 503.  Default:
         this process's global registry (scoring engine, train stats,
@@ -134,7 +140,14 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 ready = bool(self._ready())
             except Exception:  # noqa: BLE001
                 ready = False
-            self._send_json(200 if ready else 503, {"ready": ready})
+            body = {"ready": ready}
+            try:
+                info = self._model_info()
+            except Exception:  # noqa: BLE001 - the model block is
+                info = None    # advisory; readiness must still answer
+            if info:
+                body["model"] = info
+            self._send_json(200 if ready else 503, body)
         elif self.path == "/slo":
             try:
                 report = self._slo()
@@ -337,6 +350,10 @@ class HTTPServer:
         # /metrics hook: None -> the process-global MetricsRegistry;
         # a custom provider returns the full exposition text itself
         self.metrics_provider: Optional[Callable[[], str]] = None
+        # /readyz model block: RolloutController.install() points this
+        # at its model_info() so operators can read the active
+        # version/digest off the readiness probe (ISSUE 14 satellite)
+        self.model_info_provider: Optional[Callable[[], dict]] = None
         outer = self
 
         class Handler(_ServingHandler):
@@ -348,6 +365,10 @@ class HTTPServer:
             def _ready(self):
                 check = outer.ready_check
                 return check is not None and bool(check())
+
+            def _model_info(self):
+                provider = outer.model_info_provider
+                return provider() if provider is not None else None
 
             def _metrics(self):
                 provider = outer.metrics_provider
@@ -465,6 +486,18 @@ class DistributedHTTPServer:
             w.metrics_provider = provider
 
     @property
+    def model_info_provider(self) -> Optional[Callable[[], dict]]:
+        """/readyz model-block hook, fanned out to every worker."""
+        return self.workers[0].model_info_provider if self.workers \
+            else None
+
+    @model_info_provider.setter
+    def model_info_provider(
+            self, provider: Optional[Callable[[], dict]]) -> None:
+        for w in self.workers:
+            w.model_info_provider = provider
+
+    @property
     def request_queue(self) -> "queue.Queue[Tuple[str, Any, float]]":
         return self._exchange.queue
 
@@ -561,8 +594,10 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
 
     # "engine_ready" mirrors the driver's ready beacon (None until the
     # first beacon arrives — treated as ready so a beacon-less driver
-    # degrades to link-up readiness, the pre-beacon contract)
-    link: Dict[str, Any] = {"engine_ready": None}
+    # degrades to link-up readiness, the pre-beacon contract);
+    # "model_info" mirrors the beacon's rollout model block so this
+    # worker's /readyz names the active version/digest (ISSUE 14)
+    link: Dict[str, Any] = {"engine_ready": None, "model_info": None}
     stop_evt = threading.Event()
     pending: Dict[str, _Pending] = {}
     payloads: Dict[str, Any] = {}   # rid -> payload, kept for re-park
@@ -626,8 +661,14 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             if op == "stop":
                 stop_evt.set()
             elif op == "ready":
-                # driver readiness beacon → worker /readyz truth
-                link["engine_ready"] = bool(msg.get("value"))
+                # driver readiness beacon → worker /readyz truth; a
+                # None value means "no engine check installed" (the
+                # beacon only carried model info) and must not flip
+                # readiness
+                if msg.get("value") is not None:
+                    link["engine_ready"] = bool(msg.get("value"))
+                if msg.get("model") is not None:
+                    link["model_info"] = msg.get("model")
         elif channel == CH_SCORING and op == "reply":
             rid = msg["rid"]
             with plock:
@@ -718,6 +759,10 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             # readiness over the exchange) has not declared itself down
             return (client.connected
                     and link["engine_ready"] is not False)
+
+        def _model_info(self):
+            # the driver's rollout model block, as last beaconed
+            return link.get("model_info")
 
         def _metrics(self):
             # the engine (and its StageStats) lives in the DRIVER
@@ -1039,6 +1084,11 @@ class MultiprocessHTTPServer:
         # beacon thread broadcasts it to worker processes so their
         # /readyz reflects ENGINE readiness, not just link liveness
         self.ready_check: Optional[Callable[[], bool]] = None
+        # rollout model info (ISSUE 14): the driver-side controller
+        # installs model_info() here; the ready beacon carries it to
+        # every worker process so THEIR /readyz names the active
+        # model version/digest too
+        self.model_info_provider: Optional[Callable[[], dict]] = None
         self._reply_timeout = reply_timeout
         self._join_timeout = join_timeout
         self._request_read_timeout = request_read_timeout
@@ -1193,17 +1243,24 @@ class MultiprocessHTTPServer:
         readiness."""
         while not self._closing.wait(0.5):
             check = self.ready_check
-            if check is None:
+            info_provider = self.model_info_provider
+            if check is None and info_provider is None:
                 continue
-            try:
-                r = bool(check())
-            except Exception:  # noqa: BLE001
-                r = False
+            r = None
+            if check is not None:
+                try:
+                    r = bool(check())
+                except Exception:  # noqa: BLE001
+                    r = False
+            msg = {"op": "ready", "value": r}
+            if info_provider is not None:
+                try:
+                    msg["model"] = info_provider()
+                except Exception:  # noqa: BLE001 - advisory block
+                    pass
             for session in self._worker_sessions():
                 try:
-                    session.send(CH_CONTROL,
-                                 {"op": "ready", "value": r},
-                                 timeout=0.5)
+                    session.send(CH_CONTROL, msg, timeout=0.5)
                 except OSError:
                     pass   # dying link: the transport handles it
 

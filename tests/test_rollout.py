@@ -1,0 +1,626 @@
+"""SLO-gated zero-downtime rollout (ISSUE 14): the versioned model
+registry (durable writes, digest verification, promotion states), the
+blue/green RolloutController (deterministic per-rid routing, SLO-gated
+promote/rollback, zero wrong answers under canary faults), the
+ScoringEngine routing hook, hot-swap under concurrent traffic, the
+fleet's shard-consistent version cutover, and the /readyz + metrics
+model-info surfaces.  Tier-1 smoke for tools/chaos_rollout.py."""
+
+import json
+import os
+import queue
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.gbdt import LightGBMRegressor
+from mmlspark_tpu.gbdt.booster import (Booster, DIGEST_HEADER,
+                                       ModelDigestError,
+                                       with_digest_header)
+from mmlspark_tpu.io.chaos import ChaosPlan, ChaosPredictor, corrupt_file
+from mmlspark_tpu.io.registry import (ModelCorruption, ModelRegistry,
+                                      RegistryError)
+from mmlspark_tpu.io.rollout import (RolloutConfig, RolloutController,
+                                     render_model_info)
+from mmlspark_tpu.io.scoring import ScoringEngine
+
+
+@pytest.fixture(scope="module")
+def models():
+    """Two distinct model generations as native-model TEXT (each test
+    builds fresh Boosters from them, so invalidate_cache() in one test
+    cannot poison another's predictors) plus the shared feature set."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(600, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]).astype(np.float64)
+    m1 = LightGBMRegressor(numIterations=6, numLeaves=7,
+                           parallelism="serial", verbosity=0).fit(
+        {"features": X, "label": y})
+    m2 = LightGBMRegressor(numIterations=10, numLeaves=15,
+                           parallelism="serial", verbosity=0).fit(
+        {"features": X, "label": y})
+    t1 = m1.getModel().save_native_model_string()
+    t2 = m2.getModel().save_native_model_string()
+    w1 = np.asarray(m1.getModel().predict_margin(X), np.float32)
+    w2 = np.asarray(m2.getModel().predict_margin(X), np.float32)
+    assert not np.array_equal(w1, w2)
+    return {"t1": t1, "t2": t2, "X": X, "w1": w1, "w2": w2}
+
+
+def make_registry(tmp_path, models, n_candidates=1):
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v1 = reg.publish(models["t1"], activate=True)
+    cands = [reg.publish(models["t2"]) for _ in range(n_candidates)]
+    return reg, v1, cands[0] if cands else None
+
+
+class FakeServer:
+    """Exchange-contract stub: a raw request queue + recorded replies."""
+
+    binary_wire = False
+
+    def __init__(self):
+        self.request_queue = queue.Queue()
+        self.replies = []
+        self._lock = threading.Lock()
+
+    def reply(self, rid, val, status=200):
+        with self._lock:
+            self.replies.append((rid, val, status))
+        return True
+
+    def reply_many(self, entries):
+        with self._lock:
+            self.replies.extend(entries)
+        return len(entries)
+
+    def by_rid(self):
+        with self._lock:
+            return {r: (v, s) for r, v, s in self.replies}
+
+
+def wait_replies(srv, n, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with srv._lock:
+            if len(srv.replies) >= n:
+                return True
+        time.sleep(0.01)
+    return False
+
+
+# --------------------------------------------------------- the registry
+
+
+class TestRegistry:
+    def test_publish_load_round_trip_bit_exact(self, tmp_path, models):
+        reg, v1, v2 = make_registry(tmp_path, models)
+        assert (v1, v2) == (1, 2)
+        assert reg.active_version() == v1
+        assert reg.candidates() == [v2]
+        X = models["X"]
+        b = reg.load()           # active
+        got = np.asarray(b.predict_margin(X), np.float32)
+        assert np.array_equal(got, models["w1"])
+        b2 = reg.load(v2)
+        assert np.array_equal(
+            np.asarray(b2.predict_margin(X), np.float32),
+            models["w2"])
+
+    def test_versions_monotonic_across_reopen(self, tmp_path, models):
+        reg, v1, v2 = make_registry(tmp_path, models)
+        reg2 = ModelRegistry(reg.root)      # fresh process, same root
+        v3 = reg2.publish(models["t1"])
+        assert v3 == v2 + 1
+        assert reg2.active_version() == v1
+
+    @pytest.mark.parametrize("mode", ["bitflip", "torn"])
+    def test_corrupt_model_file_rejected_and_quarantined(
+            self, tmp_path, models, mode):
+        reg, v1, v2 = make_registry(tmp_path, models)
+        corrupt_file(reg.model_path(v2), mode=mode)
+        with pytest.raises(ModelCorruption):
+            reg.load(v2)
+        assert reg.entry(v2)["promoted_state"] == "quarantined"
+        # a quarantined entry can never be promoted
+        with pytest.raises(RegistryError):
+            reg.activate(v2)
+        # the healthy active version still loads
+        assert reg.load(v1) is not None
+
+    def test_activate_retires_and_rollback_restores(self, tmp_path,
+                                                    models):
+        reg, v1, v2 = make_registry(tmp_path, models)
+        reg.activate(v2)
+        assert reg.active_version() == v2
+        assert reg.entry(v1)["promoted_state"] == "retired"
+        back = reg.rollback()
+        assert back == v1
+        assert reg.active_version() == v1
+        assert reg.entry(v2)["promoted_state"] == "rolled_back"
+
+    def test_manifest_replace_is_the_commit_point(self, tmp_path,
+                                                  models):
+        """A crash BEFORE the manifest rename leaves the old state
+        fully intact: the new model file is an invisible orphan."""
+        reg, v1, _ = make_registry(tmp_path, models, n_candidates=0)
+
+        class Boom(RuntimeError):
+            pass
+
+        def die():
+            raise Boom()
+
+        reg.pre_commit_hook = die
+        with pytest.raises(Boom):
+            reg.publish(models["t2"])
+        reg.pre_commit_hook = None
+        reg2 = ModelRegistry(reg.root)
+        assert reg2.latest_version() == v1
+        assert reg2.active_version() == v1
+        assert reg2.verify(v1)
+
+    def test_stale_tmp_manifest_ignored(self, tmp_path, models):
+        reg, v1, _ = make_registry(tmp_path, models, n_candidates=0)
+        with open(os.path.join(reg.root, "manifest.json.tmp"),
+                  "w") as fh:
+            fh.write("{torn garbage")
+        reg2 = ModelRegistry(reg.root)
+        assert reg2.active_version() == v1
+
+    def test_empty_model_refused(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "r"))
+        with pytest.raises(RegistryError):
+            reg.publish("")
+
+
+# ------------------------------------------- native-model digest header
+
+
+class TestBoosterDigest:
+    def _booster(self, models):
+        return Booster.load_native_model_string(models["t1"])
+
+    def test_save_embeds_header_and_load_verifies(self, tmp_path,
+                                                  models):
+        b = self._booster(models)
+        path = str(tmp_path / "m.txt")
+        b.save_native_model(path)
+        with open(path) as fh:
+            first = fh.readline()
+        assert first.startswith(DIGEST_HEADER)
+        b2 = Booster.load_native_model(path)
+        X = models["X"]
+        assert np.array_equal(
+            np.asarray(b2.predict_margin(X), np.float32),
+            np.asarray(b.predict_margin(X), np.float32))
+
+    @pytest.mark.parametrize("mode", ["bitflip", "torn"])
+    def test_corruption_detected_at_load(self, tmp_path, models, mode):
+        b = self._booster(models)
+        path = str(tmp_path / "m.txt")
+        b.save_native_model(path)
+        corrupt_file(path, ChaosPlan(3), mode=mode)
+        with pytest.raises(ModelDigestError):
+            Booster.load_native_model(path)
+
+    def test_digestless_files_still_load(self, tmp_path, models):
+        """Backward compatibility: stock LightGBM exports and
+        pre-digest saves carry no header and must load unchanged."""
+        path = str(tmp_path / "legacy.txt")
+        with open(path, "w") as fh:
+            fh.write(models["t1"])
+        b = Booster.load_native_model(path)
+        assert len(b.trees) > 0
+
+    def test_with_digest_header_idempotent(self, models):
+        once = with_digest_header(models["t1"])
+        assert with_digest_header(once) == once
+
+    def test_mangled_header_is_not_silently_digestless(self, models):
+        stamped = with_digest_header(models["t1"])
+        mangled = "#X" + stamped[2:]     # bit-flip inside the header
+        with pytest.raises(ModelDigestError):
+            Booster.load_native_model_string(mangled)
+
+
+# ----------------------------------------------------- per-rid routing
+
+
+class TestRouting:
+    def _controller(self, tmp_path, models, **cfg):
+        reg, v1, v2 = make_registry(tmp_path, models)
+        defaults = dict(canary_fraction=0.3, soak_s=60.0,
+                        min_canary_rows=10**9)
+        defaults.update(cfg)
+        ctl = RolloutController(reg,
+                                config=RolloutConfig(**defaults))
+        return reg, ctl, v2
+
+    def test_routing_deterministic_across_instances(self, tmp_path,
+                                                    models):
+        _, ctl_a, v2 = self._controller(tmp_path, models)
+        ctl_a.start_canary(v2)
+        _, ctl_b, v2b = self._controller(tmp_path / "b", models)
+        ctl_b.start_canary(v2b)
+        rids = [f"req-{i}" for i in range(500)]
+        arms_a = [ctl_a.arm_for(r) for r in rids]
+        arms_b = [ctl_b.arm_for(r) for r in rids]
+        assert arms_a == arms_b        # same rid + version → same arm
+        # and stable on retry within one instance
+        assert arms_a == [ctl_a.arm_for(r) for r in rids]
+
+    def test_fraction_respected(self, tmp_path, models):
+        _, ctl, v2 = self._controller(tmp_path, models,
+                                      canary_fraction=0.25)
+        ctl.start_canary(v2)
+        rids = [f"r{i}" for i in range(4000)]
+        frac = sum(ctl.arm_for(r) == "canary" for r in rids) / 4000
+        assert 0.2 < frac < 0.3
+
+    def test_new_canary_samples_new_slice(self, tmp_path, models):
+        """The salt is the canary version: rollout N+1 must not retry
+        the exact ids rollout N canaried."""
+        _, ctl, v2 = self._controller(tmp_path, models)
+        rids = [f"r{i}" for i in range(1000)]
+        a = [ctl.arm_for(r, fraction=0.3, salt="2") for r in rids]
+        b = [ctl.arm_for(r, fraction=0.3, salt="3") for r in rids]
+        assert a != b
+
+    def test_no_canary_routes_everything_baseline(self, tmp_path,
+                                                  models):
+        _, ctl, _ = self._controller(tmp_path, models)
+        assert all(ctl.arm_for(f"r{i}") == "baseline"
+                   for i in range(50))
+
+
+# ----------------------------------- promote / rollback through the gate
+
+
+class TestPromoteRollback:
+    def _engine_stack(self, tmp_path, models, **cfg):
+        reg, v1, v2 = make_registry(tmp_path, models)
+        defaults = dict(canary_fraction=0.4, soak_s=0.0,
+                        min_canary_rows=20, canary_deadline_ms=None,
+                        fast_window_s=5.0, slow_window_s=10.0)
+        defaults.update(cfg)
+        ctl = RolloutController(reg,
+                                config=RolloutConfig(**defaults))
+        srv = FakeServer()
+        eng = ScoringEngine(srv, predictor=ctl, max_rows=16,
+                            latency_budget_ms=2.0, num_scorers=2,
+                            num_repliers=0)
+        return reg, ctl, srv, eng, v2
+
+    def _drive(self, srv, X, n, tag=""):
+        rids = []
+        for k in range(n):
+            rid = f"{tag}q{k}"
+            rids.append((rid, k % len(X)))
+            srv.request_queue.put(
+                (rid, {"features": X[k % len(X)].tolist()},
+                 time.perf_counter()))
+        return rids
+
+    def test_healthy_canary_promotes_and_serves_new_version(
+            self, tmp_path, models):
+        from mmlspark_tpu.core.telemetry import get_journal
+        reg, ctl, srv, eng, v2 = self._engine_stack(tmp_path, models)
+        X, w1, w2 = models["X"], models["w1"], models["w2"]
+        eng.start()
+        try:
+            ctl.start_canary(v2)
+            rids = self._drive(srv, X, 120)
+            assert wait_replies(srv, 120)
+            got = srv.by_rid()
+            # every reply is bit-exact for its PINNED arm — no value
+            # from a third place, no mixing
+            for rid, i in rids:
+                val, status = got[rid]
+                assert status == 200
+                want = w2[i] if ctl.arm_for(rid) == "canary" else w1[i]
+                assert np.float32(val) == want
+            assert ctl.stats.counter("canary_rows") >= 20
+            state = ctl.tick()     # zero-point sampled at start_canary
+            assert state == "promoted"
+            assert reg.active_version() == v2
+            assert reg.entry(v2)["promoted_state"] == "active"
+            # post-promote traffic serves v2 for EVERY rid
+            rids2 = self._drive(srv, X, 40, tag="post")
+            assert wait_replies(srv, 160)
+            got = srv.by_rid()
+            for rid, i in rids2:
+                val, status = got[rid]
+                assert status == 200 and np.float32(val) == w2[i]
+            evs = [e for e in get_journal().events()
+                   if e["ev"] == "rollout_promoted"]
+            assert evs and evs[-1]["version"] == v2
+        finally:
+            eng.stop()
+
+    def test_faulty_canary_rolled_back_zero_wrong_answers(
+            self, tmp_path, models):
+        from mmlspark_tpu.core.telemetry import get_journal
+        reg, ctl, srv, eng, v2 = self._engine_stack(
+            tmp_path, models, min_canary_rows=10**9)
+        X, w1 = models["X"], models["w1"]
+        plan = ChaosPlan(11)
+        ctl.canary_wrap = lambda p: ChaosPredictor(
+            p, plan, exc_rate=1.0, name="canary")
+        eng.start()
+        try:
+            ctl.start_canary(v2)
+            rids = self._drive(srv, X, 100)
+            assert wait_replies(srv, 100)
+            got = srv.by_rid()
+            # EVERY reply — canary-routed included — is the baseline's
+            # bit-exact answer: canary faults burn the SLO, never a
+            # client
+            for rid, i in rids:
+                val, status = got[rid]
+                assert status == 200
+                assert np.float32(val) == w1[i]
+            assert ctl.stats.counter("canary_errors") > 0
+            assert ctl.stats.counter("canary_fallback_rows") > 0
+            state = ctl.tick()              # both windows burning
+            assert state == "rolled_back"
+            assert ctl.state() == "steady"
+            assert reg.entry(v2)["promoted_state"] == "rolled_back"
+            assert reg.active_version() == 1
+            evs = [e for e in get_journal().events()
+                   if e["ev"] == "rollout_rolled_back"]
+            assert evs and evs[-1]["version"] == v2
+            assert evs[-1]["reason"].startswith("slo_burn")
+            # post-rollback traffic still answers, all baseline
+            rids2 = self._drive(srv, X, 30, tag="post")
+            assert wait_replies(srv, 130)
+            got = srv.by_rid()
+            for rid, i in rids2:
+                val, status = got[rid]
+                assert status == 200 and np.float32(val) == w1[i]
+        finally:
+            eng.stop()
+
+    def test_canary_deadline_objective_counts(self, tmp_path, models):
+        reg, ctl, srv, eng, v2 = self._engine_stack(
+            tmp_path, models, canary_deadline_ms=0.0,
+            min_canary_rows=10**9)
+        X = models["X"]
+        eng.start()
+        try:
+            ctl.start_canary(v2)
+            self._drive(srv, X, 60)
+            assert wait_replies(srv, 60)
+            # a 0 ms deadline: every canary batch misses
+            assert ctl.stats.counter("canary_deadline_miss") > 0
+            assert ctl.tick() == "rolled_back"
+        finally:
+            eng.stop()
+
+    def test_holdout_drift_gauge(self, tmp_path, models):
+        reg, ctl, srv, eng, v2 = self._engine_stack(
+            tmp_path, models,
+            holdout_drift_threshold=1e9)   # gauge only, never trips
+        X = models["X"]
+        ctl.set_holdout(X[:64])
+        ctl.start_canary(v2)
+        ctl.tick()
+        drift = ctl.stats.gauge("canary_holdout_drift")
+        want = float(np.mean(np.abs(models["w2"][:64]
+                                    - models["w1"][:64])))
+        assert drift == pytest.approx(want, rel=1e-5)
+
+    def test_rollback_requires_canary(self, tmp_path, models):
+        reg, ctl, srv, eng, v2 = self._engine_stack(tmp_path, models)
+        with pytest.raises(RegistryError):
+            ctl.rollback()
+        with pytest.raises(RegistryError):
+            ctl.promote()
+
+
+# -------------------- invalidate_cache() under concurrent traffic (sat)
+
+
+class TestHotSwapUnderTraffic:
+    def test_swap_mid_flight_every_reply_is_one_version(
+            self, tmp_path, models):
+        """ISSUE 14 satellite: swap the serving model while batches
+        are in flight.  Every reply must be bit-exact against EXACTLY
+        one of the two versions (no torn batch mixing trees across
+        versions), nothing may error, and the superseded booster's
+        predictors must be invalidated afterwards."""
+        reg, v1, v2 = make_registry(tmp_path, models)
+        ctl = RolloutController(reg, config=RolloutConfig(
+            canary_fraction=0.3, soak_s=0.0, min_canary_rows=1,
+            retire_grace_s=10.0))
+        old_baseline_booster = ctl._boosters["baseline"]
+        stale_pred = old_baseline_booster.predictor()
+        srv = FakeServer()
+        eng = ScoringEngine(srv, predictor=ctl, max_rows=8,
+                            latency_budget_ms=1.0, num_scorers=3,
+                            num_repliers=0)
+        X, w1, w2 = models["X"], models["w1"], models["w2"]
+        eng.start()
+        stop = threading.Event()
+        sent = []
+        lock = threading.Lock()
+
+        def client(cid):
+            k = 0
+            while not stop.is_set():
+                rid = f"c{cid}-{k}"
+                i = (cid * 131 + k) % len(X)
+                with lock:
+                    sent.append((rid, i))
+                srv.request_queue.put(
+                    (rid, {"features": X[i].tolist()},
+                     time.perf_counter()))
+                k += 1
+                time.sleep(0.001)
+
+        threads = [threading.Thread(target=client, args=(c,),
+                                    daemon=True) for c in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)                 # traffic on v1
+            ctl.start_canary(v2)
+            time.sleep(0.3)                 # split traffic
+            ctl.promote()                   # swap + invalidate
+            time.sleep(0.3)                 # traffic on v2
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            with lock:
+                expected = list(sent)
+            assert wait_replies(srv, len(expected))
+            got = srv.by_rid()
+            n_v1 = n_v2 = 0
+            for rid, i in expected:
+                val, status = got[rid]
+                assert status == 200, (rid, val, status)
+                v = np.float32(val)
+                if v == w1[i] and w1[i] != w2[i]:
+                    n_v1 += 1
+                elif v == w2[i]:
+                    n_v2 += 1
+                else:
+                    raise AssertionError(
+                        f"reply for {rid} matches NEITHER version "
+                        f"bit-exactly: {v!r} vs {w1[i]!r}/{w2[i]!r}")
+            assert n_v1 > 0 and n_v2 > 0    # the swap really happened
+            # the superseded forest is unreachable: a predictor bound
+            # to it raises instead of silently serving stale trees
+            with pytest.raises(RuntimeError, match="stale"):
+                stale_pred(X[:4])
+        finally:
+            stop.set()
+            eng.stop()
+
+
+# ------------------------------------- fleet shard-consistent cutover
+
+
+class TestFleetVersionCutover:
+    def test_two_phase_cutover_never_mixes_versions(self, tmp_path,
+                                                    models):
+        from mmlspark_tpu.io.fleet import (PredictorFleet,
+                                           ShardedPredictor)
+        b1 = Booster.load_native_model_string(models["t1"])
+        b2 = Booster.load_native_model_string(models["t2"])
+        X = models["X"][:64]
+        w1 = np.asarray(ShardedPredictor(b1, 2)(X), np.float32)
+        w2 = np.asarray(ShardedPredictor(b2, 2)(X), np.float32)
+        path = str(tmp_path / "v2.txt")
+        b2.save_native_model(path)
+        fleet = PredictorFleet(b1, num_shards=2, spawn=False).start()
+        results = []
+        stop = threading.Event()
+
+        def loop():
+            while not stop.is_set():
+                results.append(np.asarray(fleet(X), np.float32))
+
+        t = threading.Thread(target=loop, daemon=True)
+        try:
+            assert np.array_equal(
+                np.asarray(fleet(X), np.float32), w1)
+            v = fleet.load_version(path)
+            t.start()
+            time.sleep(0.05)
+            fleet.activate_version(v)
+            time.sleep(0.05)
+            stop.set()
+            t.join(timeout=10)
+            assert fleet.active_version == v
+            assert np.array_equal(
+                np.asarray(fleet(X), np.float32), w2)
+            # every concurrent result is EXACTLY one version's margin
+            # vector — a mixed reduce (some shards v1, some v2) cannot
+            # equal either and would fail here
+            for r in results:
+                assert (np.array_equal(r, w1)
+                        or np.array_equal(r, w2)), \
+                    "reduce mixed tree-range shards across versions"
+        finally:
+            stop.set()
+            fleet.stop()
+
+    def test_load_failure_aborts_cutover(self, tmp_path, models):
+        from mmlspark_tpu.io.fleet import PredictorFleet
+        from mmlspark_tpu.io.transport import TransportError
+        b1 = Booster.load_native_model_string(models["t1"])
+        b2 = Booster.load_native_model_string(models["t2"])
+        X = models["X"][:16]
+        path = str(tmp_path / "v2.txt")
+        b2.save_native_model(path)
+        fleet = PredictorFleet(b1, num_shards=2, spawn=False).start()
+        try:
+            w1 = np.asarray(fleet(X), np.float32)
+            corrupt_file(path, mode="bitflip")
+            with pytest.raises((TransportError, ModelDigestError)):
+                fleet.load_version(path, timeout=10.0)
+            # the fleet still serves the old version everywhere
+            assert np.array_equal(np.asarray(fleet(X), np.float32),
+                                  w1)
+            assert fleet.active_version == 0
+        finally:
+            fleet.stop()
+
+
+# ------------------------------------ /readyz + metrics model surfaces
+
+
+class TestModelInfoSurfaces:
+    def _get(self, url, timeout=5.0):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_readyz_and_metrics_name_the_active_model(self, tmp_path,
+                                                      models):
+        from mmlspark_tpu.io.serving import HTTPServer
+        reg, v1, v2 = make_registry(tmp_path, models)
+        ctl = RolloutController(reg)
+        srv = HTTPServer(port=0).start()
+        eng = ScoringEngine(srv, predictor=ctl, num_repliers=0)
+        ctl.install(srv)
+        eng.start()
+        try:
+            status, body = self._get(srv.address + "/readyz")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["ready"] is True
+            arms = doc["model"]["arms"]
+            assert arms[0]["arm"] == "baseline"
+            assert arms[0]["version"] == v1
+            assert arms[0]["digest"].startswith("sha256:")
+            assert doc["model"]["state"] == "steady"
+            status, body = self._get(srv.address + "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert "mmlspark_tpu_serving_model_info{" in text
+            assert f'version="{v1}"' in text
+            # a live canary appears as a second arm
+            ctl.start_canary(v2)
+            status, body = self._get(srv.address + "/readyz")
+            doc = json.loads(body)
+            assert [a["arm"] for a in doc["model"]["arms"]] == \
+                ["baseline", "canary"]
+            assert doc["model"]["canary_version"] == v2
+        finally:
+            eng.stop()
+            srv.stop()
+
+    def test_render_model_info_shape(self):
+        text = render_model_info(
+            [{"arm": "baseline", "version": 3,
+              "digest": "sha256:abc"}])
+        assert "# TYPE mmlspark_tpu_serving_model_info gauge" in text
+        assert ('mmlspark_tpu_serving_model_info{arm="baseline",'
+                'digest="sha256:abc",version="3"} 1') in text

@@ -5,13 +5,77 @@ contracts: the ``mapInPandas``-shaped scoring closure on a plain iterator
 of pandas batches, and ``from_spark`` against a duck-typed DataFrame.
 """
 
+import json
 import os
+import socket
+import subprocess
+import sys
+import tempfile
+
 import numpy as np
 import pandas as pd
 import pytest
 
 from mmlspark_tpu import spark as sk
 from mmlspark_tpu.gbdt import LightGBMClassifier
+
+_MP_PROBE_SRC = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax, numpy as np
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=2, process_id=int(sys.argv[2]))
+from jax.experimental import multihost_utils
+out = multihost_utils.process_allgather(np.asarray([jax.process_index()]))
+assert sorted(np.asarray(out).ravel().tolist()) == [0, 1]
+print("MP_OK", flush=True)
+"""
+
+
+def _jax_multiprocess_available() -> bool:
+    """Collection-time probe (ISSUE 14 satellite): can this container
+    actually run a 2-process ``jax.distributed`` gang with a real
+    cross-process collective?  Some CPU jaxlib builds accept
+    ``initialize()`` but fail the first collective with
+    "Multiprocess computations aren't implemented on the CPU backend"
+    — the executor-side tests then fail on environment, not code.
+    The verdict is cached in a tmp file keyed by the jax build, so
+    repeated tier-1 runs pay the ~10 s subprocess probe once."""
+    import jax
+    cache = os.path.join(
+        tempfile.gettempdir(),
+        f"mmlspark_tpu_jaxmp_probe_{jax.__version__}.json")
+    try:
+        with open(cache) as fh:
+            return bool(json.load(fh)["available"])
+    except (OSError, ValueError, KeyError):
+        pass
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _MP_PROBE_SRC, addr, str(i)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True) for i in range(2)]
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        available = all(p.returncode == 0 for p in procs) \
+            and all("MP_OK" in o for o in outs)
+    except Exception:  # noqa: BLE001 - an unprobeable env is
+        for p in procs:                  # an unavailable env
+            p.kill()
+        available = False
+    try:
+        with open(cache, "w") as fh:
+            json.dump({"available": available}, fh)
+    except OSError:
+        pass
+    return available
 
 
 @pytest.fixture(scope="module")
@@ -122,6 +186,11 @@ class TestDriverSide:
         assert len(np.asarray(pdf["x"].iloc[0])) == 2
 
 
+@pytest.mark.skipif(
+    not _jax_multiprocess_available(),
+    reason="jax multiprocess collectives unavailable on this "
+           "container's CPU backend (2-process process_allgather "
+           "probe failed); executor-side training cannot run")
 class TestExecutorSideTraining:
     """Executor-side training (VERDICT r3 next #7): the barrier-task
     closure trains INSIDE separate worker processes via None-slot sharded

@@ -875,6 +875,15 @@ class TestMetricFamilyDocGuard:
             "/jax/core/compile/backend_compile_duration", 0.01)
         prof.record_memory("tpu:0", "bytes_in_use", 1 << 20)
         reg.register_exposition("profile", prof.render_prometheus)
+        # the rollout controller's model-info family (ISSUE 14
+        # satellite), rendered off a representative arm entry the way
+        # io/rollout publishes the real one
+        from mmlspark_tpu.io.rollout import render_model_info
+        reg.register_exposition(
+            "serving_model_info",
+            lambda: render_model_info(
+                [{"arm": "baseline", "version": 1,
+                  "digest": "sha256:deadbeef"}]))
         # the ops compile-probe info family, rendered off a seeded
         # cache the way ops/pallas_histogram publishes the real one
         import mmlspark_tpu.ops.pallas_histogram as ph
