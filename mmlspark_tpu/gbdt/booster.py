@@ -583,12 +583,27 @@ class Booster:
 
     @classmethod
     def load_native_model(cls, path: str) -> "Booster":
-        # binary read + replacing decode: a bit-flip that breaks UTF-8
-        # must surface as the digest verdict (ModelDigestError), not a
-        # UnicodeDecodeError from the file read — the replacement
-        # characters change the body, so the digest check catches it
         with open(path, "rb") as f:
-            text = f.read().decode("utf-8", errors="replace")
+            raw = f.read()
+        try:
+            text = raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            # a digest-stamped file may decode with replacement
+            # characters: they alter the body, so the digest check
+            # below rejects the file with the right verdict
+            # (ModelDigestError, not UnicodeDecodeError).  A
+            # digest-less legacy file has no such net — replacement
+            # characters would be silently PARSED — so refuse it
+            # outright instead of accepting mangled bytes.
+            head = raw[:len(DIGEST_HEADER) + 16]
+            if raw.startswith(DIGEST_HEADER.encode("utf-8")) \
+                    or b".digest.sha256=" in head:
+                text = raw.decode("utf-8", errors="replace")
+            else:
+                raise ModelDigestError(
+                    f"native model file {path!r} is not valid UTF-8 "
+                    "and carries no digest header; the file is torn "
+                    "or binary-corrupted — refusing to load") from e
         return cls.load_native_model_string(text)
 
 

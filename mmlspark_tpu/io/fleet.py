@@ -436,9 +436,12 @@ class PredictorFleet:
         # and stamps it into the rid, so a cutover mid-fan-out can
         # never mix tree-range shards from two models in one reduce
         self._active_version = 0
+        # "path" (set in start() / load_version) is what a respawned
+        # worker reloads — kept per version so _worker_spec always
+        # hands out the active model's file, not the original one
         self._version_meta: Dict[int, Dict[str, Any]] = {
             0: {"ranges": list(self.ranges), "K": self._K,
-                "init_score": self._init_score}}
+                "init_score": self._init_score, "path": None}}
         #: (op, version) -> {"event", "acked": set, "failed": dict}
         self._ctrl_waiters: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._seq = itertools.count()
@@ -474,16 +477,33 @@ class PredictorFleet:
 
     # ---- lifecycle ----
 
+    def _worker_spec(self, shard: int) -> Tuple[Optional[str], int,
+                                                int, int]:
+        """The ``(model_path, lo, hi, version)`` a (re)spawned worker
+        for ``shard`` must come up with: always the ACTIVE version's
+        file and tree range.  After a cutover ``self._model_path``
+        still names the version-0 model while ``self.ranges`` describes
+        the new one — a respawn mixing the two would load the wrong
+        forest, hold only version 0, and fail every ``vN|…`` request
+        until the next cutover."""
+        with self._lock:
+            ver = self._active_version
+            meta = self._version_meta[ver]
+            lo, hi = meta["ranges"][shard]
+            path = meta.get("path") or self._model_path
+        return path, lo, hi, ver
+
     def _spawn_proc(self, shard: int):
         import multiprocessing as mp
         ctx = mp.get_context("spawn")
         dh, dp = self._ts.address
-        lo, hi = self.ranges[shard]
+        path, lo, hi, ver = self._worker_spec(shard)
         p = ctx.Process(
             target=_fleet_worker_main,
-            args=(dh, dp, shard, self._model_path, lo, hi,
+            args=(dh, dp, shard, path, lo, hi,
                   self._backend, self.token,
                   self.routing == "replica"),
+            kwargs={"version": ver},
             daemon=True)
         p.start()
         return p
@@ -495,6 +515,8 @@ class PredictorFleet:
                 suffix=".lgbm.txt", prefix="fleet_model_")
             os.close(fd)
             self._booster.save_native_model(self._model_path)
+            with self._lock:
+                self._version_meta[0]["path"] = self._model_path
             self._procs = [self._spawn_proc(s)
                            for s in range(self.num_shards)]
         else:
@@ -742,7 +764,9 @@ class PredictorFleet:
         ``version``, each shard building its predictor for the NEW
         model's tree ranges.  Blocks until all shards acked the load;
         any shard's failure (digest mismatch included) aborts with the
-        fleet still serving the old version everywhere."""
+        fleet still serving the old version everywhere.  ``model_path``
+        must stay readable for as long as the version serves: the
+        supervisor reloads it when it respawns a crashed worker."""
         from ..gbdt.booster import Booster
         timeout = self._join_timeout if timeout is None else timeout
         # driver-side load verifies the digest once more and yields
@@ -771,7 +795,8 @@ class PredictorFleet:
         with self._lock:
             self._version_meta[version] = {
                 "ranges": ranges, "K": K,
-                "init_score": float(b.init_score)}
+                "init_score": float(b.init_score),
+                "path": model_path}
         return version
 
     def activate_version(self, version: int,

@@ -261,7 +261,10 @@ class ModelRegistry:
     def mark(self, version: int, state: str) -> None:
         """Record a state transition (``rolled_back`` after a failed
         canary, ``quarantined`` after a digest mismatch).  Demoting the
-        active entry clears the active pointer."""
+        active entry clears the active pointer.  ``quarantined`` is
+        terminal: it records proven on-disk corruption, and overwriting
+        it (e.g. with ``rolled_back``) would make the entry eligible
+        for re-activation — transitions out of it raise instead."""
         if state not in STATES:
             raise RegistryError(f"unknown promoted_state {state!r}")
         with self._lock:
@@ -269,6 +272,12 @@ class ModelRegistry:
             if e is None:
                 raise RegistryError(
                     f"registry has no version {version}")
+            if e["promoted_state"] == "quarantined":
+                if state == "quarantined":
+                    return          # idempotent re-quarantine
+                raise RegistryError(
+                    f"version {version} is quarantined (digest "
+                    f"mismatch); refusing to mark it {state!r}")
             e["promoted_state"] = state
             if self._manifest.get("active") == int(version) \
                     and state != "active":
@@ -321,15 +330,18 @@ class ModelRegistry:
         """The version's model text, digest-verified.  A mismatch
         quarantines the entry (one atomic manifest commit) and raises
         :class:`ModelCorruption` — a torn or bit-flipped model file is
-        REJECTED at load, never served."""
+        REJECTED at load, never served.  An :class:`OSError` (EMFILE,
+        an NFS blip, a permission hiccup) raises WITHOUT a state
+        transition: only the bytes themselves hashing wrong proves
+        corruption, and a transient read failure must not permanently
+        strand a healthy version in quarantine."""
         e = self.entry(version)
         path = self.model_path(version)
         try:
             with open(path, "rb") as fh:
                 data = fh.read()
         except OSError as ex:
-            self.quarantine(version)
-            raise ModelCorruption(
+            raise RegistryError(
                 f"model file for version {version} unreadable: "
                 f"{ex}") from ex
         want = e["digest"].split(":", 1)[-1]

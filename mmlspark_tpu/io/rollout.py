@@ -235,6 +235,13 @@ class RolloutController:
         self._lock = threading.Lock()
         self._boosters: Dict[str, Any] = {}   # arm -> live Booster
         self._soak_started: Optional[float] = None
+        # counters are process-cumulative; the gate and the journal
+        # must report THIS rollout's traffic, so start_canary snapshots
+        # a zero-point and everything gates on the delta — otherwise a
+        # canary that saw no traffic inherits the previous rollout's
+        # rows and sails through min_canary_rows
+        self._canary_rows0 = 0
+        self._canary_errors0 = 0
         self._monitor: Optional[SLOMonitor] = None
         self._holdout: Optional[np.ndarray] = None
         self._holdout_ref: Optional[np.ndarray] = None
@@ -434,6 +441,8 @@ class RolloutController:
                                old.baseline_info,
                                self._info_for(version))
             self._soak_started = time.monotonic()
+            self._canary_rows0 = self.stats.counter("canary_rows")
+            self._canary_errors0 = self.stats.counter("canary_errors")
             # fresh per-rollout gate: burn windows must not inherit a
             # previous canary's errors
             self._monitor = SLOMonitor(
@@ -496,9 +505,10 @@ class RolloutController:
             self._monitor = None
             self._holdout_ref = None
             self.stats.incr("promotions")
+            rows = (self.stats.counter("canary_rows")
+                    - self._canary_rows0)
         self._journal.emit("rollout_promoted", version=version,
-                           canary_rows=self.stats.counter(
-                               "canary_rows"))
+                           canary_rows=rows)
         self._retire(old, retired_booster)   # the superseded baseline
         return version
 
@@ -523,9 +533,12 @@ class RolloutController:
             self._monitor = None
             self._holdout_ref = None
             self.stats.incr("rollbacks")
+            rows = (self.stats.counter("canary_rows")
+                    - self._canary_rows0)
+            errors = (self.stats.counter("canary_errors")
+                      - self._canary_errors0)
         ev = {"version": version, "reason": reason,
-              "canary_rows": self.stats.counter("canary_rows"),
-              "canary_errors": self.stats.counter("canary_errors")}
+              "canary_rows": rows, "canary_errors": errors}
         if detail:
             ev["slo"] = detail
         self._journal.emit("rollout_rolled_back", **ev)
@@ -557,6 +570,7 @@ class RolloutController:
             arms = self._arms
             monitor = self._monitor
             soak_started = self._soak_started
+            rows0 = self._canary_rows0
         if arms.canary is None or monitor is None:
             return "steady"
         self._gauge_holdout_drift(arms)
@@ -571,7 +585,7 @@ class RolloutController:
         soaked = (soak_started is not None
                   and time.monotonic() - soak_started
                   >= self.cfg.soak_s)
-        if soaked and (self.stats.counter("canary_rows")
+        if soaked and (self.stats.counter("canary_rows") - rows0
                        >= self.cfg.min_canary_rows):
             self.promote()
             return "promoted"

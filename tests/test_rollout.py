@@ -131,6 +131,39 @@ class TestRegistry:
         # the healthy active version still loads
         assert reg.load(v1) is not None
 
+    def test_transient_read_failure_does_not_quarantine(
+            self, tmp_path, models):
+        """An OSError reading the model file (EMFILE, NFS blip) is NOT
+        corruption: the load fails loudly but the entry keeps its
+        state, so the version is servable again once I/O recovers."""
+        reg, v1, v2 = make_registry(tmp_path, models)
+        path = reg.model_path(v2)
+        with open(path, "rb") as fh:
+            saved = fh.read()
+        os.unlink(path)
+        with pytest.raises(RegistryError) as ei:
+            reg.load(v2)
+        assert not isinstance(ei.value, ModelCorruption)
+        assert reg.entry(v2)["promoted_state"] == "candidate"
+        # I/O recovers → the same version loads with no ceremony
+        with open(path, "wb") as fh:
+            fh.write(saved)
+        assert reg.load(v2) is not None
+        assert reg.activate(v2) == v2
+
+    def test_quarantine_is_terminal(self, tmp_path, models):
+        """The quarantine marker records proven corruption; a later
+        rollback/retire mark must not overwrite it (that would make
+        the entry activatable again)."""
+        reg, v1, v2 = make_registry(tmp_path, models)
+        reg.quarantine(v2)
+        reg.quarantine(v2)              # idempotent
+        with pytest.raises(RegistryError):
+            reg.mark(v2, "rolled_back")
+        assert reg.entry(v2)["promoted_state"] == "quarantined"
+        with pytest.raises(RegistryError):
+            reg.activate(v2)
+
     def test_activate_retires_and_rollback_restores(self, tmp_path,
                                                     models):
         reg, v1, v2 = make_registry(tmp_path, models)
@@ -215,6 +248,32 @@ class TestBoosterDigest:
             fh.write(models["t1"])
         b = Booster.load_native_model(path)
         assert len(b.trees) > 0
+
+    def test_digestless_non_utf8_refused(self, tmp_path, models):
+        """A digest-less legacy file with bytes that are not UTF-8 has
+        no digest to catch a replacing decode — it must be refused with
+        a clear error, never parsed with replacement characters."""
+        path = str(tmp_path / "legacy.txt")
+        raw = models["t1"].encode("utf-8")
+        with open(path, "wb") as fh:
+            fh.write(raw[:200] + b"\xff\xfe\xff" + raw[200:])
+        with pytest.raises(ModelDigestError, match="not valid UTF-8"):
+            Booster.load_native_model(path)
+
+    def test_stamped_non_utf8_rejected_by_digest(self, tmp_path,
+                                                 models):
+        """The same corruption under a digest header surfaces as the
+        digest verdict: the replacing decode alters the body and the
+        embedded hash no longer matches."""
+        b = self._booster(models)
+        path = str(tmp_path / "m.txt")
+        b.save_native_model(path)
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        with open(path, "wb") as fh:
+            fh.write(raw[:200] + b"\xff\xfe\xff" + raw[200:])
+        with pytest.raises(ModelDigestError, match="digest"):
+            Booster.load_native_model(path)
 
     def test_with_digest_header_idempotent(self, models):
         once = with_digest_header(models["t1"])
@@ -413,6 +472,45 @@ class TestPromoteRollback:
                                     - models["w1"][:64])))
         assert drift == pytest.approx(want, rel=1e-5)
 
+    def test_min_canary_rows_fresh_per_rollout(self, tmp_path, models):
+        """The promotion gate must count THIS rollout's canary rows:
+        a second canary that saw zero traffic must keep soaking even
+        though the cumulative counter already passed the bar in the
+        first rollout."""
+        reg = ModelRegistry(str(tmp_path / "registry"))
+        reg.publish(models["t1"], activate=True)
+        v2 = reg.publish(models["t2"])
+        v3 = reg.publish(models["t2"])
+        ctl = RolloutController(reg, config=RolloutConfig(
+            canary_fraction=1.0, soak_s=0.0, min_canary_rows=50,
+            canary_deadline_ms=None, retire_grace_s=0.5))
+        X = models["X"]
+        ctl.start_canary(v2)
+        ctl.score_routed(X[:64], [f"r{i}" for i in range(64)])
+        assert ctl.stats.counter("canary_rows") >= 50
+        assert ctl.tick() == "promoted"
+        # rollout 2: zero rows scored so far — the cumulative counter
+        # (still >= 50) must NOT satisfy the gate
+        ctl.start_canary(v3)
+        assert ctl.tick() == "soaking"
+        ctl.score_routed(X[:64], [f"s{i}" for i in range(64)])
+        assert ctl.tick() == "promoted"
+        assert reg.active_version() == v3
+
+    def test_rollback_preserves_quarantine_marker(self, tmp_path,
+                                                  models):
+        """A canary whose registry entry was quarantined mid-flight
+        (digest mismatch on another loader) still rolls back cleanly,
+        and the rollback must NOT overwrite the quarantine marker."""
+        reg, ctl, srv, eng, v2 = self._engine_stack(tmp_path, models)
+        ctl.start_canary(v2)
+        reg.quarantine(v2)
+        ctl.rollback(reason="manual")
+        assert ctl.state() == "steady"
+        assert reg.entry(v2)["promoted_state"] == "quarantined"
+        with pytest.raises(RegistryError):
+            reg.activate(v2)
+
     def test_rollback_requires_canary(self, tmp_path, models):
         reg, ctl, srv, eng, v2 = self._engine_stack(tmp_path, models)
         with pytest.raises(RegistryError):
@@ -547,6 +645,34 @@ class TestFleetVersionCutover:
                     "reduce mixed tree-range shards across versions"
         finally:
             stop.set()
+            fleet.stop()
+
+    def test_respawn_spec_tracks_active_version(self, tmp_path,
+                                                models):
+        """The supervisor respawns a crashed worker from
+        ``_worker_spec``: after a cutover it must hand out the ACTIVE
+        version's model path, tree range and version number — a
+        version-0 respawn against the new ranges would fail every
+        ``vN|…`` request until the next cutover."""
+        from mmlspark_tpu.io.fleet import PredictorFleet
+        b1 = Booster.load_native_model_string(models["t1"])
+        b2 = Booster.load_native_model_string(models["t2"])
+        path = str(tmp_path / "v2.txt")
+        b2.save_native_model(path)
+        fleet = PredictorFleet(b1, num_shards=2, spawn=False).start()
+        try:
+            assert [fleet._worker_spec(s)[3] for s in range(2)] \
+                == [0, 0]
+            v = fleet.load_version(path)
+            # staged but not yet active: a respawn still serves v0
+            assert fleet._worker_spec(0)[3] == 0
+            fleet.activate_version(v)
+            for s in range(2):
+                mpath, lo, hi, ver = fleet._worker_spec(s)
+                assert ver == v
+                assert mpath == path
+                assert (lo, hi) == tuple(fleet.ranges[s])
+        finally:
             fleet.stop()
 
     def test_load_failure_aborts_cutover(self, tmp_path, models):
