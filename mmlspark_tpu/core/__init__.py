@@ -11,6 +11,11 @@ from .utils import ClusterUtil, FaultToleranceUtils, StopWatch
 from .telemetry import (MetricsRegistry, EventJournal, get_registry,
                         get_journal, new_trace_id, render_prometheus,
                         merge_snapshots, read_journal)
+from .sketch import (StreamSketch, MatrixSketch, ReferenceProfile,
+                     build_reference_profile, merge_sketch_snapshots,
+                     psi, js_divergence)
+from .drift import (DriftConfig, DriftMonitor, set_drift_monitor,
+                    peek_drift_monitor, drift_report_from_counters)
 
 __all__ = [
     "Param", "Params", "TypeConverters", "HasInputCol", "HasOutputCol",
@@ -26,4 +31,9 @@ __all__ = [
     "MetricsRegistry", "EventJournal", "get_registry", "get_journal",
     "new_trace_id", "render_prometheus", "merge_snapshots",
     "read_journal",
+    "StreamSketch", "MatrixSketch", "ReferenceProfile",
+    "build_reference_profile", "merge_sketch_snapshots",
+    "psi", "js_divergence",
+    "DriftConfig", "DriftMonitor", "set_drift_monitor",
+    "peek_drift_monitor", "drift_report_from_counters",
 ]

@@ -108,6 +108,22 @@ def default_objectives() -> Tuple[SLObjective, ...]:
             "fraction of time the worst peer heartbeat stays fresh",
             gauge=("elastic", "heartbeat_age_ms"), threshold=2000.0),
         SLObjective(
+            "feature_drift", 0.99,
+            "worst per-feature PSI (live traffic vs the fit-time "
+            "reference profile) staying under the drift threshold "
+            "(core/drift.py publishes the gauge under ns='drift'; "
+            "silent until a drift monitor is installed).  The "
+            "threshold MATCHES DriftConfig.psi_threshold's default — "
+            "the burn gate and the instantaneous alert gauge must "
+            "agree on what 'drifted' means",
+            gauge=("drift", "psi_worst"), threshold=0.25),
+        SLObjective(
+            "prediction_drift", 0.99,
+            "prediction-margin PSI (live scoring output vs the "
+            "fit-time training-margin sketch) staying under the "
+            "drift threshold (silent until a drift monitor runs)",
+            gauge=("drift", "psi_prediction"), threshold=0.25),
+        SLObjective(
             "perf_latency_budget", 0.99,
             "perf-sentinel worst stage-vs-baseline ratio staying "
             "inside the latency budget (tools/perf_sentinel.py "

@@ -213,6 +213,12 @@ class Booster:
         # CompiledPredictor captures the token at build time and refuses
         # to score a forest that changed under it
         self._cache_token = 0
+        # fit-time data-quality baseline (ISSUE 15): the engine attaches
+        # a core.sketch.ReferenceProfile after training; the registry
+        # persists it beside the model and drift monitors compare live
+        # traffic against it.  None for loaded/extended models whose
+        # profile wasn't captured — drift monitoring is simply off then.
+        self.reference_profile = None
 
     def extended(self, continuation: "Booster") -> "Booster":
         """The merged model of continued training (LightGBM's

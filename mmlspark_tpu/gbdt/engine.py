@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -271,7 +272,7 @@ _CKPT_MESH_STATE = _CKPT_MESH_PREFIX + "{:06d}.npz"
 #: chaos drill snapshot before/after a fit and assert deltas.
 train_stats = StageStats()
 for _k in ("chunks_replayed", "ckpt_saved", "ckpt_resumed",
-           "ckpt_discarded", "boost_chunks"):
+           "ckpt_discarded", "boost_chunks", "ref_profiles"):
     train_stats.incr(_k, 0)
 del _k
 # federate under the process registry: a serving process that also
@@ -1264,6 +1265,81 @@ def _efb_dev_from_host(efb_host):
         default_of=jnp.asarray(efb_host[5]))
 
 
+#: set to "0" to skip fit-time reference-profile capture (ISSUE 15) —
+#: e.g. a bench run that fits thousands of throwaway models
+REF_PROFILE_ENV = "MMLSPARK_TPU_REF_PROFILE"
+
+#: rows fed to the margin sketch's representative-predict pass; the
+#: per-feature sketches always count the FULL binned matrix (bincount
+#: is cheap), only the margin baseline subsamples
+_REF_PROFILE_MARGIN_ROWS = 32768
+
+
+def _bin_representatives(mapper: BinMapper) -> List[np.ndarray]:
+    """Per-feature lookup ``fine bin index -> representative raw
+    value``.  Tree thresholds are bin upper bounds, so every raw value
+    in fine bin ``b`` falls on the same side of every split as the
+    bound ``ub[b]`` — predicting on the representatives routes to
+    EXACTLY the leaves the true raw rows would (missing bin → NaN,
+    which the forest walk routes via default direction; categorical
+    bins → their raw category value)."""
+    reps: List[np.ndarray] = []
+    for j in range(mapper.num_features):
+        rep = np.full(mapper.num_total_bins, np.nan, np.float64)
+        if mapper.is_categorical(j):
+            vals = mapper.cat_values[j]
+            rep[:len(vals)] = vals.astype(np.float64)
+        else:
+            ub = mapper.upper_bounds[j]
+            if len(ub):
+                rep[:len(ub)] = ub
+                rep[len(ub)] = ub[-1] + max(1.0, abs(float(ub[-1])))
+            else:
+                rep[0] = 0.0
+        reps.append(rep)
+    return reps
+
+
+def _capture_reference_profile(booster: Booster, bins, mapper,
+                               feature_names) -> None:
+    """Attach the fit-time data-quality baseline (ISSUE 15): per-feature
+    sketches over the full binned training matrix plus a
+    prediction-margin sketch from a bin-representative predict pass.
+    Advisory — a capture failure logs and leaves
+    ``booster.reference_profile`` None (drift monitoring off), it never
+    fails the fit."""
+    if os.environ.get(REF_PROFILE_ENV, "1") == "0" or mapper is None:
+        return
+    try:
+        from ..core.sketch import build_reference_profile
+        if isinstance(bins, (list, tuple)):
+            bins = np.concatenate([np.asarray(b) for b in bins], axis=0)
+        bins = np.asarray(bins)
+        if bins.ndim != 2 or bins.shape[1] != mapper.num_features:
+            return
+        sample = bins
+        if sample.shape[0] > _REF_PROFILE_MARGIN_ROWS:
+            idx = np.random.default_rng(0).choice(
+                sample.shape[0], size=_REF_PROFILE_MARGIN_ROWS,
+                replace=False)
+            idx.sort()
+            sample = sample[idx]
+        reps = _bin_representatives(mapper)
+        Xr = np.empty(sample.shape, np.float32)
+        for j, rep in enumerate(reps):
+            Xr[:, j] = rep[sample[:, j].astype(np.int64)]
+        margins = np.asarray(booster.predict_margin(Xr))
+        booster.reference_profile = build_reference_profile(
+            bins, mapper, margins, feature_names=feature_names,
+            meta={"trees": len(booster.trees),
+                  "num_class": booster.num_class,
+                  "fit_span": _tm.current_fit_span()})
+        train_stats.incr("ref_profiles")
+    except Exception:  # noqa: BLE001 - the profile is advisory
+        log.exception("reference-profile capture failed; drift "
+                      "monitoring will be unavailable for this model")
+
+
 def train(*args, **kwargs) -> Booster:
     """Train a forest — the public entrypoint (see :func:`_train_impl`
     for the full parameter contract).
@@ -1298,6 +1374,12 @@ def train(*args, **kwargs) -> Booster:
                               {"fit": span, "error": repr(e)})
         _tm.set_current_fit_span(None)
         raise
+    def _arg(i: int, name: str):
+        return args[i] if len(args) > i else kwargs.get(name)
+
+    _capture_reference_profile(booster, _arg(0, "bins"),
+                               _arg(3, "mapper"),
+                               _arg(6, "feature_names"))
     _tm.get_journal().emit(
         "fit_end", fit=span,
         dur_s=round(time.perf_counter() - t0, 3),

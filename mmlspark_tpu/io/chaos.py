@@ -65,9 +65,9 @@ from .transport import T_ACK as _T_ACK
 
 __all__ = [
     "ChaosBoostStep", "ChaosChannel", "ChaosControllerKill",
-    "ChaosHeartbeat", "ChaosPlan", "ChaosPredictor", "ChaosQueue",
-    "ChaosSocket", "ChaosTransport", "WorkerKilled", "corrupt_file",
-    "kill_process", "read_ckpt_boundary",
+    "ChaosDrift", "ChaosHeartbeat", "ChaosPlan", "ChaosPredictor",
+    "ChaosQueue", "ChaosSocket", "ChaosTransport", "WorkerKilled",
+    "corrupt_file", "kill_process", "read_ckpt_boundary",
 ]
 
 
@@ -384,6 +384,71 @@ class ChaosTransport:
 
     def __getattr__(self, attr):
         return getattr(self._sock, attr)
+
+
+class ChaosDrift:
+    """Seeded mid-traffic data-drift injector (ISSUE 15): perturb ONE
+    feature column of the request stream once a configured number of
+    rows has flowed — the upstream-pipeline-change / sensor-failure
+    event the drift monitor must detect.
+
+    Wrap the drill's payload generator (or a feature matrix producer):
+    ``drift(X)`` returns ``X`` untouched for the first ``after_rows``
+    rows of cumulative traffic, then applies, to rows past that
+    boundary (the cut can land mid-batch):
+
+    * ``scale``/``shift`` — ``x → x * scale + shift`` (a recalibrated
+      or re-unit'd upstream feature);
+    * ``nan_rate`` — per-row Bernoulli NaN injection drawn from the
+      plan's channel (the "feature went silently null" storm).
+
+    Deterministic like every injector: the NaN decision sequence is a
+    pure function of ``(seed, name)`` and the row index.  Counters:
+    ``rows_seen`` / ``rows_injected`` / ``nans_injected`` — the drill's
+    injection ledger.  The input is never mutated in place (clients
+    may reuse their row buffers)."""
+
+    def __init__(self, plan: ChaosPlan, *, feature: int,
+                 shift: float = 0.0, scale: float = 1.0,
+                 nan_rate: float = 0.0, after_rows: int = 0,
+                 name: str = "drift"):
+        self.feature = int(feature)
+        self.shift = float(shift)
+        self.scale = float(scale)
+        self.nan_rate = float(nan_rate)
+        self.after_rows = int(after_rows)
+        self._chan = plan.channel(name)
+        self._lock = threading.Lock()
+        self.rows_seen = 0
+        self.rows_injected = 0
+        self.nans_injected = 0
+
+    def __call__(self, X):
+        import numpy as np
+        X = np.asarray(X)
+        squeeze = X.ndim == 1
+        if squeeze:
+            X = X[None, :]
+        n = X.shape[0]
+        with self._lock:
+            start = self.rows_seen
+            self.rows_seen += n
+        k0 = max(0, self.after_rows - start)
+        if k0 >= n:
+            return X[0] if squeeze else X
+        X = X.astype(np.float32, copy=True)
+        col = X[k0:, self.feature] * self.scale + self.shift
+        if self.nan_rate > 0:
+            mask = np.fromiter(
+                (self._chan.fire(self.nan_rate)
+                 for _ in range(n - k0)), bool, count=n - k0)
+            col[mask] = np.nan
+            with self._lock:
+                self.nans_injected += int(mask.sum())
+        X[k0:, self.feature] = col
+        with self._lock:
+            self.rows_injected += n - k0
+        return X[0] if squeeze else X
 
 
 def kill_process(proc_or_pid) -> int:

@@ -470,6 +470,20 @@ class PredictorFleet:
         prof.alias("fleet.fanout", self._pt_fanout)
         prof.alias("fleet.wait", self._pt_wait)
         prof.alias("fleet.reduce", self._pt_reduce)
+        # data-quality tap (ISSUE 15): attach_drift() installs a
+        # DriftMonitor; score() then sketches every request's feature
+        # block + reduced margins at the fan-out point
+        self._drift = None
+
+    def attach_drift(self, monitor) -> "PredictorFleet":
+        """Attach a :class:`~mmlspark_tpu.core.drift.DriftMonitor`
+        (built from the served model's reference profile) and install
+        it process-wide so the drift SLO objectives and the
+        ``mmlspark_tpu_drift_*`` families read it."""
+        from ..core.drift import set_drift_monitor
+        self._drift = monitor
+        set_drift_monitor(monitor)
+        return self
 
     @property
     def mode(self) -> str:
@@ -926,4 +940,11 @@ class PredictorFleet:
         req_s = time.perf_counter() - t0
         self._rtt.record(req_s)
         prof.span("fleet.request", req_s, tid=rid, record=False)
-        return out[:, 0] if K == 1 else out
+        out = out[:, 0] if K == 1 else out
+        if self._drift is not None:
+            # fleet topology's drift tap (ISSUE 15): the driver is the
+            # one process that sees every request's full feature block
+            # AND the reduced margin — sketching here covers all
+            # shards/replicas with one monitor (duty-gated inside)
+            self._drift.observe(X, out)
+        return out

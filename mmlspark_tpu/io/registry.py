@@ -47,10 +47,13 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 __all__ = ["ModelCorruption", "ModelRegistry", "RegistryError"]
 
@@ -191,10 +194,18 @@ class ModelRegistry:
     def model_path(self, version: int) -> str:
         return os.path.join(self._models, f"v{int(version):06d}.txt")
 
+    def profile_path(self, version: int) -> str:
+        """The version's fit-time reference-profile file (ISSUE 15) —
+        lives beside the model file, written and verified with the
+        identical tmp+fsync+rename+digest discipline."""
+        return os.path.join(self._models,
+                            f"v{int(version):06d}.profile.json")
+
     # -- writes --------------------------------------------------------------
 
     def publish(self, model, *, activate: bool = False,
-                meta: Optional[Dict[str, Any]] = None) -> int:
+                meta: Optional[Dict[str, Any]] = None,
+                profile=None) -> int:
         """Store a model (a :class:`~mmlspark_tpu.gbdt.booster.Booster`
         or a native-model text string) as the next version.  The model
         file becomes durable BEFORE the manifest names it; a crash
@@ -202,11 +213,25 @@ class ModelRegistry:
         dangling entry.  ``activate=True`` additionally promotes the
         new entry in the same manifest commit (the bootstrap path — a
         canaried rollout publishes a candidate and lets the gate
-        promote it)."""
+        promote it).
+
+        ``profile`` (ISSUE 15): the fit-time
+        :class:`~mmlspark_tpu.core.sketch.ReferenceProfile` (or its
+        JSON text) persisted beside the model under the same
+        digest-verified atomic-rename discipline; defaults to the
+        booster's own ``reference_profile`` when the engine captured
+        one.  The profile file becomes durable before the manifest
+        names it, exactly like the model file."""
         text = model if isinstance(model, str) \
             else model.save_native_model_string()
         if not text:
             raise RegistryError("refusing to publish an empty model")
+        if profile is None:
+            profile = getattr(model, "reference_profile", None)
+        profile_text = None
+        if profile is not None:
+            profile_text = profile if isinstance(profile, str) \
+                else profile.to_json()
         # embed the booster-level digest header too, so the file is
         # self-verifying even when read outside the registry
         from ..gbdt.booster import with_digest_header
@@ -223,6 +248,11 @@ class ModelRegistry:
                 "promoted_state": "candidate",
                 "size_bytes": len(payload),
             }
+            if profile_text is not None:
+                pbytes = profile_text.encode("utf-8")
+                _atomic_write(self.profile_path(version), pbytes)
+                entry["profile_digest"] = \
+                    f"sha256:{sha256_hex(pbytes)}"
             if meta:
                 entry["meta"] = dict(meta)
             self._manifest["entries"][str(version)] = entry
@@ -354,14 +384,56 @@ class ModelRegistry:
                 "entry quarantined")
         return data.decode("utf-8")
 
+    def load_profile(self, version: int):
+        """The version's fit-time
+        :class:`~mmlspark_tpu.core.sketch.ReferenceProfile`,
+        digest-verified, or ``None`` with a warning for entries that
+        never recorded one (digest-less legacy publishes, fits with
+        capture disabled) — drift monitoring is simply off for that
+        version, never an error.  A recorded digest that no longer
+        matches the bytes is the SAME corruption contract the model
+        file has: the entry is quarantined and
+        :class:`ModelCorruption` raises; a transient read failure
+        raises :class:`RegistryError` without a state transition."""
+        e = self.entry(int(version))
+        want = e.get("profile_digest")
+        if want is None:
+            log.warning(
+                "registry version %s has no reference profile "
+                "(legacy/profile-less entry); drift monitoring is off "
+                "for this version", version)
+            return None
+        path = self.profile_path(int(version))
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError as ex:
+            raise RegistryError(
+                f"reference profile for version {version} unreadable: "
+                f"{ex}") from ex
+        got = sha256_hex(data)
+        if got != want.split(":", 1)[-1]:
+            self.quarantine(int(version))
+            raise ModelCorruption(
+                f"reference profile for version {version} fails its "
+                f"digest (want {want[:19]}…, got sha256:{got[:12]}…); "
+                "entry quarantined")
+        from ..core.sketch import ReferenceProfile
+        return ReferenceProfile.from_json(data.decode("utf-8"))
+
     def load(self, version: Optional[int] = None):
         """Load a :class:`~mmlspark_tpu.gbdt.booster.Booster`
         (``version=None`` loads the active entry).  Both digests — the
-        registry's and the file's embedded header — are verified."""
+        registry's and the file's embedded header — are verified, and
+        the version's reference profile (when recorded) is attached as
+        ``booster.reference_profile`` so a drift monitor can be built
+        straight off the loaded model."""
         from ..gbdt.booster import Booster
         if version is None:
             version = self.active_version()
             if version is None:
                 raise RegistryError("registry has no active version")
         text = self.read_text(int(version))
-        return Booster.load_native_model_string(text)
+        booster = Booster.load_native_model_string(text)
+        booster.reference_profile = self.load_profile(int(version))
+        return booster

@@ -101,6 +101,16 @@ class RolloutConfig:
     #: on the registered holdout); None disables the objective
     holdout_drift_threshold: Optional[float] = None
     holdout_target: float = 0.99
+    #: LIVE-traffic drift gate (ISSUE 15): worst per-feature /
+    #: prediction PSI from the attached
+    #: :class:`~mmlspark_tpu.core.drift.DriftMonitor` staying under
+    #: this while the canary soaks; None disables (and without
+    #: :meth:`RolloutController.attach_drift` the objectives are never
+    #: declared).  Unlike the holdout gauge this watches the traffic
+    #: actually hitting the rollout, so a canary promoted INTO a
+    #: drifting feed is caught even when the model itself is healthy.
+    live_drift_threshold: Optional[float] = 0.25
+    live_drift_target: float = 0.99
     #: background gate cadence (:meth:`RolloutController.start`)
     tick_s: float = 0.5
     #: how long promote/rollback waits for in-flight pinned batches
@@ -109,9 +119,11 @@ class RolloutConfig:
 
 
 def rollout_objectives(cfg: RolloutConfig,
-                       holdout: bool = False) -> List[SLObjective]:
+                       holdout: bool = False,
+                       live_drift: bool = False) -> List[SLObjective]:
     """The canary gate's objectives, reading the ``rollout``
-    namespace's counters."""
+    namespace's counters (plus, with ``live_drift``, the attached
+    drift monitor's ``ns="drift"`` gauges)."""
     objs = [
         SLObjective(
             "canary_error_ratio", cfg.error_target,
@@ -135,6 +147,20 @@ def rollout_objectives(cfg: RolloutConfig,
             "under the drift threshold",
             gauge=("rollout", "canary_holdout_drift"),
             threshold=float(cfg.holdout_drift_threshold)))
+    if live_drift and cfg.live_drift_threshold is not None:
+        objs.append(SLObjective(
+            "canary_live_drift", cfg.live_drift_target,
+            "worst per-feature PSI on LIVE traffic (attached drift "
+            "monitor vs the fit-time reference profile) staying under "
+            "the rollout drift threshold",
+            gauge=("drift", "psi_worst"),
+            threshold=float(cfg.live_drift_threshold)))
+        objs.append(SLObjective(
+            "canary_prediction_drift", cfg.live_drift_target,
+            "prediction-margin PSI on live traffic staying under the "
+            "rollout drift threshold",
+            gauge=("drift", "psi_prediction"),
+            threshold=float(cfg.live_drift_threshold)))
     return objs
 
 
@@ -245,6 +271,9 @@ class RolloutController:
         self._monitor: Optional[SLOMonitor] = None
         self._holdout: Optional[np.ndarray] = None
         self._holdout_ref: Optional[np.ndarray] = None
+        #: live-traffic drift gate (ISSUE 15): attach_drift() installs
+        #: a DriftMonitor; canaries then gate on its PSI gauges too
+        self._drift = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: chaos/test seam: wraps the canary predictor at
@@ -308,6 +337,20 @@ class RolloutController:
         X = np.ascontiguousarray(np.asarray(X, np.float32))
         self._holdout = X
         self._holdout_ref = None      # recomputed against current arms
+
+    def attach_drift(self, monitor) -> "RolloutController":
+        """Attach a :class:`~mmlspark_tpu.core.drift.DriftMonitor`
+        (ISSUE 15): it is installed process-wide (``ns="drift"`` +
+        exposition) and every canary's private gate gains the
+        live-traffic drift objectives (``canary_live_drift`` /
+        ``canary_prediction_drift``) next to the holdout gauge — a
+        canary soaking while the input or prediction distribution
+        shifts past ``cfg.live_drift_threshold`` is auto-rolled-back
+        by the same burn machinery as an erroring one."""
+        from ..core.drift import set_drift_monitor
+        self._drift = monitor
+        set_drift_monitor(monitor)
+        return self
 
     # -- routing -------------------------------------------------------------
 
@@ -447,7 +490,8 @@ class RolloutController:
             # previous canary's errors
             self._monitor = SLOMonitor(
                 rollout_objectives(
-                    self.cfg, holdout=self._holdout is not None),
+                    self.cfg, holdout=self._holdout is not None,
+                    live_drift=self._drift is not None),
                 fast_window_s=self.cfg.fast_window_s,
                 slow_window_s=self.cfg.slow_window_s,
                 fast_burn_threshold=self.cfg.fast_burn_threshold,
@@ -574,6 +618,10 @@ class RolloutController:
         if arms.canary is None or monitor is None:
             return "steady"
         self._gauge_holdout_drift(arms)
+        if self._drift is not None:
+            # refresh the live PSI gauges before the gate samples them
+            # (rate-limited inside by DriftConfig.eval_interval_s)
+            self._drift.evaluate()
         monitor.sample()
         verdicts = monitor.evaluate()
         breaching = sorted(n for n, v in verdicts.items()

@@ -286,7 +286,8 @@ class ScoringEngine:
                  shed_wait_ms: Optional[float] = None,
                  deadline_ms: Optional[float] = None,
                  supervise: bool = True,
-                 stats: Optional[StageStats] = None):
+                 stats: Optional[StageStats] = None,
+                 drift_monitor=None):
         if (predictor is None) == (transform is None):
             raise ValueError(
                 "pass exactly one of predictor= (hot path) or "
@@ -345,6 +346,14 @@ class ScoringEngine:
         self._deadline = (None if deadline_ms is None
                           else float(deadline_ms) / 1e3)
         self._supervise = bool(supervise)
+        # streaming data-quality sketches (ISSUE 15): when a
+        # DriftMonitor is attached, every scored batch is offered to it
+        # (decoded float32 rows + margins) behind the monitor's own
+        # duty-cycle gate; with no monitor the hot path pays ONE
+        # attribute check per batch.  start() installs it process-wide
+        # (ns="drift" + the mmlspark_tpu_drift_* exposition) so the
+        # SLO drift objectives and the worker stats beacon see it.
+        self._drift = drift_monitor
         self._fatal: Optional[BaseException] = None
         self._died = threading.Event()
         self.stats = stats or StageStats()
@@ -797,6 +806,7 @@ class ScoringEngine:
         the e2e wall time instead of leaking glue between brackets).
         For rid-routed predictors (``routes_by_rid``) the rids ride
         along so the splitter pins each row to its arm."""
+        X_rows = X          # unpadded view for the drift sketches
         if self._pad_buckets:
             b = next_pow2(n)
             if b > n:
@@ -824,6 +834,10 @@ class ScoringEngine:
                                 prof._compile_seq - seq0)
         else:
             m = np.asarray(scorer(X))[:n]
+        if self._drift is not None:
+            # live-traffic sketches (duty-cycle gated inside; never
+            # raises) — rows as decoded, margins as scored
+            self._drift.observe(X_rows[:n], m)
         if self._reply_fn is not None:
             return self._reply_fn(m)
         if self._ndarray_replies:
@@ -1002,6 +1016,11 @@ class ScoringEngine:
         # render_metrics) see its stage latencies and resilience
         # counters without any per-server plumbing
         get_registry().register("scoring", self.stats)
+        if self._drift is not None:
+            # the newest engine's monitor owns ns="drift" (and the
+            # mmlspark_tpu_drift_* families), same semantics as above
+            from ..core.drift import set_drift_monitor
+            set_drift_monitor(self._drift)
         return self
 
     def is_ready(self) -> bool:

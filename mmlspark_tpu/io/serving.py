@@ -952,9 +952,18 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                 # the beacon names the fit span this process is inside
                 # (None outside training) — the trace reader can tie a
                 # worker's stats to the fit they served under
-                client.send(CH_STATS, {"op": "stats",
-                                       "snapshot": wstats.snapshot(),
-                                       "fit": current_fit_span()})
+                payload = {"op": "stats",
+                           "snapshot": wstats.snapshot(),
+                           "fit": current_fit_span()}
+                # drift sketches ride the same beacon (ISSUE 15): the
+                # driver key-wise sums the counters across workers —
+                # cross-process sketch merging through the metrics
+                # scrape, exactly like StageStats
+                from ..core.drift import peek_drift_monitor
+                dm = peek_drift_monitor()
+                if dm is not None:
+                    payload["drift"] = dm.snapshot()
+                client.send(CH_STATS, payload)
             except OSError:
                 pass
 
@@ -1073,6 +1082,12 @@ class MultiprocessHTTPServer:
         for _k in ("worker_deaths", "worker_respawns"):
             self.stats.incr(_k, 0)
         self.worker_stats: Dict[int, dict] = {}
+        # per-worker drift-sketch snapshots (ISSUE 15): workers whose
+        # scoring engine carries a DriftMonitor piggyback its
+        # StageStats-shaped block on the stats beacon; render_metrics
+        # merges them (counters SUM = the merged sketch, gauges take
+        # the worst arm) into one ns="drift" block
+        self.worker_drift: Dict[int, dict] = {}
         # worker slot -> monotonic instant of its last stats beacon (or
         # scrape piggyback): the per-worker `worker_up` gauge ages from
         # here, so a silent worker is visible from ONE scrape
@@ -1217,6 +1232,7 @@ class MultiprocessHTTPServer:
             per_worker = {
                 w: {**s, "gauges": dict(s.get("gauges") or {})}
                 for w, s in self.worker_stats.items()}
+            worker_drift = list(self.worker_drift.values())
             seen = dict(self._beacon_seen)
         for w in range(len(self.addresses)):
             snap = per_worker.setdefault(
@@ -1233,6 +1249,15 @@ class MultiprocessHTTPServer:
                  for w, snap in sorted(per_worker.items())}
         if per_worker:
             extra["workers"] = merge_snapshots(per_worker.values())
+        if worker_drift:
+            # merged drift sketches for the whole topology: counter
+            # sums ARE the concatenated-rows sketch (ISSUE 15); the
+            # driver's own monitor (if any) joins the merge
+            from ..core.drift import peek_drift_monitor
+            dm = peek_drift_monitor()
+            blocks = worker_drift + ([dm.snapshot()]
+                                     if dm is not None else [])
+            extra["drift"] = merge_snapshots(blocks)
         return get_registry().render_prometheus(extra=extra)
 
     def _beacon_loop(self) -> None:
@@ -1370,6 +1395,9 @@ class MultiprocessHTTPServer:
                                                 dict):
                     self.worker_stats[w] = msg["snapshot"]
                     self._beacon_seen[w] = time.monotonic()
+                if w is not None and isinstance(msg.get("drift"),
+                                                dict):
+                    self.worker_drift[w] = msg["drift"]
         elif channel == CH_METRICS and op == "metrics_req":
             # a /metrics scrape hit this worker: fold its piggybacked
             # stats in, render the WHOLE topology (driver registry +

@@ -884,6 +884,26 @@ class TestMetricFamilyDocGuard:
             lambda: render_model_info(
                 [{"arm": "baseline", "version": 1,
                   "digest": "sha256:deadbeef"}]))
+        # the drift monitor's families (ISSUE 15), rendered off a
+        # minimal hand-built reference profile + one observed batch so
+        # every mmlspark_tpu_drift_* family emits at least one sample
+        from mmlspark_tpu.core.drift import DriftConfig, DriftMonitor
+        from mmlspark_tpu.core.sketch import (ReferenceProfile,
+                                              StreamSketch)
+        rsk = StreamSketch([0.0, 1.0])
+        rsk.update(np.array([0.2, 0.4, 0.6, 1.2]))
+        msk = StreamSketch([0.0])
+        msk.update(np.array([-0.5, 0.5]))
+        prof = ReferenceProfile([[0.0, 1.0]], [rsk.snapshot()],
+                                [0.0], msk.snapshot(),
+                                feature_names=["f0"])
+        dmon = DriftMonitor(prof, DriftConfig(duty=1.0,
+                                              eval_interval_s=0.0,
+                                              min_rows=1))
+        dmon.observe(np.array([[0.5]], np.float32), np.array([0.1]))
+        dmon.flush()
+        dmon.close()            # no stray drain thread past this test
+        reg.register_exposition("drift", dmon.render_prometheus)
         # the ops compile-probe info family, rendered off a seeded
         # cache the way ops/pallas_histogram publishes the real one
         import mmlspark_tpu.ops.pallas_histogram as ph

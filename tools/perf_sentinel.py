@@ -190,15 +190,24 @@ def _model(args):
     return b, X
 
 
-def scoring_burst_p50(args, duration=None, warm_s=0.4):
+def scoring_burst_p50(args, duration=None, warm_s=0.4, drift=False):
     """One closed-loop burst through a real ScoringEngine; returns the
     client-observed p50 in ms.  Shared by the ``scoring_engine`` stage
-    and the profiler-overhead A/B (and the tier-1 overhead test)."""
+    and the profiler/sketch overhead A/Bs (and the tier-1 overhead
+    tests).  ``drift=True`` attaches a production-configured
+    DriftMonitor (ISSUE 15) so the A/B measures the sketch hot path
+    exactly as deployed — duty-cycle gate included."""
     import numpy as np
     from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
     b, X = _model(args)
     srv = _BurstServer(X, args.outstanding)
     predictor = b.predictor(backend="auto")
+    drift_monitor = None
+    if drift:
+        from mmlspark_tpu.core.drift import DriftMonitor
+        assert b.reference_profile is not None, \
+            "sentinel model fit captured no reference profile"
+        drift_monitor = DriftMonitor(b.reference_profile)
     factor = _slowdowns().get("scoring_engine", 1.0)
     if factor > 1.0:
         # seeded fault: a genuinely slower scorer (every call pays the
@@ -214,7 +223,8 @@ def scoring_burst_p50(args, duration=None, warm_s=0.4):
     eng = ScoringEngine(srv, predictor=predictor,
                         plan=ColumnPlan("features", X.shape[1]),
                         max_rows=64, latency_budget_ms=2.0,
-                        num_scorers=1, num_repliers=0).start()
+                        num_scorers=1, num_repliers=0,
+                        drift_monitor=drift_monitor).start()
     try:
         srv.pump()
         time.sleep(warm_s)
@@ -226,6 +236,9 @@ def scoring_burst_p50(args, duration=None, warm_s=0.4):
             lat = list(srv.lat)
     finally:
         eng.stop()
+        if drift_monitor is not None:
+            from mmlspark_tpu.core.drift import set_drift_monitor
+            set_drift_monitor(None)
     if not lat:
         return float("nan")
     return float(np.percentile(np.asarray(lat), 50) * 1e3)
@@ -356,6 +369,30 @@ def measure_profiler_overhead(args):
             "accept_overhead_lt_3pct": pct < 3.0}
 
 
+def measure_sketch_overhead(args):
+    """Drift-sketch-enabled vs disabled A/B on the closed-loop scoring
+    burst (ISSUE 15 satellite): the same <3% p50 discipline the
+    profiler overhead gate uses.  The enabled arm runs a
+    production-configured DriftMonitor (2% duty-cycle gate, the
+    deployed default) attached to the engine; interleaved reps,
+    median p50 per arm."""
+    import statistics as st
+    p50 = {True: [], False: []}
+    for _ in range(args.overhead_reps):
+        for enabled in (True, False):
+            p50[enabled].append(scoring_burst_p50(
+                args, duration=args.overhead_duration,
+                drift=enabled))
+    on, off = st.median(p50[True]), st.median(p50[False])
+    pct = (on - off) / off * 100.0 if off > 0 else float("nan")
+    return {"p50_ms_enabled": round(on, 4),
+            "p50_ms_disabled": round(off, 4),
+            "overhead_pct": round(pct, 2),
+            "runs_enabled": [round(v, 4) for v in p50[True]],
+            "runs_disabled": [round(v, 4) for v in p50[False]],
+            "accept_overhead_lt_3pct": pct < 3.0}
+
+
 # ---------------------------------------------------------------- main
 
 
@@ -402,10 +439,14 @@ def run(args):
               f"({r['ratio']}x)", flush=True)
 
     overhead = None
+    sketch_overhead = None
     if not args.skip_overhead:
         print("== profiler overhead A/B ==", flush=True)
         overhead = measure_profiler_overhead(args)
         print(json.dumps(overhead), flush=True)
+        print("== drift-sketch overhead A/B ==", flush=True)
+        sketch_overhead = measure_sketch_overhead(args)
+        print(json.dumps(sketch_overhead), flush=True)
 
     # sample the monitor twice so the gauge objective gets a window
     mon = get_monitor()
@@ -423,6 +464,7 @@ def run(args):
         "calibrate": bool(args.calibrate),
         "rel_threshold": args.rel,
         "profiler_overhead": overhead,
+        "sketch_overhead": sketch_overhead,
         "host": host_info(),
         "slo": {"healthy": slo["healthy"],
                 "breaching": slo["breaching"],
