@@ -16,6 +16,15 @@ vs_baseline = sklearn_wall_clock / our_wall_clock  (>1 means faster).
 Robustness contract (VERDICT r1 weak #1): backend init is probed in a
 subprocess with a timeout and falls back to CPU on hang/crash; the JSON
 line is ALWAYS emitted, even on partial failure, with an "error" field.
+
+Wide-data A/B (ISSUE 16): `--parallelism {data,voting,feature}` with
+`--devices N` runs the same scenario under each distributed mode —
+voting rides the voted-column select-ring, feature the split-broadcast
+protocol — and the detail block records collective count and payload
+bytes per reduce so the PV-Tree payload cut is machine-checkable:
+
+  python bench.py --rows 8192 --features 2000 --iters 4 --devices 4 \
+      --parallelism voting --skip-baseline --force-cpu
 """
 
 import argparse
@@ -72,6 +81,21 @@ def main():
                     help="passThroughArgs forwarded to the estimator "
                          "(A/B knobs, e.g. 'packed_gather=true'); empty "
                          "for the official configuration")
+    ap.add_argument("--parallelism", default=None,
+                    choices=("data", "voting", "feature"),
+                    help="distributed mode for the wide-data A/B "
+                         "(ISSUE 16); builds a mesh over --devices and "
+                         "folds per-reduce payload accounting into "
+                         "detail")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="mesh size; on a CPU backend this forces the "
+                         "host-platform device count before jax init")
+    ap.add_argument("--top-k", type=int, default=32,
+                    help="PV-Tree votes per shard (voting mode only)")
+    ap.add_argument("--skip-baseline", action="store_true",
+                    help="skip the sklearn baseline (the wide-data A/B "
+                         "compares our own modes, and sklearn at "
+                         "f=2000 dominates the wall clock)")
     args = ap.parse_args()
 
     n = args.rows or (20_000 if args.smoke else 400_000)
@@ -87,6 +111,8 @@ def main():
         "detail": {"rows": n, "features": f, "iterations": iters,
                    "num_leaves": leaves},
     }
+    if args.parallelism:
+        result["detail"]["parallelism"] = args.parallelism
     try:
         run_bench(args, n, f, iters, leaves, result)
     except KeyboardInterrupt:
@@ -117,6 +143,12 @@ def run_bench(args, n, f, iters, leaves, result):
     else:
         backend = probe_backend(args.probe_timeout)
     if backend == "cpu":
+        if args.devices and args.devices > 1:
+            # the host platform exposes ONE device unless forced; this
+            # must land in XLA_FLAGS before the backend initializes
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={args.devices}")
         import jax
         jax.config.update("jax_platforms", "cpu")
 
@@ -126,23 +158,31 @@ def run_bench(args, n, f, iters, leaves, result):
     # tunneled-chip runs observed 10.5s vs 6.9s back to back), and
     # min-of-k is the standard noise-robust estimator for a
     # deterministic workload
-    from sklearn.ensemble import HistGradientBoostingClassifier
     from sklearn.metrics import roc_auc_score
-    sk_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        sk = HistGradientBoostingClassifier(
-            max_iter=iters, learning_rate=0.1, max_leaf_nodes=leaves,
-            max_bins=255, early_stopping=False, validation_fraction=None)
-        sk.fit(X, y)
-        sk_times.append(time.perf_counter() - t0)
-    sk_time = min(sk_times)
-    sk_auc = roc_auc_score(y, sk.predict_proba(X)[:, 1])
-    log(f"sklearn: {sk_time:.2f}s (runs: "
-        f"{', '.join(f'{t:.2f}' for t in sk_times)})  AUC={sk_auc:.4f}")
-    result["detail"].update(sklearn_wall_s=round(sk_time, 3),
-                            sklearn_runs=[round(t, 3) for t in sk_times],
-                            sklearn_train_auc=round(float(sk_auc), 5))
+    if args.skip_baseline:
+        sk_time = None
+        result["detail"]["sklearn_skipped"] = True
+        log("sklearn baseline skipped (--skip-baseline)")
+    else:
+        from sklearn.ensemble import HistGradientBoostingClassifier
+        sk_times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sk = HistGradientBoostingClassifier(
+                max_iter=iters, learning_rate=0.1, max_leaf_nodes=leaves,
+                max_bins=255, early_stopping=False,
+                validation_fraction=None)
+            sk.fit(X, y)
+            sk_times.append(time.perf_counter() - t0)
+        sk_time = min(sk_times)
+        sk_auc = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+        log(f"sklearn: {sk_time:.2f}s (runs: "
+            f"{', '.join(f'{t:.2f}' for t in sk_times)})  "
+            f"AUC={sk_auc:.4f}")
+        result["detail"].update(
+            sklearn_wall_s=round(sk_time, 3),
+            sklearn_runs=[round(t, 3) for t in sk_times],
+            sklearn_train_auc=round(float(sk_auc), 5))
 
     # --- ours ----------------------------------------------------------
     import jax
@@ -161,6 +201,27 @@ def run_bench(args, n, f, iters, leaves, result):
 
     kw = dict(learningRate=0.1, numLeaves=leaves, maxBin=255,
               minDataInLeaf=20, verbosity=0)
+    mesh = None
+    if args.parallelism:
+        from mmlspark_tpu.core.mesh import build_mesh
+        D = args.devices or len(jax.devices())
+        devs = jax.devices()[:D]
+        if args.parallelism == "feature":
+            mesh = build_mesh(data=1, feature=D, devices=devs)
+        else:
+            mesh = build_mesh(data=D, feature=1, devices=devs)
+            # data/voting layouts can ride the on-chip ring; feature
+            # stays on its split-broadcast psum protocol
+            kw["collective"] = "ring"
+        kw["parallelism"] = args.parallelism
+        if args.parallelism == "voting":
+            kw["topK"] = args.top_k
+        # leaf-wise trees never exceed depth numLeaves-1, so this pin is
+        # a no-op on tree SHAPE — it exists so the committed artifact's
+        # "collective count per tree <= max_depth + 1" gate is
+        # well-defined (count == numLeaves == maxDepth + 1)
+        kw["maxDepth"] = leaves - 1
+        result["detail"].update(devices=D, max_depth=leaves - 1)
     if args.pass_through:
         kw["passThroughArgs"] = args.pass_through
         result["detail"]["pass_through"] = args.pass_through
@@ -168,15 +229,20 @@ def run_bench(args, n, f, iters, leaves, result):
     # (boost step AND forest-pack kernels compiled, caches hot)
     log("warm-up / compile...")
     t0 = time.perf_counter()
-    LightGBMClassifier(numIterations=iters, **kw).fit(
-        {"features": X, "label": y})
+
+    def fit_once():
+        est = LightGBMClassifier(numIterations=iters, **kw)
+        if mesh is not None:
+            est = est.setMesh(mesh)
+        return est.fit({"features": X, "label": y})
+
+    fit_once()
     log(f"warm-up (incl compile): {time.perf_counter() - t0:.2f}s")
 
     our_times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        model = LightGBMClassifier(numIterations=iters, **kw).fit(
-            {"features": X, "label": y})
+        model = fit_once()
         our_times.append(time.perf_counter() - t0)
     our_time = min(our_times)
     # provenance: the RESOLVED histogram kernel + collective the fit ran
@@ -184,13 +250,23 @@ def run_bench(args, n, f, iters, leaves, result):
     # bench artifact must say which kernel produced the number
     from mmlspark_tpu.gbdt import engine as _engine
     result["detail"].update(_engine.last_fit_info)
+    info = _engine.last_fit_info
+    if "collective_count_per_tree" in info:
+        # per-reduce payload: the number the 10-100x wide-data claim
+        # rides on (ISSUE 16 acceptance reads these off the artifact)
+        cnt = int(info["collective_count_per_tree"])
+        payload = int(info["collective_payload_bytes_per_tree"])
+        result["detail"].update(
+            collective_payload_bytes_per_reduce=(
+                round(payload / cnt, 1) if cnt else 0.0))
     out = model.transform({"features": X, "label": y})
     our_auc = roc_auc_score(y, np.asarray(out["probability"])[:, 1])
     log(f"ours: {our_time:.2f}s (runs: "
         f"{', '.join(f'{t:.2f}' for t in our_times)})  AUC={our_auc:.4f}")
 
     result["value"] = round(n * iters / our_time, 1)
-    result["vs_baseline"] = round(sk_time / our_time, 4)
+    if sk_time is not None:
+        result["vs_baseline"] = round(sk_time / our_time, 4)
     result["detail"].update(our_wall_s=round(our_time, 3),
                             our_runs=[round(t, 3) for t in our_times],
                             our_train_auc=round(float(our_auc), 5))

@@ -77,8 +77,12 @@ def data_only_mesh(mesh: Mesh) -> Mesh:
     The Pallas ring collectives (ops/pallas_collectives.py) require
     exactly one named mesh axis — both for Mosaic's LOGICAL device-id
     lowering along the ring and for the interpret-mode DMA discharge,
-    which rejects multi-axis environments.  Only meaningful for pure
-    data-parallel layouts (feature axis of size 1); raises otherwise."""
+    which rejects multi-axis environments.  Only meaningful for layouts
+    whose feature axis is size 1 — pure data-parallel AND voting-
+    parallel fits (voting shares the data layout; its voted-column ring
+    reduces only the candidate slab) — and raises otherwise.  Every
+    scan builder in this module sizes its PartitionSpecs via
+    :func:`_f_ax`, so the rebuilt mesh flows through them unchanged."""
     if _feat_n(mesh) != 1:
         raise ValueError(
             "ring collectives need a pure data-parallel layout; "

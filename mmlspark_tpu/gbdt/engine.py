@@ -29,7 +29,7 @@ from ..core.profiling import StageStats
 from .binning import BinMapper, fit_bin_mapper
 from .booster import Booster, HostTree, host_tree_from_arrays
 from .grower import (EFBArrays, GrowerConfig, TreeArrays, apply_shrinkage,
-                     grow_tree, predict_tree_binned,
+                     collective_schedule, grow_tree, predict_tree_binned,
                      predict_tree_binned_any, predict_tree_binned_efb,
                      _grow_tree_impl)
 from .objectives import Objective, MulticlassObjective
@@ -49,36 +49,53 @@ def _resolve_hist_method(method: str) -> str:
 
 def _resolve_collective_cfg(params: "TrainParams", mesh, *,
                             ranking: bool = False):
-    """Resolve ``params.collective`` → ``("psum"|"ring", mesh)``.
+    """Resolve ``params.collective`` → ``("psum"|"ring", mesh, reason)``.
 
     "auto" stays on psum until an on-chip A/B flips the default
-    (tools/tpu_session.sh queues one).  "ring" requires a pure
-    data-parallel multi-shard layout on a path whose scans support the
-    data-only mesh (gbdt/goss/rf/multiclass — not ranking, dart or
-    voting), plus a Mosaic compile probe on accelerator backends; it
-    degrades to psum with a log line otherwise.  On success the mesh is
-    rebuilt SINGLE-AXIS (``distributed.data_only_mesh``): the Pallas
-    ring kernels — and their interpret-mode discharge, which rejects
-    multi-axis environments — ring over exactly one named axis."""
-    if params.collective in ("auto", "psum", "") or mesh is None:
-        return "psum", mesh
+    (tools/tpu_session.sh queues one).  "ring" requires a multi-shard
+    layout whose data axis is the only populated one, on a path whose
+    scans support the data-only mesh (gbdt/goss/rf/multiclass, data- or
+    voting-parallel — not ranking, dart or a feature-sharded mesh), plus
+    a Mosaic compile probe on accelerator backends; it degrades to psum
+    with only a ``log.info``, and the downgrade REASON is returned so
+    ``_record_fit_resolution`` lands it in ``last_fit_info`` and the
+    /metrics info gauge (the third element is "none" when the request
+    was honored or nothing beyond psum was asked for).  On success the
+    mesh is rebuilt SINGLE-AXIS (``distributed.data_only_mesh``): the
+    Pallas ring kernels — and their interpret-mode discharge, which
+    rejects multi-axis environments — ring over exactly one named axis.
+    Voting fits ride the same data-only mesh (their mesh layout is the
+    data layout; the voted-column ring reduces only the candidate
+    slab)."""
+    if params.collective in ("auto", "psum", ""):
+        return "psum", mesh, "none"
+    if mesh is None:
+        if params.collective == "ring":
+            log.info("collective='ring' needs a multi-shard mesh; this "
+                     "serial fit keeps psum (single_data_shard)")
+            return "psum", mesh, "single_data_shard"
+        return "psum", mesh, "none"
     if params.collective != "ring":
         raise ValueError(f"Unknown collective {params.collective!r}; "
                          "valid: auto, psum, ring")
     from ..core.mesh import DATA_AXIS
     from .distributed import _feat_n, data_only_mesh
     d = int(mesh.shape[DATA_AXIS])
-    if (d <= 1 or _feat_n(mesh) > 1 or ranking
-            or params.boosting == "dart"
-            or params.parallelism == "voting"):
-        log.info("collective='ring' needs a multi-shard pure "
-                 "data-parallel gbdt/goss/rf fit; this fit keeps psum")
-        return "psum", mesh
+    reason = ("single_data_shard" if d <= 1
+              else "feature_axis" if _feat_n(mesh) > 1
+              else "ranking" if ranking
+              else "dart" if params.boosting == "dart"
+              else None)
+    if reason is not None:
+        log.info("collective='ring' needs a multi-shard data-parallel "
+                 "or voting gbdt/goss/rf fit; this fit keeps psum "
+                 "(%s)", reason)
+        return "psum", mesh, reason
     from ..ops.pallas_collectives import resolve_collective
     resolved = resolve_collective("ring", d)
     if resolved == "ring":
-        return "ring", data_only_mesh(mesh)
-    return "psum", mesh
+        return "ring", data_only_mesh(mesh), "none"
+    return "psum", mesh, "compile_probe"
 
 
 #: What the LAST fit in this process actually ran (resolved histogram
@@ -87,11 +104,40 @@ def _resolve_collective_cfg(params: "TrainParams", mesh, *,
 last_fit_info: Dict[str, str] = {}
 
 
-def _record_fit_resolution(cfg, collective: str) -> None:
+def _record_fit_resolution(cfg, collective: str,
+                           downgrade: str = "none",
+                           sched: Optional[dict] = None) -> None:
     last_fit_info.clear()
     last_fit_info.update(histogram_method=cfg.hist_method,
                          collective=collective,
+                         collective_downgrade=downgrade,
                          backend=jax.default_backend())
+    if sched is not None:
+        # static per-tree collective accounting (grower.
+        # collective_schedule) — bench.py folds these into the artifact
+        # detail, and the info gauge exposes them as labels
+        dense = max(1, sched["dense_payload_bytes"])
+        last_fit_info.update(
+            collective_count_per_tree=str(sched["count"]),
+            collective_payload_bytes_per_tree=str(sched["payload_bytes"]),
+            collective_payload_vs_dense=(
+                f"{sched['payload_bytes'] / dense:.6f}"))
+
+
+def _collective_sched_for(cfg, mesh, n: int, f: int) -> dict:
+    """Per-tree collective accounting for this fit: the grower schedule
+    evaluated on the MESH-sharded cfg (axis names attach inside the
+    scan builders, so the engine-level cfg alone would always read
+    serial — zero count/payload)."""
+    if mesh is None:
+        return collective_schedule(cfg, f)
+    from ..core.mesh import DATA_AXIS
+    from .distributed import _feat_n, _sharded_cfg
+    dn = int(mesh.shape[DATA_AXIS])
+    return collective_schedule(
+        _sharded_cfg(mesh, cfg), f,
+        n_rows_local=-(-n // max(1, dn)),
+        feature_shards=_feat_n(mesh))
 
 log = logging.getLogger("mmlspark_tpu.gbdt")
 
@@ -272,7 +318,8 @@ _CKPT_MESH_STATE = _CKPT_MESH_PREFIX + "{:06d}.npz"
 #: chaos drill snapshot before/after a fit and assert deltas.
 train_stats = StageStats()
 for _k in ("chunks_replayed", "ckpt_saved", "ckpt_resumed",
-           "ckpt_discarded", "boost_chunks", "ref_profiles"):
+           "ckpt_discarded", "boost_chunks", "ref_profiles",
+           "collective_count", "collective_payload_bytes"):
     train_stats.incr(_k, 0)
 del _k
 # federate under the process registry: a serving process that also
@@ -320,7 +367,8 @@ _MONITOR_LOSS_MAX_ROWS = 65536
 def _monitor_chunk(it0: int, it1: int, dt_s: float, n_rows: int, K: int,
                    hist_method: str, objective=None, scores=None,
                    labels=None, weights=None,
-                   collective: str = "none") -> None:
+                   collective: str = "none",
+                   coll_sched: Optional[dict] = None) -> None:
     """Per-boost-chunk live training telemetry: ms/tree, rows/s,
     last-iteration and (when the objective can compute it cheaply)
     train-loss gauges on ``train_stats``, plus one ``boost_chunk``
@@ -334,7 +382,13 @@ def _monitor_chunk(it0: int, it1: int, dt_s: float, n_rows: int, K: int,
     bounded: beyond ``_MONITOR_LOSS_MAX_ROWS`` rows the loss is
     computed on a strided sample, sliced ON DEVICE first, so a
     Criteo-scale fit pays a bounded D2H per boundary for the gauge, not
-    an O(n) transfer the training loop never needed before."""
+    an O(n) transfer the training loop never needed before.
+
+    ``coll_sched``: the fit's per-tree collective accounting
+    (grower.collective_schedule) — scaled by the chunk's tree count into
+    the ``collective_count``/``collective_payload_bytes`` counters and
+    journaled on the ``boost_chunk`` event, so the payload a wide-data
+    voting fit saves is machine-checkable on /metrics (ISSUE 16)."""
     iters = max(1, it1 - it0)
     trees = iters * max(1, K)
     ms_per_tree = dt_s * 1e3 / trees
@@ -343,6 +397,12 @@ def _monitor_chunk(it0: int, it1: int, dt_s: float, n_rows: int, K: int,
     train_stats.set_gauge("train_rows_per_s", round(rows_per_s, 1))
     train_stats.set_gauge("last_iteration", float(it1))
     train_stats.incr("boost_chunks")
+    coll_count = coll_bytes = None
+    if coll_sched is not None:
+        coll_count = coll_sched["count"] * trees
+        coll_bytes = coll_sched["payload_bytes"] * trees
+        train_stats.incr("collective_count", coll_count)
+        train_stats.incr("collective_payload_bytes", coll_bytes)
     loss = None
     if (objective is not None and scores is not None
             and labels is not None
@@ -365,6 +425,9 @@ def _monitor_chunk(it0: int, it1: int, dt_s: float, n_rows: int, K: int,
           "it_end": int(it1), "ms_per_tree": round(ms_per_tree, 3),
           "rows_per_s": round(rows_per_s, 1),
           "hist_method": hist_method, "collective": collective}
+    if coll_count is not None:
+        ev["collective_count"] = int(coll_count)
+        ev["collective_payload_bytes"] = int(coll_bytes)
     if loss is not None:
         ev["train_loss"] = round(float(loss), 6)
     _tm.get_journal().emit("boost_chunk", **ev)
@@ -1451,7 +1514,7 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
         if params.boost_from_average and init_scores is None else 0.0
 
     use_voting = params.parallelism == "voting"
-    collective, mesh = _resolve_collective_cfg(
+    collective, mesh, coll_downgrade = _resolve_collective_cfg(
         params, mesh, ranking=ranking_info is not None)
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
@@ -1467,7 +1530,8 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
         max_cat_threshold=params.max_cat_threshold,
         max_cat_to_onehot=params.max_cat_to_onehot)
-    _record_fit_resolution(cfg, collective)
+    coll_sched = _collective_sched_for(cfg, mesh, n, f)
+    _record_fit_resolution(cfg, collective, coll_downgrade, coll_sched)
 
     if params.boosting not in ("gbdt", "goss", "dart", "rf"):
         raise NotImplementedError(
@@ -1729,7 +1793,7 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
             get_profiler().record_phase(
                 "train.host_iter", time.perf_counter() - t_iter)
             _monitor_chunk(it, it + 1, time.perf_counter() - t_iter,
-                           n, K, cfg.hist_method)
+                           n, K, cfg.hist_method, coll_sched=coll_sched)
             if has_val:
                 # trees are already shrunk, so val scores add at lr=1.0
                 val_scores = val_scores + predict_tree_binned(
@@ -2007,7 +2071,7 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
             trees_chunks.append(trees_st)
             _monitor_chunk(it, it + C, time.perf_counter() - t_chunk,
                            n, K, cfg.hist_method, objective, scores,
-                           labels, w)
+                           labels, w, coll_sched=coll_sched)
             stop = False
             if has_val:
                 vh = np.asarray(val_hist)        # (C, n_val[, K])
@@ -2144,7 +2208,7 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
     init = objective.init_score(y_global, w_global) \
         if params.boost_from_average and init_scores is None else 0.0
 
-    collective, mesh = _resolve_collective_cfg(
+    collective, mesh, coll_downgrade = _resolve_collective_cfg(
         params, mesh, ranking=ranking_info is not None)
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
@@ -2160,10 +2224,12 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
         max_cat_threshold=params.max_cat_threshold,
         max_cat_to_onehot=params.max_cat_to_onehot)
-    _record_fit_resolution(cfg, collective)
 
     from .budget import check_fit_budget
     f_sh = next(b.shape[1] for b in bins_shards if b is not None)
+    _record_fit_resolution(
+        cfg, collective, coll_downgrade,
+        _collective_sched_for(cfg, mesh, sum(sizes), f_sh))
     _bagging = params.bagging_freq > 0 and params.bagging_fraction < 1.0
     _chunk = params.num_iterations
     if _bagging:
@@ -2791,6 +2857,9 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
     # so both are excluded; voting's shard-local vote scan likewise.
     efb_dev_m, efb_host_m = None, None
     from .distributed import _feat_n as _feat_shards
+    # per-tree collective accounting for the chunk monitor: evaluated on
+    # the sharded cfg (axis names attach inside the scan builders)
+    coll_sched_m = _collective_sched_for(cfg, mesh, n, f)
     if params.enable_bundle and not mapper.has_categorical \
             and mapper.num_total_bins <= 256 \
             and _feat_shards(mesh) == 1 \
@@ -3086,7 +3155,8 @@ def _train_distributed(bins, labels, w, mapper, objective, params, cfg, mesh,
         # addressable on any one controller), so train loss is skipped
         # rather than gathered
         _monitor_chunk(it, it + C, time.perf_counter() - t_chunk, n, K,
-                       cfg.hist_method, collective=cfg.collective)
+                       cfg.hist_method, collective=cfg.collective,
+                       coll_sched=coll_sched_m)
         stop = False
         if has_val:
             vh = np.asarray(val_hist)[:, :nv]    # drop val pad rows

@@ -401,62 +401,95 @@ def _hist(bins, gh, cfg: GrowerConfig, efb: Optional[EFBArrays] = None):
     return h
 
 
-def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
-                           feat_info, depth_ok, cfg: GrowerConfig):
-    """PV-Tree split finding (Meng et al. 2016; LightGBM
-    tree_learner=voting): each data shard scores every feature on its
-    LOCAL histogram against its LOCAL totals, votes its top-k features,
-    votes are allgathered, and only the globally top-2k voted features'
-    histograms are psum-reduced for the exact global decision.
+def _take_cand(hist, cand):
+    """Gather candidate columns: ``(f,B,3)[cand (k2,)]`` → ``(k2,B,3)``,
+    or batched ``(m,f,B,3)`` with ``cand (m,k2)`` → ``(m,k2,B,3)``."""
+    if cand.ndim == 1:
+        return jnp.take(hist, cand, axis=0)
+    return jnp.take_along_axis(hist, cand[:, :, None, None], axis=1)
 
-    Categorical features vote with their local Fisher-grouping gain
-    (:func:`_cat_split_gains`) and, when voted into the candidate set, get
-    the exact sorted-subset search over the psum-reduced candidate
-    histograms — same two-phase shape as the numeric path.
-    Returns the same tuple as :func:`find_best_split`.
-    """
-    f, B = hist_local.shape[0], hist_local.shape[1]
+
+def _reduce_select(hist_local, cand, cfg: GrowerConfig):
+    """Reduce ONLY the voted candidate columns across the data mesh: the
+    voted-column ring (ops/pallas_collectives.ring_allreduce_select)
+    when the collective resolved to ring, gather + ``lax.psum``
+    otherwise.  Trace-safe like :func:`_reduce_hist` — the ring entry
+    consults only the cached Mosaic verdict and the VMEM gate."""
+    if cfg.collective == "ring" and cfg.data_axis_size > 1:
+        from ..ops.pallas_collectives import ring_allreduce_select_or_psum
+        return ring_allreduce_select_or_psum(hist_local, cand,
+                                             cfg.axis_name,
+                                             cfg.data_axis_size)
+    return jax.lax.psum(_take_cand(hist_local, cand), cfg.axis_name)
+
+
+def _voting_masks(feat_info, depth_ok, cfg: GrowerConfig):
+    """Per-feature numeric mask and (when categorical) cat-allowed mask
+    shared by every phase of the voting protocol."""
     feature_mask = feat_info[:, 0]
     is_cat_f = feat_info[:, 1] > 0
-    md, mh = cfg.min_data_in_leaf, cfg.min_sum_hessian_in_leaf
-
-    def per_feature_gains(hist, pg, ph, pc, mask_cols):
-        cum = jnp.cumsum(hist, axis=1)
-        gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
-        gr, hr, cr = pg - gl, ph - hl, pc - cl
-        valid = ((cl >= md) & (cr >= md) & (hl >= mh) & (hr >= mh)
-                 & (jnp.arange(B) < B - 1)[None, :])
-        parent_gain = _leaf_gain(pg, ph, cfg)
-        gains = (_leaf_gain(gl, hl, cfg) + _leaf_gain(gr, hr, cfg)
-                 - parent_gain)
-        return jnp.where(valid & mask_cols & depth_ok, gains, -jnp.inf)
-
-    # 1. local votes: top-k features by local best gain vs local totals
-    s_loc = jnp.sum(hist_local[0], axis=0)
     num_mask = ((feature_mask > 0) & (~is_cat_f if cfg.use_categorical
                                       else True))
-    gains_loc = per_feature_gains(hist_local, s_loc[0], s_loc[1], s_loc[2],
-                                  num_mask[:, None])
+    cat_allowed = (is_cat_f & (feature_mask > 0) & depth_ok
+                   if cfg.use_categorical else None)
+    return num_mask, cat_allowed
+
+
+def _voting_feature_gains(hist, pg, ph, pc, mask_cols, depth_ok,
+                          cfg: GrowerConfig):
+    """Per-(feature, bin) numeric split gains over ``hist`` against the
+    given parent totals — the scan both the vote and decide phases run."""
+    B = hist.shape[1]
+    md, mh = cfg.min_data_in_leaf, cfg.min_sum_hessian_in_leaf
+    cum = jnp.cumsum(hist, axis=1)
+    gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
+    gr, hr, cr = pg - gl, ph - hl, pc - cl
+    valid = ((cl >= md) & (cr >= md) & (hl >= mh) & (hr >= mh)
+             & (jnp.arange(B) < B - 1)[None, :])
+    parent_gain = _leaf_gain(pg, ph, cfg)
+    gains = (_leaf_gain(gl, hl, cfg) + _leaf_gain(gr, hr, cfg)
+             - parent_gain)
+    return jnp.where(valid & mask_cols & depth_ok, gains, -jnp.inf)
+
+
+def _voting_votes(hist_local, feat_info, depth_ok, num_mask, cat_allowed,
+                  cfg: GrowerConfig):
+    """Shard-local vote: the ids of the top-k features by local best
+    gain against the shard's LOCAL leaf totals."""
+    f = hist_local.shape[0]
+    s_loc = jnp.sum(hist_local[0], axis=0)
+    gains_loc = _voting_feature_gains(hist_local, s_loc[0], s_loc[1],
+                                      s_loc[2], num_mask[:, None],
+                                      depth_ok, cfg)
     score_f = jnp.max(gains_loc, axis=1)
     if cfg.use_categorical:
-        cat_allowed = is_cat_f & (feature_mask > 0) & depth_ok
         gains_cat_loc, _, _ = _cat_split_gains(
             hist_local, s_loc[0], s_loc[1], s_loc[2], cat_allowed,
             feat_info[:, 2], cfg)
         score_f = jnp.maximum(score_f, jnp.max(gains_cat_loc, axis=1))
+    _, votes = jax.lax.top_k(score_f, min(cfg.voting_k, f))
+    return votes
+
+
+def _voting_candidates(votes_flat, f: int, cfg: GrowerConfig):
+    """Global candidate set from the allgathered votes: top-2k features
+    by vote count (feature id tie-break keeps every shard's selection
+    identical and deterministic)."""
+    counts = jnp.zeros(f, jnp.int32).at[votes_flat].add(1)
     k = min(cfg.voting_k, f)
-    _, votes = jax.lax.top_k(score_f, k)
-    votes_all = jax.lax.all_gather(votes, cfg.axis_name)        # (S, k)
-    counts = jnp.zeros(f, jnp.int32).at[votes_all.reshape(-1)].add(1)
-    # 2. global candidates: top-2k by vote count (feature id tie-break
-    #    keeps every shard's selection identical and deterministic)
     k2 = min(2 * k, f)
     key = counts * f + (f - 1 - jnp.arange(f, dtype=jnp.int32))
     _, cand = jax.lax.top_k(key, k2)                             # (k2,)
-    # 3. exact decision over the psum-reduced candidate histograms
-    hist_cand = jax.lax.psum(hist_local[cand], cfg.axis_name)   # (k2, B, 3)
-    gains_cand = per_feature_gains(hist_cand, parent_g, parent_h, parent_c,
-                                   num_mask[cand][:, None])
+    return cand
+
+
+def _voting_decide(hist_cand, cand, pg, ph, pc, feat_info, depth_ok,
+                   num_mask, cat_allowed, cfg: GrowerConfig):
+    """Exact decision over the globally reduced candidate histograms."""
+    B = hist_cand.shape[1]
+    gains_cand = _voting_feature_gains(hist_cand, pg, ph, pc,
+                                       num_mask[cand][:, None],
+                                       depth_ok, cfg)
     flat = gains_cand.reshape(-1)
     idx = jnp.argmax(flat)
     best_gain = flat[idx]
@@ -466,7 +499,7 @@ def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
     cat_bits = jnp.zeros(cfg.cat_words, jnp.uint32)
     if cfg.use_categorical:
         cat_gain, cat_feat_loc, _, cat_bits_w = _find_best_cat_split(
-            hist_cand, parent_g, parent_h, parent_c, cat_allowed[cand],
+            hist_cand, pg, ph, pc, cat_allowed[cand],
             feat_info[cand, 2], cfg)
         cat_wins = cat_gain > best_gain
         best_gain = jnp.maximum(best_gain, cat_gain)
@@ -477,6 +510,63 @@ def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
     gain_ok = best_gain > jnp.maximum(cfg.min_gain_to_split, EPS_GAIN)
     return (jnp.where(gain_ok, best_gain, -jnp.inf), feat, b, is_cat,
             cat_bits)
+
+
+def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
+                           feat_info, depth_ok, cfg: GrowerConfig):
+    """PV-Tree split finding (Meng et al. 2016; LightGBM
+    tree_learner=voting): each data shard scores every feature on its
+    LOCAL histogram against its LOCAL totals, votes its top-k features,
+    votes are allgathered, and only the globally top-2k voted features'
+    histograms are reduced — via the voted-column ring or psum per
+    ``cfg.collective`` (:func:`_reduce_select`) — for the exact global
+    decision.
+
+    Categorical features vote with their local Fisher-grouping gain
+    (:func:`_cat_split_gains`) and, when voted into the candidate set, get
+    the exact sorted-subset search over the reduced candidate
+    histograms — same two-phase shape as the numeric path.
+    Returns the same tuple as :func:`find_best_split`.
+    """
+    f = hist_local.shape[0]
+    num_mask, cat_allowed = _voting_masks(feat_info, depth_ok, cfg)
+    # 1. local votes  2. global candidates  3. exact decision over the
+    # reduced (k2, B, 3) candidate slab
+    votes = _voting_votes(hist_local, feat_info, depth_ok, num_mask,
+                          cat_allowed, cfg)
+    votes_all = jax.lax.all_gather(votes, cfg.axis_name)        # (S, k)
+    cand = _voting_candidates(votes_all.reshape(-1), f, cfg)
+    hist_cand = _reduce_select(hist_local, cand, cfg)           # (k2, B, 3)
+    return _voting_decide(hist_cand, cand, parent_g, parent_h, parent_c,
+                          feat_info, depth_ok, num_mask, cat_allowed, cfg)
+
+
+def find_best_split_voting_pair(hist_l, hist_r, tot_l, tot_r, feat_info,
+                                depth_ok, cfg: GrowerConfig):
+    """Batched-frontier voting for the two children of one grow step:
+    both children's votes ride ONE allgather and both candidate slabs
+    ONE ``(2, k2, B, 3)`` reduction, so the collective count per grow
+    step is 1 candidate reduce instead of 2 — O(depth)-shaped instead of
+    O(leaves)-shaped when ``num_leaves ≤ max_depth + 1``.  The stacked
+    reduce is element-wise, so results are BIT-IDENTICAL to two
+    independent :func:`find_best_split_voting` calls."""
+    f = hist_l.shape[0]
+    num_mask, cat_allowed = _voting_masks(feat_info, depth_ok, cfg)
+    votes = jnp.stack([
+        _voting_votes(hist_l, feat_info, depth_ok, num_mask, cat_allowed,
+                      cfg),
+        _voting_votes(hist_r, feat_info, depth_ok, num_mask, cat_allowed,
+                      cfg)])
+    votes_all = jax.lax.all_gather(votes, cfg.axis_name)     # (S, 2, k)
+    cand_l = _voting_candidates(votes_all[:, 0].reshape(-1), f, cfg)
+    cand_r = _voting_candidates(votes_all[:, 1].reshape(-1), f, cfg)
+    slab = _reduce_select(jnp.stack([hist_l, hist_r]),
+                          jnp.stack([cand_l, cand_r]), cfg)  # (2,k2,B,3)
+    res_l = _voting_decide(slab[0], cand_l, *tot_l, feat_info, depth_ok,
+                           num_mask, cat_allowed, cfg)
+    res_r = _voting_decide(slab[1], cand_r, *tot_r, feat_info, depth_ok,
+                           num_mask, cat_allowed, cfg)
+    return res_l, res_r
 
 
 def _bucket_sizes(n: int, cfg: GrowerConfig):
@@ -757,6 +847,51 @@ def _find_split(hist, pg, ph, pc, fi, depth_ok, cfg: GrowerConfig):
     return find_best_split(hist, pg, ph, pc, fi, depth_ok, cfg)
 
 
+def collective_schedule(cfg: GrowerConfig, f: int, *,
+                        n_rows_local: int = 0,
+                        feature_shards: int = 1) -> dict:
+    """Static per-TREE accounting of the grower's cross-shard
+    collectives — computed host-side from shapes so the engine can
+    journal ``collective_count``/``collective_payload_bytes`` per boost
+    chunk without touching the trace (ISSUE 16 tentpole d).
+
+    ``count`` counts the payload-bearing launches: histogram reductions
+    under a data axis (the voting path batches both children of a grow
+    step into one, so count = num_leaves = root + L-1 steps), and
+    split-column broadcasts under a feature axis.  ``payload_bytes``
+    sums the logical bytes each shard hands to EVERY training
+    collective, tiny aux ones included (vote allgathers, leaf totals,
+    partition counts, the feature-parallel gain/feat/bin tuple).
+    ``dense_payload_bytes`` is what the same tree pays on the dense
+    data-parallel reduce path — L reduces of the full (f, B, 3) f32
+    state — the denominator of the bench artifact's payload ratio.
+    Serial fits return zero count/payload.
+    """
+    B, L, W = cfg.num_bins, cfg.num_leaves, cfg.cat_words
+    dense = L * f * B * 3 * 4
+    count, payload = 0, 0
+    if cfg.axis_name is not None and cfg.data_axis_size > 1:
+        if _is_voting(cfg):
+            k = min(cfg.voting_k, f)
+            k2 = min(2 * k, f)
+            slab = k2 * B * 3 * 4
+            count += L
+            payload += slab + (L - 1) * 2 * slab   # root + batched pairs
+            payload += 4 * (k + (L - 1) * 2 * k)   # vote allgathers (i32)
+            payload += L * 3 * 4                   # leaf-totals psums
+        else:
+            count += L                             # root + L-1 children
+            payload += dense
+        if cfg.compact_rows:
+            payload += (L - 1) * 2 * 4             # partition-count pairs
+    if cfg.feature_axis_name is not None and feature_shards > 1:
+        count += L - 1                             # split-column psums
+        payload += (L - 1) * n_rows_local * 4
+        payload += (2 * L - 1) * (16 + W * 4)      # split-tuple allgathers
+    return {"count": count, "payload_bytes": payload,
+            "dense_payload_bytes": dense}
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def grow_tree(bins: jnp.ndarray, gh: jnp.ndarray,
               feat_info: jnp.ndarray,
@@ -989,10 +1124,20 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
             child_depth = state.leaf_depth[l] + 1
             depth_ok = jnp.asarray(
                 (cfg.max_depth <= 0), bool) | (child_depth < cfg.max_depth)
-            bg_l, bf_l, bb_l, bc_l, bits_l = _find_split(
-                hist_l, g_l, h_l, c_l, feat_info, depth_ok, cfg)
-            bg_r, bf_r, bb_r, bc_r, bits_r = _find_split(
-                hist_r, g_r, h_r, c_r, feat_info, depth_ok, cfg)
+            if _is_voting(cfg):
+                # batched frontier (ISSUE 16): both children's votes
+                # ride one allgather and both candidate slabs one
+                # stacked reduction — 1 collective per grow step
+                ((bg_l, bf_l, bb_l, bc_l, bits_l),
+                 (bg_r, bf_r, bb_r, bc_r, bits_r)) = \
+                    find_best_split_voting_pair(
+                        hist_l, hist_r, (g_l, h_l, c_l),
+                        (g_r, h_r, c_r), feat_info, depth_ok, cfg)
+            else:
+                bg_l, bf_l, bb_l, bc_l, bits_l = _find_split(
+                    hist_l, g_l, h_l, c_l, feat_info, depth_ok, cfg)
+                bg_r, bf_r, bb_r, bc_r, bits_r = _find_split(
+                    hist_r, g_r, h_r, c_r, feat_info, depth_ok, cfg)
 
             t = state.tree
             # link the new internal node into its parent

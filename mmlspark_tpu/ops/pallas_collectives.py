@@ -19,6 +19,18 @@ per-tree collective entirely on-chip (ROADMAP open item 1; SNIPPETS
     each chunk's reduction visits devices in rotated ring order, so
     results differ from psum by ulp-level rounding only.
 
+``ring_allreduce_select``
+    The voted-column ring (ISSUE 16): gather ONLY the PV-Tree voted
+    candidate columns — the ``(k2, B, 3)`` slab out of the full
+    ``(f, B, 3)`` local histogram — and run the slab through the same
+    chunked double-buffered ring schedule.  On wide data this cuts the
+    collective *payload* 10–100× on top of the transport win: the
+    reduce moves ``k2/f`` of the dense bytes.  The gather happens
+    outside the kernel (a plain XLA take), so the ring kernel itself is
+    shared with ``ring_allreduce`` — only the Mosaic collective id
+    differs, keeping the two launches' barriers from aliasing when one
+    program runs both.
+
 ``fused_segment_hist_ring``
     The full gather→histogram→ring-allreduce fusion: extends
     ``histogram_pallas_fused``'s VMEM-resident row gather + 16×16
@@ -70,11 +82,12 @@ RING_MAX_BYTES = 4 << 20
 #: whole-matrix residency affordable exactly when the ring applies).
 FUSED_RING_MAX_BINST_BYTES = 6 << 20
 
-#: Mosaic collective ids for the two kernel families (any constant works
+#: Mosaic collective ids for the kernel families (any constant works
 #: as long as every device in the gang runs the same program; distinct
-#: ids keep the two kernels' barriers from aliasing).
+#: ids keep the kernels' barriers from aliasing).
 _RING_COLLECTIVE_ID = 7
 _FUSED_RING_COLLECTIVE_ID = 8
+_SELECT_RING_COLLECTIVE_ID = 9
 
 
 def _dev_id(i, interpret: bool):
@@ -145,19 +158,11 @@ def _ring_allreduce_kernel(x_ref, out_ref, work, comm, send_sem, recv_sem,
         out_ref[chunk(c)] = comm[nslot]
 
 
-def ring_allreduce(x: jnp.ndarray, axis_name: str, num_devices: int,
-                   interpret: bool = False) -> jnp.ndarray:
-    """Pallas ring all-reduce of ``x`` over ``axis_name`` (call inside
-    ``shard_map`` on a SINGLE-named-axis mesh).  Drop-in for
-    ``jax.lax.psum(x, axis_name)``; bit-identical at ``num_devices=2``,
-    ulp-rotated at larger rings.  Raises when the VMEM gate refuses the
-    array — trace-safe callers use :func:`ring_allreduce_or_psum`."""
-    if num_devices <= 1:
-        return x
-    if 4 * int(np.prod(x.shape)) > RING_MAX_BYTES:
-        raise ValueError(
-            f"ring_allreduce: {x.shape} f32 exceeds the "
-            f"{RING_MAX_BYTES >> 20} MB VMEM-residency gate")
+def _ring_flat(x: jnp.ndarray, axis_name: str, num_devices: int,
+               interpret: bool, collective_id: int) -> jnp.ndarray:
+    """Shared launcher for the dense/select ring: flatten, pad to one
+    (cb, 128) chunk per device, run :func:`_ring_allreduce_kernel` under
+    the given Mosaic collective id, unpad."""
     shape, dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     total = flat.shape[0]
@@ -183,9 +188,26 @@ def ring_allreduce(x: jnp.ndarray, axis_name: str, num_devices: int,
         interpret=interpret,
         **({} if interpret else dict(
             compiler_params=pltpu.TPUCompilerParams(
-                collective_id=_RING_COLLECTIVE_ID))),
+                collective_id=collective_id))),
     )(arr)
     return out.reshape(-1)[:total].reshape(shape).astype(dtype)
+
+
+def ring_allreduce(x: jnp.ndarray, axis_name: str, num_devices: int,
+                   interpret: bool = False) -> jnp.ndarray:
+    """Pallas ring all-reduce of ``x`` over ``axis_name`` (call inside
+    ``shard_map`` on a SINGLE-named-axis mesh).  Drop-in for
+    ``jax.lax.psum(x, axis_name)``; bit-identical at ``num_devices=2``,
+    ulp-rotated at larger rings.  Raises when the VMEM gate refuses the
+    array — trace-safe callers use :func:`ring_allreduce_or_psum`."""
+    if num_devices <= 1:
+        return x
+    if 4 * int(np.prod(x.shape)) > RING_MAX_BYTES:
+        raise ValueError(
+            f"ring_allreduce: {x.shape} f32 exceeds the "
+            f"{RING_MAX_BYTES >> 20} MB VMEM-residency gate")
+    return _ring_flat(x, axis_name, num_devices, interpret,
+                      _RING_COLLECTIVE_ID)
 
 
 def ring_allreduce_or_psum(x: jnp.ndarray, axis_name: str,
@@ -203,6 +225,62 @@ def ring_allreduce_or_psum(x: jnp.ndarray, axis_name: str,
         return ring_allreduce(x, axis_name, num_devices,
                               interpret=interpret)
     return jax.lax.psum(x, axis_name)
+
+
+# -- voted-column ring: gather the candidate slab, ring only the slab --------
+
+
+def _gather_cand(hist: jnp.ndarray, cand: jnp.ndarray) -> jnp.ndarray:
+    """Gather the voted candidate columns: ``(f, B, 3)[cand (k2,)]`` →
+    ``(k2, B, 3)``, or the batched-frontier layout ``(m, f, B, 3)`` with
+    ``cand (m, k2)`` → ``(m, k2, B, 3)`` (m children share one launch)."""
+    if cand.ndim == 1:
+        return jnp.take(hist, cand, axis=0)
+    return jnp.take_along_axis(hist, cand[:, :, None, None], axis=1)
+
+
+def ring_allreduce_select(hist: jnp.ndarray, cand: jnp.ndarray,
+                          axis_name: str, num_devices: int,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Voted-column ring all-reduce (PV-Tree candidate reduction).
+
+    Gathers ``hist[cand]`` — the ``(k2, B, 3)`` voted-candidate slab of
+    a shard-LOCAL ``(f, B, 3)`` histogram, or the stacked ``(m, k2, B,
+    3)`` slab of a batched frontier — and runs ONLY the slab through the
+    chunked double-buffered ring schedule.  Same numerics contract as
+    :func:`ring_allreduce` (bit-identical to gather+psum at D=2,
+    ulp-rotated beyond), under its own Mosaic collective id so the dense
+    and voted rings never share a barrier.  Raises when the VMEM gate
+    refuses the slab — trace-safe callers use
+    :func:`ring_allreduce_select_or_psum`."""
+    slab = _gather_cand(hist, cand)
+    if num_devices <= 1:
+        return slab
+    if 4 * int(np.prod(slab.shape)) > RING_MAX_BYTES:
+        raise ValueError(
+            f"ring_allreduce_select: slab {slab.shape} f32 exceeds the "
+            f"{RING_MAX_BYTES >> 20} MB VMEM-residency gate")
+    return _ring_flat(slab, axis_name, num_devices, interpret,
+                      _SELECT_RING_COLLECTIVE_ID)
+
+
+def ring_allreduce_select_or_psum(hist: jnp.ndarray, cand: jnp.ndarray,
+                                  axis_name: str,
+                                  num_devices: int) -> jnp.ndarray:
+    """Trace-safe voted-column reduction: the select-ring when the
+    cached compile verdict and the VMEM gate allow it, gather +
+    ``lax.psum`` otherwise.  The Mosaic verdict is the dense ring's
+    (``ring_compile_supported``): the kernel is byte-for-byte the same
+    program, only the collective id differs, so one probe covers both."""
+    slab = _gather_cand(hist, cand)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    if (num_devices > 1
+            and 4 * int(np.prod(slab.shape)) <= RING_MAX_BYTES
+            and ring_compile_supported(interpret, probe=False)
+            is not False):
+        return _ring_flat(slab, axis_name, num_devices, interpret,
+                          _SELECT_RING_COLLECTIVE_ID)
+    return jax.lax.psum(slab, axis_name)
 
 
 # -- fused gather → segment histogram → ring all-reduce ----------------------

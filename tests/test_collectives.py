@@ -85,6 +85,114 @@ class TestRingAllreduce:
         assert np.all(np.asarray(out) == 0.0)
 
 
+class TestRingAllreduceSelect:
+    """The voted-column slab ring (ISSUE 16): gather `hist[cand]` then
+    reduce ONLY the `(k2, B, 3)` slab on the same chunked schedule.
+    Parity is pinned against gather-then-psum at the pow2 ladder the
+    dense ring ships with."""
+
+    @pytest.mark.parametrize("size", [2048, 4096, 8192, 16384])
+    def test_bucket_ladder_bit_parity(self, size, rng, mesh2):
+        from mmlspark_tpu.ops.pallas_collectives import (
+            ring_allreduce_select)
+        d, f, B = 2, 64, 64
+        k2 = max(2, size // (B * 3 * 4))  # slab elems track the ladder
+        hist = jax.device_put(
+            jnp.asarray(rng.normal(size=(d * f, B, 3)), jnp.float32),
+            NamedSharding(mesh2, P(DATA_AXIS, None, None)))
+        cand = jnp.asarray(
+            rng.choice(f, size=min(k2, f), replace=False), jnp.int32)
+        spec = P(DATA_AXIS, None, None)
+        out_spec = P(None, None, None)
+        got = np.asarray(_smap(
+            lambda h: ring_allreduce_select(h, cand, DATA_AXIS, d,
+                                            interpret=True),
+            mesh2, spec, out_spec)(hist))
+        want = np.asarray(_smap(
+            lambda h: jax.lax.psum(jnp.take(h, cand, axis=0), DATA_AXIS),
+            mesh2, spec, out_spec)(hist))
+        assert got.shape == (cand.shape[0], B, 3)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("d", [3, 8])
+    def test_larger_rings_allclose(self, d, rng):
+        from mmlspark_tpu.ops.pallas_collectives import (
+            ring_allreduce_select)
+        mesh = _data_mesh(d)
+        f, B, k2 = 31, 16, 10
+        hist = jax.device_put(
+            jnp.asarray(rng.normal(size=(d * f, B, 3)), jnp.float32),
+            NamedSharding(mesh, P(DATA_AXIS, None, None)))
+        cand = jnp.asarray(rng.choice(f, size=k2, replace=False),
+                           jnp.int32)
+        spec = P(DATA_AXIS, None, None)
+        out_spec = P(None, None, None)
+        got = np.asarray(_smap(
+            lambda h: ring_allreduce_select(h, cand, DATA_AXIS, d,
+                                            interpret=True),
+            mesh, spec, out_spec)(hist))
+        want = np.asarray(_smap(
+            lambda h: jax.lax.psum(jnp.take(h, cand, axis=0), DATA_AXIS),
+            mesh, spec, out_spec)(hist))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_batched_pair_slab(self, rng, mesh2):
+        """The batched-frontier layout: stacked (2, f, B, 3) hists with
+        per-leaf candidate rows reduce as one collective, bit-identical
+        to two separate gather-then-psum calls at D=2."""
+        from mmlspark_tpu.ops.pallas_collectives import (
+            ring_allreduce_select)
+        d, f, B, k2 = 2, 23, 32, 8
+        hist = jax.device_put(
+            jnp.asarray(rng.normal(size=(d * 2, f, B, 3)), jnp.float32),
+            NamedSharding(mesh2, P(DATA_AXIS, None, None, None)))
+        cand = jnp.asarray(
+            np.stack([rng.choice(f, size=k2, replace=False)
+                      for _ in range(2)]), jnp.int32)
+        spec = P(DATA_AXIS, None, None, None)
+        out_spec = P(None, None, None, None)
+        got = np.asarray(_smap(
+            lambda h: ring_allreduce_select(h, cand, DATA_AXIS, d,
+                                            interpret=True),
+            mesh2, spec, out_spec)(hist))
+        want = np.asarray(_smap(
+            lambda h: jax.lax.psum(
+                jnp.take_along_axis(h, cand[:, :, None, None], axis=1),
+                DATA_AXIS),
+            mesh2, spec, out_spec)(hist))
+        assert got.shape == (2, k2, B, 3)
+        np.testing.assert_array_equal(got, want)
+
+    def test_vmem_gate_and_or_psum_fallback(self, mesh2):
+        from mmlspark_tpu.ops import pallas_collectives as pc
+        hist = jnp.zeros((2 * 2048, 256, 3), jnp.float32)
+        cand = jnp.arange(1500, dtype=jnp.int32)  # slab > 4 MB
+        with pytest.raises(ValueError, match="VMEM-residency gate"):
+            _smap(lambda h: pc.ring_allreduce_select(
+                      h, cand, DATA_AXIS, 2, interpret=True),
+                  mesh2, P(DATA_AXIS, None, None), P(None, None, None))(
+                jax.device_put(hist, NamedSharding(
+                    mesh2, P(DATA_AXIS, None, None))))
+        out = _smap(lambda h: pc.ring_allreduce_select_or_psum(
+                        h, cand, DATA_AXIS, 2),
+                    mesh2, P(DATA_AXIS, None, None),
+                    P(None, None, None))(
+            jax.device_put(hist, NamedSharding(
+                mesh2, P(DATA_AXIS, None, None))))
+        assert out.shape == (1500, 256, 3)
+        assert np.all(np.asarray(out) == 0.0)
+
+    def test_serial_is_plain_gather(self, rng):
+        from mmlspark_tpu.ops.pallas_collectives import (
+            ring_allreduce_select)
+        hist = jnp.asarray(rng.normal(size=(9, 8, 3)), jnp.float32)
+        cand = jnp.asarray([4, 1, 7], jnp.int32)
+        out = ring_allreduce_select(hist, cand, DATA_AXIS, 1,
+                                    interpret=True)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(hist)[[4, 1, 7]])
+
+
 class TestFusedSegmentHistRing:
     """The gather→hist→ring kernel vs the gather→hist→psum reference, at
     the partition grower's real pow2 bucket ladder."""
@@ -197,7 +305,7 @@ class TestForestIdentity:
     psum references on the 2-device mesh — the dense ring behind dot16
     and the fully fused pallas_ring kernel both."""
 
-    def _fit(self, method, collective, mesh):
+    def _fit(self, method, collective, mesh, **kw):
         from mmlspark_tpu.gbdt import fit_bin_mapper
         from mmlspark_tpu.gbdt.engine import TrainParams, train
         from mmlspark_tpu.gbdt.objectives import get_objective
@@ -210,7 +318,8 @@ class TestForestIdentity:
                      TrainParams(num_iterations=3, num_leaves=7,
                                  min_data_in_leaf=5, max_bin=63,
                                  histogram_method=method,
-                                 collective=collective, verbosity=0),
+                                 collective=collective, verbosity=0,
+                                 **kw),
                      mesh=mesh)
 
     @staticmethod
@@ -232,6 +341,35 @@ class TestForestIdentity:
         a = self._fit("pallas_fused", "psum", mesh2_2axis)
         b = self._fit("pallas_ring", "ring", mesh2_2axis)
         self._assert_forests_equal(a, b)
+
+    def test_voting_ring_forest_identity(self, mesh2_2axis):
+        """ISSUE 16: voting-over-ring forests are bit-identical to
+        voting-over-psum at D=2 — the voted slab rides the select-ring
+        and pairwise adds commute."""
+        a = self._fit("dot16", "psum", mesh2_2axis,
+                      parallelism="voting", top_k=4)
+        b = self._fit("dot16", "ring", mesh2_2axis,
+                      parallelism="voting", top_k=4)
+        self._assert_forests_equal(a, b)
+
+    def test_voting_ring_uses_select_ring(self, mesh2_2axis,
+                                          monkeypatch):
+        """Guard against the voting fit silently staying on psum: the
+        select-ring entry must be traced during a voting ring fit."""
+        from mmlspark_tpu.ops import pallas_collectives as pc
+        calls = []
+        real = pc.ring_allreduce_select_or_psum
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(pc, "ring_allreduce_select_or_psum", spy)
+        # a distinct top_k keeps jit from replaying a cached trace
+        self._fit("dot16", "ring", mesh2_2axis,
+                  parallelism="voting", top_k=5)
+        assert calls, ("parallelism='voting' + collective='ring' never "
+                       "reached the select-ring")
 
     def test_ring_actually_rings(self, mesh2_2axis, monkeypatch):
         """Guard against a silent fall-through to psum making the parity
@@ -327,34 +465,72 @@ class TestResolutionAndFallback:
     def test_auto_collective_stays_psum(self, mesh2_2axis):
         from mmlspark_tpu.gbdt.engine import (TrainParams,
                                               _resolve_collective_cfg)
-        c, m = _resolve_collective_cfg(
+        c, m, why = _resolve_collective_cfg(
             TrainParams(collective="auto"), mesh2_2axis)
-        assert c == "psum" and m is mesh2_2axis
+        assert c == "psum" and m is mesh2_2axis and why == "none"
 
     def test_ring_excluded_paths_keep_psum(self, mesh2_2axis):
-        """dart / voting / ranking / feature-sharded layouts keep psum
-        (their scans bind the 2-axis mesh the ring cannot ride)."""
-        from mmlspark_tpu.core.mesh import build_mesh
+        """dart / ranking / feature-sharded layouts keep psum (their
+        scans bind the 2-axis mesh the ring cannot ride); each records
+        the downgrade reason.  Voting fits are no longer pinned — the
+        voted-column select-ring rides the same data-only mesh."""
+        from mmlspark_tpu.core.mesh import DATA_AXIS, build_mesh
         from mmlspark_tpu.gbdt.engine import (TrainParams,
                                               _resolve_collective_cfg)
-        for kw in (dict(boosting="dart"), dict(parallelism="voting")):
-            c, m = _resolve_collective_cfg(
-                TrainParams(collective="ring", **kw), mesh2_2axis)
-            assert c == "psum" and m is mesh2_2axis
-        c, m = _resolve_collective_cfg(
+        c, m, why = _resolve_collective_cfg(
+            TrainParams(collective="ring", boosting="dart"), mesh2_2axis)
+        assert c == "psum" and m is mesh2_2axis and why == "dart"
+        c, m, why = _resolve_collective_cfg(
             TrainParams(collective="ring"), mesh2_2axis, ranking=True)
-        assert c == "psum"
+        assert c == "psum" and why == "ranking"
         fmesh = build_mesh(data=1, feature=2, devices=jax.devices()[:2])
-        c, m = _resolve_collective_cfg(
+        c, m, why = _resolve_collective_cfg(
             TrainParams(collective="ring", parallelism="feature"), fmesh)
-        assert c == "psum"
+        assert c == "psum" and why in ("feature_axis", "single_data_shard")
+        # voting pin lifted: resolves to ring on a data-only mesh
+        c, m, why = _resolve_collective_cfg(
+            TrainParams(collective="ring", parallelism="voting"),
+            mesh2_2axis)
+        assert c == "ring" and why == "none"
+        assert tuple(m.axis_names) == (DATA_AXIS,)
 
     def test_ring_resolution_builds_data_only_mesh(self, mesh2_2axis):
         from mmlspark_tpu.core.mesh import DATA_AXIS, FEATURE_AXIS
         from mmlspark_tpu.gbdt.engine import (TrainParams,
                                               _resolve_collective_cfg)
-        c, m = _resolve_collective_cfg(
+        c, m, why = _resolve_collective_cfg(
             TrainParams(collective="ring"), mesh2_2axis)
-        assert c == "ring"
+        assert c == "ring" and why == "none"
         assert tuple(m.axis_names) == (DATA_AXIS,)
         assert FEATURE_AXIS not in dict(m.shape)
+
+    def test_downgrade_reason_recorded_and_exposed(self, mesh2_2axis):
+        """Satellite: a ring→psum downgrade is a log.info, but the
+        reason lands in last_fit_info AND the /metrics exposition."""
+        from mmlspark_tpu.gbdt import fit_bin_mapper
+        from mmlspark_tpu.gbdt.engine import (TrainParams, last_fit_info,
+                                              train)
+        from mmlspark_tpu.gbdt.objectives import get_objective
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(256, 6))
+        y = (X[:, 0] > 0).astype(np.float64)
+        mapper = fit_bin_mapper(X, max_bin=31)
+        bins = mapper.transform_packed(X)
+        train(bins, y, None, mapper, get_objective("binary"),
+              TrainParams(num_iterations=2, num_leaves=4,
+                          min_data_in_leaf=5, max_bin=31,
+                          boosting="dart", collective="ring",
+                          verbosity=0),
+              mesh=mesh2_2axis)
+        assert last_fit_info["collective"] == "psum"
+        assert last_fit_info["collective_downgrade"] == "dart"
+        from mmlspark_tpu.core import telemetry as tm
+        text = tm.get_registry().render_prometheus()
+        assert 'collective_downgrade="dart"' in text
+        # serial fits record the single-shard reason
+        train(bins, y, None, mapper, get_objective("binary"),
+              TrainParams(num_iterations=2, num_leaves=4,
+                          min_data_in_leaf=5, max_bin=31,
+                          collective="ring", verbosity=0))
+        assert last_fit_info["collective_downgrade"] == \
+            "single_data_shard"

@@ -69,9 +69,12 @@ def main():
                          "instead of the local formulations: "
                          "fused gather+hist+ring (pallas_ring) vs "
                          "fused-hist + ring_allreduce vs fused-hist + "
-                         "psum, per bucket size, on a data-only mesh "
-                         "over every visible device (needs >= 2; same "
-                         "in-program R-slope discipline)")
+                         "psum, plus the voted-payload column "
+                         "(voted+ring / voted+psum: reduce only the 2k "
+                         "candidate slab, ISSUE 16), per bucket size, "
+                         "on a data-only mesh over every visible device "
+                         "(needs >= 2; same in-program R-slope "
+                         "discipline)")
     args = ap.parse_args()
 
     import jax
@@ -215,7 +218,8 @@ def collective_sweep(args, backend):
     from mmlspark_tpu.core.mesh import DATA_AXIS
     from mmlspark_tpu.gbdt.distributed import _shard_map
     from mmlspark_tpu.ops.pallas_collectives import (
-        fused_ring_applicable, fused_segment_hist_ring, ring_allreduce)
+        fused_ring_applicable, fused_segment_hist_ring, ring_allreduce,
+        ring_allreduce_select)
     from mmlspark_tpu.ops.pallas_histogram import histogram_pallas_fused
 
     D = len(jax.devices())
@@ -271,6 +275,22 @@ def collective_sweep(args, backend):
                 histogram_pallas_fused(b, g, i, B, size,
                                        interpret=interpret), DATA_AXIS),
         }
+        # Voted-payload column (ISSUE 16): the PV-Tree candidate slab —
+        # reduce only 2k columns of the fused histogram, over the
+        # select-ring and over psum.  k2 is a representative 2*top_k for
+        # this feature count; the point of the column is the payload
+        # slope vs the dense variants above, not the exact k.
+        k2 = max(2, min(f, 2 * min(20, max(1, f // 2))))
+        cand = jnp.asarray(
+            np.sort(rng.choice(f, size=k2, replace=False)), jnp.int32)
+        variants["voted+ring"] = lambda b, g, i: ring_allreduce_select(
+            histogram_pallas_fused(b, g, i, B, size,
+                                   interpret=interpret),
+            cand, DATA_AXIS, D, interpret=interpret)
+        variants["voted+psum"] = lambda b, g, i: jax.lax.psum(
+            jnp.take(histogram_pallas_fused(b, g, i, B, size,
+                                            interpret=interpret),
+                     cand, axis=0), DATA_AXIS)
         times = dict(coll.get(str(size), {}))
         ref = None
         for name, fn in variants.items():
@@ -291,8 +311,14 @@ def collective_sweep(args, backend):
                 if ref is None:
                     ref = np.asarray(out)
                 else:
-                    err = float(np.max(np.abs(np.asarray(out) - ref)))
-                    scale = float(np.max(np.abs(ref))) or 1.0
+                    want = ref
+                    if name.startswith("voted"):
+                        # the voted slab is the dense reference gathered
+                        # at the candidate columns, per shard block
+                        want = ref.reshape(D, f, B, 3)[
+                            :, np.asarray(cand)].reshape(-1, B, 3)
+                    err = float(np.max(np.abs(np.asarray(out) - want)))
+                    scale = float(np.max(np.abs(want))) or 1.0
                     assert err / scale < 2e-2, f"{name} mismatch {err}"
                 jax.block_until_ready(pr(binsT, gh, idx))
                 best_r = best_1 = float("inf")

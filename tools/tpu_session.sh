@@ -72,6 +72,17 @@ step 1800 bash -c 'python bench.py --pass-through "histogram_method=pallas_ring 
 #     R-discipline applies (signal must clear the dispatch jitter)
 step 2400 python tools/sweep_histogram.py --collectives --reps 65
 
+# 4e. ISSUE 16 voted-column A/B: voting-parallel over the select-ring
+#     vs over psum, through the official wide-data bench shape, at the
+#     mesh sizes a real pod slice gives us (D=2, then D=4 if the lease
+#     holds).  The sweep's voted+ring/voted+psum columns (4d) give the
+#     per-reduce slope; these runs give end-to-end wall clock + the
+#     journaled payload counters on a real ICI ring.
+step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 2 --parallelism voting --skip-baseline | tee artifacts/bench_tpu_session_voted_d2.out'
+step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 2 --parallelism voting --skip-baseline --pass-through collective=psum | tee artifacts/bench_tpu_session_voted_d2_psum.out'
+step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 4 --parallelism voting --skip-baseline | tee artifacts/bench_tpu_session_voted_d4.out'
+step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 4 --parallelism voting --skip-baseline --pass-through collective=psum | tee artifacts/bench_tpu_session_voted_d4_psum.out'
+
 # 5. secondary BASELINE target: ImageFeaturizer imgs/sec on-chip
 step 900 bash -c 'python tools/bench_featurizer.py | tee artifacts/bench_featurizer_tpu.out'
 
