@@ -92,6 +92,19 @@ def main():
                          "host-platform device count before jax init")
     ap.add_argument("--top-k", type=int, default=32,
                     help="PV-Tree votes per shard (voting mode only)")
+    ap.add_argument("--quantized-grad", default="off",
+                    choices=("off", "16", "8"),
+                    help="quantized-gradient A/B (ISSUE 17): train with "
+                         "low-bit (g,h) grid codes and fold a same-config "
+                         "f32 twin fit, a histogram-build micro A/B at "
+                         "the committed pin, and vendored-dataset metric "
+                         "parity into detail")
+    ap.add_argument("--collective", default=None,
+                    choices=("auto", "psum", "ring"),
+                    help="override the distributed modes' collective "
+                         "(default: ring for data/voting); the quantized "
+                         "payload gate reads psum, whose wire slab is "
+                         "dtype-priced — the ring always moves f32 lanes")
     ap.add_argument("--skip-baseline", action="store_true",
                     help="skip the sklearn baseline (the wide-data A/B "
                          "compares our own modes, and sklearn at "
@@ -214,6 +227,8 @@ def run_bench(args, n, f, iters, leaves, result):
             # stays on its split-broadcast psum protocol
             kw["collective"] = "ring"
         kw["parallelism"] = args.parallelism
+        if args.collective:
+            kw["collective"] = args.collective
         if args.parallelism == "voting":
             kw["topK"] = args.top_k
         # leaf-wise trees never exceed depth numLeaves-1, so this pin is
@@ -222,6 +237,9 @@ def run_bench(args, n, f, iters, leaves, result):
         # well-defined (count == numLeaves == maxDepth + 1)
         kw["maxDepth"] = leaves - 1
         result["detail"].update(devices=D, max_depth=leaves - 1)
+    if args.quantized_grad != "off":
+        kw["quantizedGrad"] = args.quantized_grad
+        result["detail"]["quantized_grad"] = args.quantized_grad
     if args.pass_through:
         kw["passThroughArgs"] = args.pass_through
         result["detail"]["pass_through"] = args.pass_through
@@ -270,6 +288,187 @@ def run_bench(args, n, f, iters, leaves, result):
     result["detail"].update(our_wall_s=round(our_time, 3),
                             our_runs=[round(t, 3) for t in our_times],
                             our_train_auc=round(float(our_auc), 5))
+
+    if args.quantized_grad != "off":
+        _quantized_ab(args, kw, mesh, iters, X, y, result)
+
+
+def _quantized_ab(args, kw, mesh, iters, X, y, result):
+    """Fold the ISSUE 17 acceptance numbers into ``detail``:
+
+    * ``quantized_vs_f32`` — a same-config f32 twin fit: wall clock,
+      train AUC and the journaled per-tree collective payload, so
+      ``payload_ratio`` (quantized / f32 bytes on the wire) is
+      machine-checkable straight off the artifact.
+    * ``hist_build`` — the histogram-build micro A/B at the committed
+      pin (32768 x 50, 256 bins, 8-bit grid): min-of-9 build time for
+      f32 gh vs int16 grid codes through the same resolved kernel.
+    * ``parity`` — eval-metric relative deltas (quantized vs f32) on
+      the REAL vendored datasets under tests/benchmarks/data/.
+    """
+    import time
+
+    import numpy as np
+    from sklearn.metrics import roc_auc_score
+
+    from mmlspark_tpu.gbdt import LightGBMClassifier
+    from mmlspark_tpu.gbdt import engine as _engine
+
+    log("quantized A/B: f32 twin fit...")
+    kw_f32 = dict(kw)
+    kw_f32["quantizedGrad"] = "off"
+
+    def fit_f32():
+        est = LightGBMClassifier(numIterations=iters, **kw_f32)
+        if mesh is not None:
+            est = est.setMesh(mesh)
+        return est.fit({"features": X, "label": y})
+
+    fit_f32()                                   # warm-up / compile
+    t0 = time.perf_counter()
+    model_f32 = fit_f32()
+    f32_wall = time.perf_counter() - t0
+    f32_info = dict(_engine.last_fit_info)
+    out = model_f32.transform({"features": X, "label": y})
+    f32_auc = roc_auc_score(y, np.asarray(out["probability"])[:, 1])
+    ab = {"f32_wall_s": round(f32_wall, 3),
+          "f32_train_auc": round(float(f32_auc), 5),
+          "quant_train_auc": result["detail"]["our_train_auc"],
+          "auc_rel_delta": round(
+              abs(result["detail"]["our_train_auc"] - float(f32_auc))
+              / max(abs(float(f32_auc)), 1e-12), 6)}
+    qp = result["detail"].get("collective_payload_bytes_per_tree")
+    fp = f32_info.get("collective_payload_bytes_per_tree")
+    if qp is not None and fp is not None and int(fp) > 0:
+        ab.update(payload_bytes_per_tree_quant=int(qp),
+                  payload_bytes_per_tree_f32=int(fp),
+                  payload_ratio=round(int(qp) / int(fp), 6))
+    result["detail"]["quantized_vs_f32"] = ab
+    log(f"quantized A/B: f32 twin {f32_wall:.2f}s "
+        f"AUC={f32_auc:.4f} payload ratio="
+        f"{ab.get('payload_ratio', 'n/a')}")
+    result["detail"]["hist_build"] = _hist_build_micro()
+    result["detail"]["parity"] = _vendored_parity(args.quantized_grad)
+
+
+def _hist_build_micro():
+    """Histogram-build micro A/B at the committed pin: one (n, f) bin
+    matrix, f32 ``(g, h, 1)`` vs int16 grid codes at ``|code| <= 127``
+    (the 8-bit grid — the packed-int64 single-add native mode), through
+    whatever kernel ``method='auto'``-equivalent dispatch resolves for
+    each dtype.  Min-of-9 on both sides."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.ops import histogram as H
+
+    n, f, B, mc = 32768, 50, 256, 127
+    rng = np.random.default_rng(3)
+    bins = jnp.asarray(rng.integers(0, B, size=(n, f), dtype=np.uint8))
+    ghf = jnp.asarray(np.stack([rng.normal(size=n),
+                                np.abs(rng.normal(size=n)),
+                                np.ones(n)], 1), jnp.float32)
+    codes = rng.integers(-mc, mc + 1, size=(n, 2))
+    ghq = jnp.asarray(np.concatenate([codes, np.ones((n, 1))], 1),
+                      jnp.int16)
+    method = "native" if H._native_available() and B <= 256 else "segment"
+    f32_fn = jax.jit(lambda b, g: H.compute_histogram(b, g, B,
+                                                      method=method))
+    q_fn = jax.jit(lambda b, g: H.compute_histogram(b, g, B,
+                                                    method=method,
+                                                    max_code=mc))
+
+    def best(fn, b, g):
+        fn(b, g).block_until_ready()            # compile
+        ts = []
+        for _ in range(9):
+            t0 = time.perf_counter()
+            fn(b, g).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    tf, tq = best(f32_fn, bins, ghf), best(q_fn, bins, ghq)
+    out = {"rows": n, "features": f, "bins": B, "max_code": mc,
+           "method": method,
+           "packed_accum": bool(H.packed_accum_ok(n, mc)),
+           "f32_build_ms": round(tf * 1e3, 3),
+           "quant_build_ms": round(tq * 1e3, 3),
+           "speedup": round(tf / tq, 4)}
+    log(f"hist build micro [{method}]: f32 {tf*1e3:.2f}ms vs "
+        f"int {tq*1e3:.2f}ms -> {tf/tq:.2f}x")
+    return out
+
+
+def _vendored_parity(quantized_grad):
+    """Quantized-vs-f32 eval parity on the REAL vendored datasets
+    (tests/benchmarks/data): held-out AUC for the breast-cancer binary
+    task, held-out RMSE for the diabetes regression — relative deltas
+    the acceptance gate reads."""
+    import gzip
+
+    import numpy as np
+    from sklearn.metrics import roc_auc_score
+
+    from mmlspark_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tests", "benchmarks", "data")
+
+    def load(name):
+        with gzip.open(os.path.join(data_dir, name), "rt") as fh:
+            fh.readline()
+            rows = np.asarray([[float(v) for v in line.split(",")]
+                               for line in fh])
+        return rows[:, :-1].astype(np.float32), rows[:, -1]
+
+    out = []
+    X, y = load("breast_cancer.csv.gz")
+    idx = np.random.default_rng(7).permutation(len(y))
+    tr, te = idx[:400], idx[400:]
+    aucs = {}
+    # lr=0.05: parity configs boost gently so the comparison measures
+    # the quantization grid, not single near-tie split flips that a
+    # 0.1-rate trajectory amplifies on a 569-row table
+    for qg in ("off", quantized_grad):
+        m = LightGBMClassifier(numIterations=150, numLeaves=15,
+                               learningRate=0.05, minDataInLeaf=10,
+                               verbosity=0, seed=42,
+                               quantizedGrad=qg).fit(
+            {"features": X[tr], "label": y[tr]})
+        pred = m.transform({"features": X[te]})
+        aucs[qg] = float(roc_auc_score(
+            y[te], np.asarray(pred["probability"])[:, 1]))
+    out.append({"dataset": "breast_cancer", "metric": "auc",
+                "f32": round(aucs["off"], 5),
+                "quant": round(aucs[quantized_grad], 5),
+                "rel_delta": round(
+                    abs(aucs[quantized_grad] - aucs["off"])
+                    / max(abs(aucs["off"]), 1e-12), 6)})
+    X, y = load("diabetes.csv.gz")
+    idx = np.random.default_rng(8).permutation(len(y))
+    tr, te = idx[:310], idx[310:]
+    rmses = {}
+    for qg in ("off", quantized_grad):
+        m = LightGBMRegressor(numIterations=120, numLeaves=7,
+                              learningRate=0.05, minDataInLeaf=10,
+                              verbosity=0, seed=42,
+                              quantizedGrad=qg).fit(
+            {"features": X[tr], "label": y[tr]})
+        pred = np.asarray(m.transform({"features": X[te]})["prediction"])
+        rmses[qg] = float(np.sqrt(np.mean((pred - y[te]) ** 2)))
+    out.append({"dataset": "diabetes", "metric": "rmse",
+                "f32": round(rmses["off"], 4),
+                "quant": round(rmses[quantized_grad], 4),
+                "rel_delta": round(
+                    abs(rmses[quantized_grad] - rmses["off"])
+                    / max(abs(rmses["off"]), 1e-12), 6)})
+    for row in out:
+        log(f"parity {row['dataset']}: f32 {row['f32']} vs quant "
+            f"{row['quant']} (rel delta {row['rel_delta']})")
+    return out
 
 
 if __name__ == "__main__":

@@ -156,6 +156,16 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
                        "on-chip ring reduce-scatter/all-gather; "
                        "docs/collectives.md)", default="auto",
                        typeConverter=TypeConverters.toString)
+    quantizedGrad = Param(
+        "quantizedGrad",
+        "Quantized-gradient training (LightGBM use_quantized_grad "
+        "analog): 'off' keeps f32 gradients; '16'/'8' discretize (g,h) "
+        "per boost round onto a seeded stochastically-rounded integer "
+        "grid, accumulate histograms in int32 and cross shards in the "
+        "narrowest wire dtype the row count admits "
+        "(docs/collectives.md).  Gains still evaluate in f32.  "
+        "gbdt/goss/rf only; dart and ranking fits fall back to f32",
+        default="off", typeConverter=TypeConverters.toString)
     categoricalSlotIndexes = Param(
         "categoricalSlotIndexes",
         "Feature indexes treated as categorical (reference "
@@ -244,6 +254,7 @@ class LightGBMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol,
             drop_seed=self.getDropSeed(),
             histogram_method=self.getHistogramMethod(),
             collective=self.getCollective(),
+            quantized_grad=self.getQuantizedGrad(),
             verbosity=self.getVerbosity(),
             parallelism=self.getParallelism(),
             top_k=self.getTopK(),

@@ -98,6 +98,58 @@ def _resolve_collective_cfg(params: "TrainParams", mesh, *,
     return "psum", mesh, "compile_probe"
 
 
+def _resolve_quantized(params: "TrainParams", n: int, mesh,
+                       collective: str, *, ranking: bool = False):
+    """Resolve ``params.quantized_grad`` → ``(bits, max_code, wire,
+    collective, downgrade)`` (ISSUE 17).
+
+    ``max_code`` is the per-round grid half-width: ``2^(bits-1)-1``
+    clamped so ``n * max_code`` (the largest magnitude any int32
+    histogram cell can reach — every row in one bin) keeps int32
+    headroom.  ``wire`` is the dtype the psum slab crosses the
+    interconnect in: the narrowest int that the SAME ``n * max_code``
+    bound fits — int8/int16 when it already fits, else the grid is
+    CLAMPED to make int16 fit when at least 3 code levels survive
+    (payload beats resolution for histogram work; LightGBM's quantized
+    training uses 2-5 bit grids), else int32.  Serial fits have no
+    wire.  Paths the quantized grower doesn't support (dart's host
+    rescale loop, lambdarank) and a ring whose f32 lane can't carry
+    the codes exactly (``n * max_code >= 2^24``) degrade — quantization
+    off or ring→psum respectively — with reason
+    ``quantized_unsupported`` for ``last_fit_info`` and /metrics."""
+    if params.quantized_grad == "off":
+        return 0, 0, "none", collective, "none"
+    if ranking or params.boosting == "dart":
+        log.info("quantizedGrad=%s needs a gbdt/goss/rf fit (dart's "
+                 "host loop and lambdarank keep f32 gradients); "
+                 "quantization is off for this fit "
+                 "(quantized_unsupported)", params.quantized_grad)
+        return 0, 0, "none", collective, "quantized_unsupported"
+    bits = int(params.quantized_grad)
+    mc = min((1 << (bits - 1)) - 1, (2**31 - 1) // max(n, 1))
+    from ..core.mesh import DATA_AXIS
+    d = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+    if d <= 1:
+        return bits, mc, "none", collective, "none"
+    if n * mc <= 127:
+        wire = "int8"
+    elif n * mc <= 32767:
+        wire = "int16"
+    elif 32767 // max(n, 1) >= 3:
+        mc = 32767 // n
+        wire = "int16"
+    else:
+        wire = "int32"
+    downgrade = "none"
+    if collective == "ring" and n * mc >= (1 << 24):
+        log.info("collective='ring' carries histograms in f32 lanes; "
+                 "quantized codes up to n*max_code=%d cannot ride it "
+                 "exactly — this fit keeps psum (quantized_unsupported)",
+                 n * mc)
+        collective, downgrade = "psum", "quantized_unsupported"
+    return bits, mc, wire, collective, downgrade
+
+
 #: What the LAST fit in this process actually ran (resolved histogram
 #: kernel + collective + backend) — bench.py records it for provenance,
 #: and the /metrics exposition below surfaces it as an info gauge.
@@ -106,12 +158,17 @@ last_fit_info: Dict[str, str] = {}
 
 def _record_fit_resolution(cfg, collective: str,
                            downgrade: str = "none",
-                           sched: Optional[dict] = None) -> None:
+                           sched: Optional[dict] = None,
+                           quantized_downgrade: str = "none") -> None:
     last_fit_info.clear()
     last_fit_info.update(histogram_method=cfg.hist_method,
                          collective=collective,
                          collective_downgrade=downgrade,
-                         backend=jax.default_backend())
+                         backend=jax.default_backend(),
+                         quantized_bits=str(cfg.quantized_bits),
+                         quantized_max_code=str(cfg.quantized_max_code),
+                         quantized_wire=cfg.quantized_wire,
+                         quantized_downgrade=quantized_downgrade)
     if sched is not None:
         # static per-tree collective accounting (grower.
         # collective_schedule) — bench.py folds these into the artifact
@@ -122,6 +179,9 @@ def _record_fit_resolution(cfg, collective: str,
             collective_payload_bytes_per_tree=str(sched["payload_bytes"]),
             collective_payload_vs_dense=(
                 f"{sched['payload_bytes'] / dense:.6f}"))
+        if sched.get("quantized_scale_bytes"):
+            last_fit_info.update(quantized_scale_bytes_per_tree=str(
+                sched["quantized_scale_bytes"]))
 
 
 def _collective_sched_for(cfg, mesh, n: int, f: int) -> dict:
@@ -187,6 +247,14 @@ class TrainParams:
     #: pack four uint8 bins per u32 word for the per-split segment gather
     #: (grower.GrowerConfig.packed_gather); measured knob, default off
     packed_gather: bool = False
+    #: quantized-gradient training (ISSUE 17; Shi et al. 2022, LightGBM
+    #: use_quantized_grad): "off" keeps f32 gradients; "16"/"8"
+    #: discretize (g, h) each boost round onto a seeded
+    #: stochastically-rounded int grid, accumulate histograms in int32,
+    #: and cross shards in the narrowest wire dtype the row count
+    #: admits (``_resolve_quantized``).  Split gains dequantize back to
+    #: f32, so the math of the gain formula is unchanged.
+    quantized_grad: str = "off"
     verbosity: int = 1
     #: categorical split knobs (LightGBM names)
     cat_smooth: float = 10.0
@@ -262,6 +330,13 @@ class TrainParams:
                     f"passThroughArgs {k}={v!r} cannot be coerced to "
                     f"{type(cur).__name__}: {e}") from None
             setattr(self, k, val)
+        qg = str(self.quantized_grad).strip().lower()
+        self.quantized_grad = {"": "off", "0": "off", "false": "off",
+                               "none": "off"}.get(qg, qg)
+        if self.quantized_grad not in ("off", "8", "16"):
+            raise ValueError(
+                f"quantizedGrad={self.quantized_grad!r} is not supported; "
+                "valid: off, 16, 8")
 
 
 @functools.partial(jax.jit, static_argnames=("obj", "cfg", "lr"),
@@ -349,6 +424,32 @@ def _fit_resolution_exposition() -> str:
 
 _tm.get_registry().register_exposition("train_histogram_method",
                                        _fit_resolution_exposition)
+
+
+def _quantized_exposition() -> str:
+    """Prometheus info gauge naming the quantized-gradient resolution of
+    the last fit (ISSUE 17): grid bits, max code after headroom clamps,
+    the wire dtype psum slabs cross shards in, and whether a downgrade
+    fired — so /metrics answers "is training actually running low-bit,
+    and how low" without log spelunking."""
+    if not last_fit_info:
+        return ""
+    keys = ("quantized_bits", "quantized_max_code", "quantized_wire",
+            "quantized_downgrade")
+    labels = ",".join(
+        f'{k[len("quantized_"):]}="{last_fit_info[k]}"'
+        for k in keys if k in last_fit_info)
+    if not labels:
+        return ""
+    name = "mmlspark_tpu_train_quantized_info"
+    return (f"# HELP {name} Quantized-gradient resolution of the last "
+            "fit\n"
+            f"# TYPE {name} gauge\n"
+            f"{name}{{{labels}}} 1\n")
+
+
+_tm.get_registry().register_exposition("train_quantized",
+                                       _quantized_exposition)
 
 
 def _ckpt_event(name: str, **fields) -> None:
@@ -1516,6 +1617,8 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
     use_voting = params.parallelism == "voting"
     collective, mesh, coll_downgrade = _resolve_collective_cfg(
         params, mesh, ranking=ranking_info is not None)
+    qbits, qmc, qwire, collective, qdown = _resolve_quantized(
+        params, n, mesh, collective, ranking=ranking_info is not None)
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
         num_bins=mapper.num_total_bins, lambda_l1=params.lambda_l1,
@@ -1529,9 +1632,12 @@ def _train_impl(bins: np.ndarray, labels: np.ndarray,
         use_categorical=mapper.has_categorical,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
         max_cat_threshold=params.max_cat_threshold,
-        max_cat_to_onehot=params.max_cat_to_onehot)
+        max_cat_to_onehot=params.max_cat_to_onehot,
+        quantized_bits=qbits, quantized_seed=params.seed,
+        quantized_max_code=qmc, quantized_wire=qwire)
     coll_sched = _collective_sched_for(cfg, mesh, n, f)
-    _record_fit_resolution(cfg, collective, coll_downgrade, coll_sched)
+    _record_fit_resolution(cfg, collective, coll_downgrade, coll_sched,
+                           quantized_downgrade=qdown)
 
     if params.boosting not in ("gbdt", "goss", "dart", "rf"):
         raise NotImplementedError(
@@ -2210,6 +2316,9 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
 
     collective, mesh, coll_downgrade = _resolve_collective_cfg(
         params, mesh, ranking=ranking_info is not None)
+    qbits, qmc, qwire, collective, qdown = _resolve_quantized(
+        params, sum(sizes), mesh, collective,
+        ranking=ranking_info is not None)
     cfg = GrowerConfig(
         num_leaves=params.num_leaves, max_depth=params.max_depth,
         num_bins=mapper.num_total_bins, lambda_l1=params.lambda_l1,
@@ -2223,13 +2332,16 @@ def _train_distributed_sharded(bins_shards, label_shards, weight_shards,
         use_categorical=mapper.has_categorical,
         cat_smooth=params.cat_smooth, cat_l2=params.cat_l2,
         max_cat_threshold=params.max_cat_threshold,
-        max_cat_to_onehot=params.max_cat_to_onehot)
+        max_cat_to_onehot=params.max_cat_to_onehot,
+        quantized_bits=qbits, quantized_seed=params.seed,
+        quantized_max_code=qmc, quantized_wire=qwire)
 
     from .budget import check_fit_budget
     f_sh = next(b.shape[1] for b in bins_shards if b is not None)
     _record_fit_resolution(
         cfg, collective, coll_downgrade,
-        _collective_sched_for(cfg, mesh, sum(sizes), f_sh))
+        _collective_sched_for(cfg, mesh, sum(sizes), f_sh),
+        quantized_downgrade=qdown)
     _bagging = params.bagging_freq > 0 and params.bagging_fraction < 1.0
     _chunk = params.num_iterations
     if _bagging:

@@ -135,6 +135,20 @@ class GrowerConfig:
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
+    #: quantized-gradient training (ISSUE 17; Shi et al. NeurIPS 2022,
+    #: LightGBM ``use_quantized_grad``): discretize each round's (g, h)
+    #: to a symmetric integer grid with seeded stochastic rounding and
+    #: accumulate EXACT int32 histograms — the sibling subtraction
+    #: becomes bit-exact in integers and the cross-shard reduces carry
+    #: low-bit slabs.  0 = off; 8/16 = grid bits.  Resolved by the
+    #: engine (_resolve_quantized): ``quantized_max_code`` is the
+    #: clamped max |code| (grid half-width, possibly narrowed so the
+    #: accumulated slab fits the wire dtype) and ``quantized_wire`` the
+    #: psum slab dtype ("none" serial, else "int8"/"int16"/"int32").
+    quantized_bits: int = 0
+    quantized_seed: int = 0
+    quantized_max_code: int = 0
+    quantized_wire: str = "none"
 
     @property
     def cat_words(self) -> int:
@@ -373,21 +387,77 @@ def _is_voting(cfg: GrowerConfig) -> bool:
     return cfg.axis_name is not None and cfg.voting_k > 0
 
 
+def _is_quantized(cfg: GrowerConfig) -> bool:
+    return cfg.quantized_bits > 0 and cfg.quantized_max_code > 0
+
+
+def _quantize_gh(gh, cfg: GrowerConfig):
+    """Discretize the round's ``(n, 3)`` float gh triple to integer grid
+    codes with seeded stochastic rounding (ISSUE 17 tentpole).
+
+    The grid scale comes from the round's GLOBAL max-abs (``pmax`` under
+    a data mesh, so every shard quantizes on the identical grid and the
+    reduced integer histograms are exact sums of exact codes).  SR —
+    ``floor(x) + (u < frac(x))`` — keeps the code expectation unbiased;
+    the PRNG key folds the g-scale's bit pattern into
+    ``cfg.quantized_seed``, so the same seed + data is bit-reproducible
+    while every boost round draws fresh noise.  The count channel is the
+    0/1 bag mask and casts exactly.  Returns ``(codes (n, 3) int32,
+    scale (3,) f32)`` with ``codes * scale`` the dequantization."""
+    mc = cfg.quantized_max_code
+    gmax = jnp.max(jnp.abs(gh[:, 0]))
+    hmax = jnp.max(jnp.abs(gh[:, 1]))
+    if cfg.axis_name is not None and cfg.data_axis_size > 1:
+        gmax = jax.lax.pmax(gmax, cfg.axis_name)
+        hmax = jax.lax.pmax(hmax, cfg.axis_name)
+    gs = jnp.maximum(gmax, jnp.float32(1e-30)) / mc
+    hs = jnp.maximum(hmax, jnp.float32(1e-30)) / mc
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(cfg.quantized_seed),
+        jax.lax.bitcast_convert_type(gmax.astype(jnp.float32), jnp.int32))
+    u = jax.random.uniform(key, (gh.shape[0], 2))
+    x = gh[:, :2] / jnp.stack([gs, hs])[None, :]
+    lo = jnp.floor(x)
+    code = lo + (u < (x - lo)).astype(jnp.float32)
+    code = jnp.clip(code, -mc, mc).astype(jnp.int32)
+    codes = jnp.concatenate(
+        [code, gh[:, 2:3].astype(jnp.int32)], axis=1)
+    scale = jnp.stack([gs, hs, jnp.float32(1.0)])
+    return codes, scale
+
+
+def _wire_cast_psum(h, cfg: GrowerConfig):
+    """psum an integer histogram slab at the resolved wire width: the
+    engine's headroom analysis (_resolve_quantized) guarantees the
+    GLOBAL accumulated magnitude fits the narrow dtype, so the slab
+    rides the all-reduce at 1 or 2 bytes/element instead of 4 and the
+    sum is still exact."""
+    if (cfg.quantized_wire in ("int8", "int16")
+            and jnp.issubdtype(h.dtype, jnp.integer)):
+        wt = jnp.int8 if cfg.quantized_wire == "int8" else jnp.int16
+        return jax.lax.psum(h.astype(wt), cfg.axis_name).astype(h.dtype)
+    return jax.lax.psum(h, cfg.axis_name)
+
+
 def _reduce_hist(h, cfg: GrowerConfig):
     """Cross-shard reduction of a local histogram: ``lax.psum`` or the
     on-chip Pallas ring (ops/pallas_collectives.py) per
     ``cfg.collective``.  The ring entry is trace-safe — it consults only
     the cached Mosaic verdict and falls back to psum when the kernel is
-    unavailable or the VMEM gate refuses the state."""
+    unavailable or the VMEM gate refuses the state.  Integer (quantized)
+    slabs ride the psum at the resolved wire width; the ring's f32 lanes
+    round-trip integer sums exactly below 2^24, which the engine's
+    resolve gate guarantees before leaving ring enabled."""
     if cfg.collective == "ring" and cfg.data_axis_size > 1:
         from ..ops.pallas_collectives import ring_allreduce_or_psum
         return ring_allreduce_or_psum(h, cfg.axis_name,
                                       cfg.data_axis_size)
-    return jax.lax.psum(h, cfg.axis_name)
+    return _wire_cast_psum(h, cfg)
 
 
 def _hist(bins, gh, cfg: GrowerConfig, efb: Optional[EFBArrays] = None):
-    h = compute_histogram(bins, gh, cfg.num_bins, method=cfg.hist_method)
+    h = compute_histogram(bins, gh, cfg.num_bins, method=cfg.hist_method,
+                          max_code=cfg.quantized_max_code)
     if efb is not None:
         # bins holds G bundle columns; expand to per-feature histograms
         # BEFORE any psum — expansion is linear (static gather + a
@@ -420,7 +490,7 @@ def _reduce_select(hist_local, cand, cfg: GrowerConfig):
         return ring_allreduce_select_or_psum(hist_local, cand,
                                              cfg.axis_name,
                                              cfg.data_axis_size)
-    return jax.lax.psum(_take_cand(hist_local, cand), cfg.axis_name)
+    return _wire_cast_psum(_take_cand(hist_local, cand), cfg)
 
 
 def _voting_masks(feat_info, depth_ok, cfg: GrowerConfig):
@@ -513,7 +583,8 @@ def _voting_decide(hist_cand, cand, pg, ph, pc, feat_info, depth_ok,
 
 
 def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
-                           feat_info, depth_ok, cfg: GrowerConfig):
+                           feat_info, depth_ok, cfg: GrowerConfig,
+                           deq=None):
     """PV-Tree split finding (Meng et al. 2016; LightGBM
     tree_learner=voting): each data shard scores every feature on its
     LOCAL histogram against its LOCAL totals, votes its top-k features,
@@ -527,41 +598,54 @@ def find_best_split_voting(hist_local, parent_g, parent_h, parent_c,
     the exact sorted-subset search over the reduced candidate
     histograms — same two-phase shape as the numeric path.
     Returns the same tuple as :func:`find_best_split`.
+
+    ``deq`` (quantized-gradient mode): the votes and the decision run on
+    DEQUANTIZED f32 histograms, but the candidate slab crosses the wire
+    RAW — the low-bit integer codes ride :func:`_reduce_select` and only
+    the reduced slab is dequantized.
     """
     f = hist_local.shape[0]
     num_mask, cat_allowed = _voting_masks(feat_info, depth_ok, cfg)
     # 1. local votes  2. global candidates  3. exact decision over the
     # reduced (k2, B, 3) candidate slab
-    votes = _voting_votes(hist_local, feat_info, depth_ok, num_mask,
-                          cat_allowed, cfg)
+    votes = _voting_votes(deq(hist_local) if deq else hist_local,
+                          feat_info, depth_ok, num_mask, cat_allowed, cfg)
     votes_all = jax.lax.all_gather(votes, cfg.axis_name)        # (S, k)
     cand = _voting_candidates(votes_all.reshape(-1), f, cfg)
     hist_cand = _reduce_select(hist_local, cand, cfg)           # (k2, B, 3)
+    if deq is not None:
+        hist_cand = deq(hist_cand)
     return _voting_decide(hist_cand, cand, parent_g, parent_h, parent_c,
                           feat_info, depth_ok, num_mask, cat_allowed, cfg)
 
 
 def find_best_split_voting_pair(hist_l, hist_r, tot_l, tot_r, feat_info,
-                                depth_ok, cfg: GrowerConfig):
+                                depth_ok, cfg: GrowerConfig, deq=None):
     """Batched-frontier voting for the two children of one grow step:
     both children's votes ride ONE allgather and both candidate slabs
     ONE ``(2, k2, B, 3)`` reduction, so the collective count per grow
     step is 1 candidate reduce instead of 2 — O(depth)-shaped instead of
     O(leaves)-shaped when ``num_leaves ≤ max_depth + 1``.  The stacked
     reduce is element-wise, so results are BIT-IDENTICAL to two
-    independent :func:`find_best_split_voting` calls."""
+    independent :func:`find_best_split_voting` calls.  ``deq`` as in
+    :func:`find_best_split_voting` — the stacked slab crosses the wire
+    as raw integer codes and is dequantized after the reduction."""
     f = hist_l.shape[0]
     num_mask, cat_allowed = _voting_masks(feat_info, depth_ok, cfg)
+    hl_v = deq(hist_l) if deq else hist_l
+    hr_v = deq(hist_r) if deq else hist_r
     votes = jnp.stack([
-        _voting_votes(hist_l, feat_info, depth_ok, num_mask, cat_allowed,
+        _voting_votes(hl_v, feat_info, depth_ok, num_mask, cat_allowed,
                       cfg),
-        _voting_votes(hist_r, feat_info, depth_ok, num_mask, cat_allowed,
+        _voting_votes(hr_v, feat_info, depth_ok, num_mask, cat_allowed,
                       cfg)])
     votes_all = jax.lax.all_gather(votes, cfg.axis_name)     # (S, 2, k)
     cand_l = _voting_candidates(votes_all[:, 0].reshape(-1), f, cfg)
     cand_r = _voting_candidates(votes_all[:, 1].reshape(-1), f, cfg)
     slab = _reduce_select(jnp.stack([hist_l, hist_r]),
                           jnp.stack([cand_l, cand_r]), cfg)  # (2,k2,B,3)
+    if deq is not None:
+        slab = deq(slab)
     res_l = _voting_decide(slab[0], cand_l, *tot_l, feat_info, depth_ok,
                            num_mask, cat_allowed, cfg)
     res_r = _voting_decide(slab[1], cand_r, *tot_r, feat_info, depth_ok,
@@ -665,7 +749,8 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
     from ..ops.histogram import native_segment_hist
     if cfg.hist_method in ("auto", "native"):
         fused = native_segment_hist(bins, gh, row_order, off, cnt,
-                                    cfg.num_bins)
+                                    cfg.num_bins,
+                                    max_code=cfg.quantized_max_code)
         if fused is not None:
             return fused
     if (cfg.hist_method in ("pallas_fused", "pallas_ring")
@@ -685,6 +770,8 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
                 is not False):
 
             f_out = bins.shape[1]
+            accum = ("int32" if jnp.issubdtype(gh.dtype, jnp.integer)
+                     else "float32")
 
             def make_f(size):
                 def fn(_):
@@ -692,12 +779,12 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
                     valid = jnp.arange(size, dtype=jnp.int32) < cnt
                     rows = jnp.minimum(seg, n - 1)
                     gh_sub = jnp.take(gh, rows, axis=0) * \
-                        valid.astype(jnp.float32)[:, None]
+                        valid.astype(gh.dtype)[:, None]
                     # binsT arrives pre-padded to the 8-feature fold
                     # (see _grow_tree_impl); slice back to real columns
                     return histogram_pallas_fused(
                         binsT, gh_sub, rows, cfg.num_bins, size,
-                        interpret=interp)[:f_out]
+                        accum=accum, interpret=interp)[:f_out]
                 return fn
 
             branch = jnp.searchsorted(jnp.asarray(sizes, jnp.int32), cnt,
@@ -720,9 +807,10 @@ def _segment_hist(bins, gh, row_order, off, cnt, n, sizes,
             else:
                 b_sub = jnp.take(bins, rows, axis=0)
             gh_sub = jnp.take(gh, rows, axis=0) * \
-                valid.astype(jnp.float32)[:, None]
+                valid.astype(gh.dtype)[:, None]
             return compute_histogram(b_sub, gh_sub, cfg.num_bins,
-                                     method=cfg.hist_method)
+                                     method=cfg.hist_method,
+                                     max_code=cfg.quantized_max_code)
         return fn
 
     branch = jnp.searchsorted(jnp.asarray(sizes, jnp.int32), cnt,
@@ -765,6 +853,8 @@ def _segment_hist_dist(bins, gh, row_order, off, cnt, n, sizes,
                 is not False):
             f_out = bins.shape[1]
             cnt_g = jax.lax.pmax(cnt, cfg.axis_name)
+            accum = ("int32" if jnp.issubdtype(gh.dtype, jnp.integer)
+                     else "float32")
 
             def make_f(size):
                 def fn(_):
@@ -772,11 +862,11 @@ def _segment_hist_dist(bins, gh, row_order, off, cnt, n, sizes,
                     valid = jnp.arange(size, dtype=jnp.int32) < cnt
                     rows = jnp.minimum(seg, n - 1)
                     gh_sub = jnp.take(gh, rows, axis=0) * \
-                        valid.astype(jnp.float32)[:, None]
+                        valid.astype(gh.dtype)[:, None]
                     return fused_segment_hist_ring(
                         binsT, gh_sub, rows, cfg.num_bins, size,
                         cfg.axis_name, cfg.data_axis_size,
-                        interpret=interp)[:f_out]
+                        accum=accum, interpret=interp)[:f_out]
                 return fn
 
             branch = jnp.searchsorted(jnp.asarray(sizes, jnp.int32),
@@ -822,9 +912,17 @@ def _global_totals(g, h, c, cfg: GrowerConfig):
     return g, h, c
 
 
-def _find_split(hist, pg, ph, pc, fi, depth_ok, cfg: GrowerConfig):
+def _find_split(hist, pg, ph, pc, fi, depth_ok, cfg: GrowerConfig,
+                deq=None):
+    """Best split over ``hist``.  ``deq`` (quantized mode): ``hist`` is
+    raw int32 codes; voting forwards it so the candidate slab crosses
+    the wire low-bit, every other path dequantizes up front — the gain
+    math is unchanged f32 by construction."""
     if _is_voting(cfg):
-        return find_best_split_voting(hist, pg, ph, pc, fi, depth_ok, cfg)
+        return find_best_split_voting(hist, pg, ph, pc, fi, depth_ok, cfg,
+                                      deq=deq)
+    if deq is not None:
+        hist = deq(hist)
     if (cfg.hist_method in ("auto", "native") and not cfg.use_categorical
             and cfg.axis_name is None and cfg.feature_axis_name is None
             and (cfg.min_sum_hessian_in_leaf > 0 or cfg.lambda_l2 > 0)):
@@ -866,30 +964,51 @@ def collective_schedule(cfg: GrowerConfig, f: int, *,
     data-parallel reduce path — L reduces of the full (f, B, 3) f32
     state — the denominator of the bench artifact's payload ratio.
     Serial fits return zero count/payload.
+
+    Histogram-slab terms are priced at the RESOLVED wire itemsize
+    (ISSUE 17 satellite — the old hardcoded ``* 4`` over-billed
+    quantized slabs): ``cfg.quantized_wire`` int8/int16 slabs cost 1/2
+    bytes per element on the psum wire, while the ring transport always
+    moves f32 lanes (``_ring_flat`` casts), so ring fits price 4
+    regardless.  ``dense_payload_bytes`` stays f32-priced — it is the
+    un-quantized denominator.  Quantized fits journal the per-tree grid
+    scale ``pmax`` pair separately (``quantized_scale_bytes``): two
+    scalar latency-bound launches, not slab payload.
     """
     B, L, W = cfg.num_bins, cfg.num_leaves, cfg.cat_words
     dense = L * f * B * 3 * 4
-    count, payload = 0, 0
+    if cfg.collective == "ring":
+        itemsize = 4               # ring lanes are f32 (see _ring_flat)
+    else:
+        itemsize = {"int8": 1, "int16": 2}.get(cfg.quantized_wire, 4)
+    count, payload, scale_bytes = 0, 0, 0
     if cfg.axis_name is not None and cfg.data_axis_size > 1:
         if _is_voting(cfg):
             k = min(cfg.voting_k, f)
             k2 = min(2 * k, f)
-            slab = k2 * B * 3 * 4
+            slab = k2 * B * 3 * itemsize
             count += L
             payload += slab + (L - 1) * 2 * slab   # root + batched pairs
             payload += 4 * (k + (L - 1) * 2 * k)   # vote allgathers (i32)
             payload += L * 3 * 4                   # leaf-totals psums
         else:
             count += L                             # root + L-1 children
-            payload += dense
+            payload += L * f * B * 3 * itemsize
+        if _is_quantized(cfg):
+            scale_bytes = 2 * 4                    # grid-scale pmax pair
         if cfg.compact_rows:
-            payload += (L - 1) * 2 * 4             # partition-count pairs
+            # partition-count pairs ride the wire width too (they go
+            # through _wire_cast_psum even on ring fits): counts are
+            # bounded by n, which any resolved narrow wire admits
+            cnt_item = {"int8": 1, "int16": 2}.get(cfg.quantized_wire, 4)
+            payload += (L - 1) * 2 * cnt_item
     if cfg.feature_axis_name is not None and feature_shards > 1:
         count += L - 1                             # split-column psums
         payload += (L - 1) * n_rows_local * 4
         payload += (2 * L - 1) * (16 + W * 4)      # split-tuple allgathers
     return {"count": count, "payload_bytes": payload,
-            "dense_payload_bytes": dense}
+            "dense_payload_bytes": dense,
+            "quantized_scale_bytes": scale_bytes}
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -928,6 +1047,24 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
     from ..core import debug as _debug
     _debug.check_bins_in_range(bins, cfg.num_bins)
     _debug.check_finite("gradients/hessians", gh)
+    # quantized-gradient mode (ISSUE 17): discretize this tree's gh to
+    # integer grid codes ONCE; every histogram below accumulates exact
+    # int32, the sibling subtraction is bit-exact in integers, and the
+    # split evaluation dequantizes through ``deq`` so the gain math is
+    # unchanged f32.
+    qscale = None
+    deq = None
+    if _is_quantized(cfg):
+        gh, qscale = _quantize_gh(gh, cfg)
+        deq = lambda h: h.astype(jnp.float32) * qscale  # noqa: E731
+
+    def tot_deq(g, h, c):
+        if qscale is None:
+            return g, h, c
+        return (g.astype(jnp.float32) * qscale[0],
+                h.astype(jnp.float32) * qscale[1],
+                c.astype(jnp.float32))
+
     n = bins.shape[0]
     # under EFB bins holds G bundle columns; histograms, feat_info and
     # tree state stay per ORIGINAL feature
@@ -964,10 +1101,11 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
         bins_pk = pack_bins_u32(bins)
 
     hist0 = _hist(bins, gh, cfg, efb)
-    g0, h0, c0 = _global_totals(*_totals_from_hist(hist0), cfg)
+    g0, h0, c0 = _global_totals(*tot_deq(*_totals_from_hist(hist0)), cfg)
     depth0_ok = (cfg.max_depth <= 0) | (0 < cfg.max_depth)
     bg0, bf0, bb0, bc0, bits0 = _find_split(
-        hist0, g0, h0, c0, feat_info, jnp.asarray(depth0_ok), cfg)
+        hist0, g0, h0, c0, feat_info, jnp.asarray(depth0_ok), cfg,
+        deq=deq)
 
     tree = TreeArrays(
         node_feat=jnp.zeros(L - 1, jnp.int32),
@@ -1004,7 +1142,7 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
         row_order=row_order0,
         leaf_start=leaf_start0,
         leaf_cnt=leaf_cnt0,
-        leaf_hist=jnp.zeros((L, f, cfg.num_bins, 3), jnp.float32
+        leaf_hist=jnp.zeros((L, f, cfg.num_bins, 3), hist0.dtype
                             ).at[0].set(hist0),
         leaf_g=jnp.zeros(L, jnp.float32).at[0].set(g0),
         leaf_h=jnp.zeros(L, jnp.float32).at[0].set(h0),
@@ -1071,8 +1209,10 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
                     state.row_order, col, off, cnt, thr, use_cat,
                     state.best_cat_bits[l], n, sizes, cfg)
                 if cfg.axis_name is not None:
-                    tot = jax.lax.psum(jnp.stack([cnt_l_p, cnt_r_p]),
-                                       cfg.axis_name)
+                    # counts are bounded by n, which the quantized wire
+                    # policy keeps within the wire dtype — ride it too
+                    tot = _wire_cast_psum(jnp.stack([cnt_l_p, cnt_r_p]),
+                                          cfg)
                     use_right = tot[1] <= tot[0]
                 else:
                     use_right = cnt_r_p <= cnt_l_p
@@ -1116,7 +1256,8 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
                 row_order = state.row_order
                 leaf_start = state.leaf_start
                 leaf_cnt = state.leaf_cnt
-            g_r, h_r, c_r = _global_totals(*_totals_from_hist(hist_r), cfg)
+            g_r, h_r, c_r = _global_totals(
+                *tot_deq(*_totals_from_hist(hist_r)), cfg)
             g_l = state.leaf_g[l] - g_r
             h_l = state.leaf_h[l] - h_r
             c_l = state.leaf_c[l] - c_r
@@ -1132,12 +1273,15 @@ def _grow_tree_impl(bins, gh, feat_info, cfg: GrowerConfig, efb=None,
                  (bg_r, bf_r, bb_r, bc_r, bits_r)) = \
                     find_best_split_voting_pair(
                         hist_l, hist_r, (g_l, h_l, c_l),
-                        (g_r, h_r, c_r), feat_info, depth_ok, cfg)
+                        (g_r, h_r, c_r), feat_info, depth_ok, cfg,
+                        deq=deq)
             else:
                 bg_l, bf_l, bb_l, bc_l, bits_l = _find_split(
-                    hist_l, g_l, h_l, c_l, feat_info, depth_ok, cfg)
+                    hist_l, g_l, h_l, c_l, feat_info, depth_ok, cfg,
+                    deq=deq)
                 bg_r, bf_r, bb_r, bc_r, bits_r = _find_split(
-                    hist_r, g_r, h_r, c_r, feat_info, depth_ok, cfg)
+                    hist_r, g_r, h_r, c_r, feat_info, depth_ok, cfg,
+                    deq=deq)
 
             t = state.tree
             # link the new internal node into its parent

@@ -130,8 +130,11 @@ def _build_ffi(src_name: str, stem: str) -> bool:
     # as a CPython extension module by pkgutil walkers (it isn't one)
     out = os.path.join(_HERE, f"{stem}.bin")
     try:
-        import jax.ffi
-        ffi_inc = jax.ffi.include_dir()
+        try:
+            from jax import ffi as _jffi        # jax >= 0.4.38
+        except ImportError:
+            from jax.extend import ffi as _jffi  # 0.4.3x series
+        ffi_inc = _jffi.include_dir()
     except Exception:  # noqa: BLE001 - ancient jax
         return False
     for cxx in ("g++", "c++", "clang++"):
@@ -189,6 +192,20 @@ def split_ffi_handler():
     """Numeric best-split scan FFI handler (serial-path FindBestThreshold)."""
     lib = _ffi_lib()
     return getattr(lib, "MmlsparkFastSplit", None) if lib else None
+
+
+def qhist_ffi_handler():
+    """Quantized-gradient histogram FFI handler (ISSUE 17): int16 grid
+    codes in, int32 accumulation out, with a packed-int64 single-add
+    fast mode under the headroom bound (ops/histogram.packed_accum_ok)."""
+    lib = _ffi_lib()
+    return getattr(lib, "MmlsparkFastQHist", None) if lib else None
+
+
+def seg_qhist_ffi_handler():
+    """Quantized dynamic-offset segment histogram FFI handler."""
+    lib = _ffi_lib()
+    return getattr(lib, "MmlsparkFastSegQHist", None) if lib else None
 
 
 def bin_columns(X, bext, nb, base, lo, scale, use_table, missing_bin,

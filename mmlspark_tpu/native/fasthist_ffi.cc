@@ -291,3 +291,180 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Arg<ffi::Buffer<ffi::F32>>()
         .Ret<ffi::Buffer<ffi::F32>>()
         .Ret<ffi::Buffer<ffi::S32>>());
+
+// ---------------------------------------------------------------------------
+// Quantized-gradient histograms (ISSUE 17).  gh holds int16 GRID CODES
+// (per-round stochastic rounding, grower-side); accumulation is exact
+// int32.  Two modes, selected by meta's `packed` flag (the JAX wrapper
+// sets it from the static headroom bound ops/histogram.packed_accum_ok):
+//
+//   packed — the (g, h, count) triple is folded into ONE biased uint64
+//     per row: [g + mc : 24 bits][h + mc : 24 bits][count : 16 bits],
+//     and the inner loop does a SINGLE 64-bit add per row-feature into
+//     an (f, B) uint64 scratch — a third of the adds and 8 bytes of
+//     cell traffic instead of 12.  The bias keeps all fields
+//     non-negative so field-carries cannot happen while
+//     n * 2*max_code < 2^24 and n < 2^16.  Exactness contract per row:
+//     count == 1 and |code| <= mc (the training invariant — the count
+//     channel is the 0/1 bag mask and the quantizer clips).  Rows that
+//     violate it (and count==0 rows) accumulate DIRECTLY into the int32
+//     output instead, so the result is exact for any input; the final
+//     unpack ADDS the scratch into the output.
+//
+//   unpacked — three int32 adds per row-feature, no scratch; used when
+//     the packed bound fails.
+namespace {
+
+struct QAccum {
+  int64_t f, B, mc;
+  bool packed;
+  int32_t* o;                  // (f, B, 3) int32, pre-zeroed
+  std::vector<uint64_t> acc;   // (f, B) packed scratch (packed mode)
+
+  void Init(int64_t f_, int64_t B_, int64_t mc_, bool packed_,
+            int32_t* o_) {
+    f = f_;
+    B = B_;
+    mc = mc_;
+    packed = packed_;
+    o = o_;
+    std::fill(o, o + f * B * 3, 0);
+    if (packed) acc.assign(static_cast<size_t>(f * B), 0ull);
+  }
+
+  inline void Row(const uint8_t* br, int32_t gi, int32_t hi, int32_t ci) {
+    if (packed && ci == 1 && gi >= -mc && gi <= mc && hi >= -mc &&
+        hi <= mc) {
+      const uint64_t pv =
+          (static_cast<uint64_t>(static_cast<uint32_t>(gi + mc)) << 40) |
+          (static_cast<uint64_t>(static_cast<uint32_t>(hi + mc)) << 16) |
+          1ull;
+      uint64_t* a = acc.data();
+      for (int64_t j = 0; j < f; ++j) {
+        int64_t bin = br[j];
+        if (bin >= B) bin = B - 1;
+        a[j * B + bin] += pv;
+      }
+      return;
+    }
+    if (gi == 0 && hi == 0 && ci == 0) return;  // masked row
+    for (int64_t j = 0; j < f; ++j) {
+      int64_t bin = br[j];
+      if (bin >= B) bin = B - 1;
+      int32_t* cell = o + (j * B + bin) * 3;
+      cell[0] += gi;
+      cell[1] += hi;
+      cell[2] += ci;
+    }
+  }
+
+  void Finish() {
+    if (!packed) return;
+    const uint64_t* a = acc.data();
+    for (int64_t c = 0; c < f * B; ++c) {
+      const uint64_t v = a[c];
+      if (v == 0) continue;
+      const int64_t k = static_cast<int64_t>(v & 0xFFFFull);
+      const int64_t hs =
+          static_cast<int64_t>((v >> 16) & 0xFFFFFFull) - k * mc;
+      const int64_t gs = static_cast<int64_t>(v >> 40) - k * mc;
+      int32_t* cell = o + c * 3;
+      cell[0] += static_cast<int32_t>(gs);
+      cell[1] += static_cast<int32_t>(hs);
+      cell[2] += static_cast<int32_t>(k);
+    }
+  }
+};
+
+}  // namespace
+
+// (bins (n,f) u8, gh (n,3) s16, meta (2,) s32 [packed, max_code])
+//   -> out (f,B,3) s32.
+static ffi::Error QHistImpl(ffi::Buffer<ffi::U8> bins,
+                            ffi::Buffer<ffi::S16> gh,
+                            ffi::Buffer<ffi::S32> meta,
+                            ffi::ResultBuffer<ffi::S32> out) {
+  auto bd = bins.dimensions();
+  if (bd.size() != 2 || gh.dimensions().size() != 2 ||
+      out->dimensions().size() != 3 || meta.element_count() < 2) {
+    return ffi::Error::InvalidArgument(
+        "fastqhist: need bins (n,f) u8, gh (n,3) s16, meta (2,) s32, "
+        "out (f,B,3) s32");
+  }
+  const int64_t n = bd[0];
+  const int64_t f = bd[1];
+  const int64_t B = out->dimensions()[1];
+  const uint8_t* b = bins.typed_data();
+  const int16_t* g = gh.typed_data();
+  const bool packed = meta.typed_data()[0] != 0;
+  const int64_t mc = meta.typed_data()[1];
+  QAccum q;
+  q.Init(f, B, mc, packed, out->typed_data());
+  for (int64_t i = 0; i < n; ++i) {
+    q.Row(b + i * f, g[3 * i], g[3 * i + 1], g[3 * i + 2]);
+  }
+  q.Finish();
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    MmlsparkFastQHist, QHistImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U8>>()
+        .Arg<ffi::Buffer<ffi::S16>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());
+
+// Quantized segment histogram off the DataPartition permutation.
+// (bins (n,f) u8, gh (n,3) s16, row_order (m,) s32, meta (4,) s32
+// [off, cnt, packed, max_code]) -> out (f,B,3) s32.
+static ffi::Error SegQHistImpl(ffi::Buffer<ffi::U8> bins,
+                               ffi::Buffer<ffi::S16> gh,
+                               ffi::Buffer<ffi::S32> row_order,
+                               ffi::Buffer<ffi::S32> meta,
+                               ffi::ResultBuffer<ffi::S32> out) {
+  if (meta.element_count() < 4) {
+    return ffi::Error::InvalidArgument(
+        "fastsegqhist: meta must be (4,) s32 [off, cnt, packed, mc]");
+  }
+  const int64_t n = bins.dimensions()[0];
+  const int64_t f = bins.dimensions()[1];
+  const int64_t m = row_order.dimensions()[0];
+  const int64_t B = out->dimensions()[1];
+  const uint8_t* b = bins.typed_data();
+  const int16_t* g = gh.typed_data();
+  const int32_t* ro = row_order.typed_data();
+  int64_t off = meta.typed_data()[0];
+  int64_t cnt = meta.typed_data()[1];
+  const bool packed = meta.typed_data()[2] != 0;
+  const int64_t mc = meta.typed_data()[3];
+  if (off < 0) off = 0;
+  if (off + cnt > m) cnt = m - off;
+  QAccum q;
+  q.Init(f, B, mc, packed, out->typed_data());
+  constexpr int64_t kPrefetch = 8;
+  for (int64_t i = 0; i < cnt; ++i) {
+    if (i + kPrefetch < cnt) {
+      const int64_t pr = ro[off + i + kPrefetch];
+      if (pr >= 0 && pr < n) {
+        __builtin_prefetch(b + pr * f);
+        __builtin_prefetch(b + pr * f + f - 1);
+        __builtin_prefetch(g + 3 * pr);
+      }
+    }
+    const int64_t row = ro[off + i];
+    if (row < 0 || row >= n) continue;  // pad sentinel
+    q.Row(b + row * f, g[3 * row], g[3 * row + 1], g[3 * row + 2]);
+  }
+  q.Finish();
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    MmlsparkFastSegQHist, SegQHistImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U8>>()
+        .Arg<ffi::Buffer<ffi::S16>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());

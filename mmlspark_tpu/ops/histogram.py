@@ -37,6 +37,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:                                    # jax >= 0.4.38
+    from jax import ffi as _jffi
+except ImportError:                     # 0.4.3x series ships jax.extend.ffi
+    try:
+        from jax.extend import ffi as _jffi
+    except ImportError:                 # ancient jax: no FFI at all
+        _jffi = None
+
 #: channels in the gradient triple
 GH_CHANNELS = 3  # grad, hess, count
 
@@ -57,11 +65,19 @@ def _sanitize_sweep(doc: dict) -> Optional[dict]:
     :func:`_auto_method` falls back to the nearest larger resolved
     bucket / the backend default — exactly the committed
     ``_sweep_tpu.json`` artifacts (``pallas: 0.0`` at 2048, ``dot16:
-    0.0`` at 4096/8192) demand."""
+    0.0`` at 4096/8192) demand.
+
+    Quantized-dtype sweep rows (ISSUE 17) land in the same table under
+    ``method@int16`` / ``method@int32`` keys: they are informational
+    columns and must never be RANKED — a winner entry naming one is
+    refused, and as rivals they are ignored (the membership check below
+    only admits the four f32-exact methods)."""
     winners = doc.get("winner_by_rows") or {}
     times = doc.get("times_us_by_rows") or {}
     out = {}
     for rows, method in winners.items():
+        if "@" in method:
+            continue
         t = times.get(rows)
         if t is None:
             # no raw readings recorded (hand-built table): trust it
@@ -115,11 +131,13 @@ def _native_available() -> bool:
                 "mmlspark_fastseghist": native.seg_hist_ffi_handler(),
                 "mmlspark_fastpartition": native.partition_ffi_handler(),
                 "mmlspark_fastsplit": native.split_ffi_handler(),
+                "mmlspark_fastqhist": native.qhist_ffi_handler(),
+                "mmlspark_fastsegqhist": native.seg_qhist_ffi_handler(),
             }
             if all(h is not None for h in handlers.values()):
                 for name, h in handlers.items():
-                    jax.ffi.register_ffi_target(
-                        name, jax.ffi.pycapsule(h), platform="cpu")
+                    _jffi.register_ffi_target(
+                        name, _jffi.pycapsule(h), platform="cpu")
                 _NATIVE_OK = True
         except Exception:  # noqa: BLE001 - no toolchain / old jax
             _NATIVE_OK = False
@@ -131,7 +149,19 @@ def _native_applies(num_bins) -> bool:
             and _native_available())
 
 
-def native_segment_hist(bins, gh, row_order, off, cnt, num_bins):
+def packed_accum_ok(n_rows: int, max_code: int) -> bool:
+    """Whether the packed-int64 single-add native accumulation is exact
+    for ``n_rows`` quantized rows on a ``max_code`` grid: the 16-bit
+    count field needs every cell's row count < 2^16 and the two biased
+    24-bit g/h fields need ``n * 2*max_code < 2^24`` (each row adds at
+    most ``2*max_code`` to a biased field).  Beyond the bound the C++
+    kernel runs its unpacked int32x3 mode instead."""
+    return (max_code > 0 and n_rows < (1 << 16)
+            and n_rows * 2 * max_code < (1 << 24))
+
+
+def native_segment_hist(bins, gh, row_order, off, cnt, num_bins,
+                        max_code: int = 0):
     """Fused gather+histogram of the DataPartition segment
     ``row_order[off:off+cnt]`` via the FFI kernel, or None when the
     native CPU path doesn't apply (callers fall back to the bucket-ladder
@@ -142,8 +172,22 @@ def native_segment_hist(bins, gh, row_order, off, cnt, num_bins):
     if not _native_applies(num_bins):
         return None
     f = bins.shape[1]
+    if jnp.issubdtype(gh.dtype, jnp.integer):
+        # quantized-gradient mode (ISSUE 17): int16 grid codes in,
+        # exact int32 accumulation out; packed single-add fast mode
+        # when the headroom bound holds for the WHOLE matrix (cnt is
+        # dynamic, so the static gate uses n — conservative).
+        packed = packed_accum_ok(bins.shape[0], max_code)
+        meta = jnp.stack([off, cnt, jnp.asarray(int(packed), jnp.int32),
+                          jnp.asarray(max_code, jnp.int32)]).astype(
+                              jnp.int32)
+        return _jffi.ffi_call(
+            "mmlspark_fastsegqhist",
+            jax.ShapeDtypeStruct((f, num_bins, GH_CHANNELS), jnp.int32),
+        )(bins.astype(jnp.uint8), gh.astype(jnp.int16),
+          row_order.astype(jnp.int32), meta)
     meta = jnp.stack([off, cnt]).astype(jnp.int32)
-    return jax.ffi.ffi_call(
+    return _jffi.ffi_call(
         "mmlspark_fastseghist",
         jax.ShapeDtypeStruct((f, num_bins, GH_CHANNELS), jnp.float32),
     )(bins.astype(jnp.uint8), gh.astype(jnp.float32),
@@ -162,7 +206,7 @@ def native_partition(row_order, col, off, cnt, thr, use_cat, cat_bits,
     m = row_order.shape[0]
     meta = jnp.stack([off, cnt, thr,
                       use_cat.astype(jnp.int32)]).astype(jnp.int32)
-    ro, counts = jax.ffi.ffi_call(
+    ro, counts = _jffi.ffi_call(
         "mmlspark_fastpartition",
         (jax.ShapeDtypeStruct((m,), jnp.int32),
          jax.ShapeDtypeStruct((2,), jnp.int32)),
@@ -196,7 +240,7 @@ def native_find_split(hist, parent_g, parent_h, parent_c, feature_mask,
         jnp.float32(lambda_l1), jnp.float32(lambda_l2),
         jnp.float32(gain_floor),
         jnp.asarray(depth_ok, jnp.float32)])
-    gain_n, fb = jax.ffi.ffi_call(
+    gain_n, fb = _jffi.ffi_call(
         "mmlspark_fastsplit",
         (jax.ShapeDtypeStruct((1,), jnp.float32),
          jax.ShapeDtypeStruct((2,), jnp.int32)),
@@ -251,34 +295,45 @@ def _auto_method(n_rows: Optional[int] = None) -> str:
 
 def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
                       method: str = "auto",
-                      row_chunk: int = 8192) -> jnp.ndarray:
+                      row_chunk: int = 8192,
+                      max_code: int = 0) -> jnp.ndarray:
     """Per-feature gradient histograms.
 
     Args:
       bins: ``(n, f)`` integer bin indices in ``[0, num_bins)``.
       gh: ``(n, 3)`` float (grad, hess, count); rows not in the active leaf
-        must already be zeroed.
+        must already be zeroed.  An INTEGER dtype selects quantized mode
+        (ISSUE 17): ``gh`` holds int16 grid codes and every formulation
+        accumulates exactly in int32 — the result is ``(f, B, 3)`` int32
+        (dequantize at split evaluation, grower-side).
       num_bins: static bin count B.
       method: "segment" | "dot16" | "onehot" | "pallas" | "pallas_bf16"
         | "auto" (plus the fused variants "pallas_fused" and
         "pallas_ring", which behave like "pallas" here — their fusion
         lives in the grower's segment path / ring collective).
+      max_code: quantized mode only — the grid's |code| bound, which
+        gates the native packed-int64 single-add fast path
+        (:func:`packed_accum_ok`).
 
     Returns:
-      ``(f, num_bins, 3)`` float32 histogram.
+      ``(f, num_bins, 3)`` float32 histogram (int32 in quantized mode).
     """
+    quantized = jnp.issubdtype(gh.dtype, jnp.integer)
+    acc_dtype = jnp.int32 if quantized else jnp.float32
     if method == "auto":
         method = _auto_method(bins.shape[0])
     if method == "native":
         if num_bins > 256 or not _native_available():
-            return _hist_segment(bins, gh, num_bins)
+            return _hist_segment(bins, gh, num_bins, acc_dtype)
+        if quantized:
+            return _hist_native_q(bins, gh, num_bins, max_code)
         return _hist_native(bins, gh, num_bins)
     if method == "segment":
-        return _hist_segment(bins, gh, num_bins)
+        return _hist_segment(bins, gh, num_bins, acc_dtype)
     if method == "dot16":
-        return _hist_dot16(bins, gh, num_bins, row_chunk)
+        return _hist_dot16(bins, gh, num_bins, row_chunk, acc_dtype)
     if method == "onehot":
-        return _hist_onehot(bins, gh, num_bins, row_chunk)
+        return _hist_onehot(bins, gh, num_bins, row_chunk, acc_dtype)
     if method in ("pallas", "pallas_bf16", "pallas_fused", "pallas_ring"):
         # 'pallas_fused' fuses the SEGMENT gather (grower._segment_hist)
         # and 'pallas_ring' additionally fuses the cross-shard ring
@@ -287,7 +342,12 @@ def compute_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         # and run the plain kernel
         from .pallas_histogram import BMAX, histogram_pallas
         if num_bins > BMAX:   # kernel folds 16x16 nibbles; fall back
-            return _hist_dot16(bins, gh, num_bins, row_chunk)
+            return _hist_dot16(bins, gh, num_bins, row_chunk, acc_dtype)
+        if quantized:
+            return histogram_pallas(
+                bins.astype(jnp.int32), gh.astype(jnp.int32), num_bins,
+                row_chunk=min(row_chunk, 4096), accum="int32",
+                interpret=jax.default_backend() == "cpu")
         return histogram_pallas(
             bins.astype(jnp.int32), gh.astype(jnp.float32), num_bins,
             row_chunk=min(row_chunk, 4096),   # VMEM ceiling for the kernel
@@ -304,14 +364,31 @@ def _hist_native(bins, gh, num_bins):
     this IS the fused gather+histogram path, LightGBM-style.  Never
     selected on accelerator backends (_auto_method gates on cpu)."""
     f = bins.shape[1]
-    return jax.ffi.ffi_call(
+    return _jffi.ffi_call(
         "mmlspark_fasthist",
         jax.ShapeDtypeStruct((f, num_bins, GH_CHANNELS), jnp.float32),
     )(bins.astype(jnp.uint8), gh.astype(jnp.float32))
 
 
-def _hist_segment(bins, gh, num_bins):
-    gh = gh.astype(jnp.float32)
+def _hist_native_q(bins, gh, num_bins, max_code):
+    """Quantized-gradient native accumulation (ISSUE 17): int16 grid
+    codes in, exact int32 histogram out.  When :func:`packed_accum_ok`
+    holds, the C++ kernel folds the (g, h, count) triple into ONE biased
+    packed int64 per row and does a single 64-bit add per row-feature —
+    a third of the adds and two thirds of the cell traffic of the f32
+    kernel — then unpacks to (f, B, 3) int32 at the end."""
+    f = bins.shape[1]
+    packed = packed_accum_ok(bins.shape[0], max_code)
+    meta = jnp.stack([jnp.asarray(int(packed), jnp.int32),
+                      jnp.asarray(max_code, jnp.int32)]).astype(jnp.int32)
+    return _jffi.ffi_call(
+        "mmlspark_fastqhist",
+        jax.ShapeDtypeStruct((f, num_bins, GH_CHANNELS), jnp.int32),
+    )(bins.astype(jnp.uint8), gh.astype(jnp.int16), meta)
+
+
+def _hist_segment(bins, gh, num_bins, acc_dtype=jnp.float32):
+    gh = gh.astype(acc_dtype)
 
     def per_feature(col):
         return jax.ops.segment_sum(gh, col.astype(jnp.int32),
@@ -321,9 +398,9 @@ def _hist_segment(bins, gh, num_bins):
     return jax.vmap(per_feature)(bins.T)
 
 
-def _hist_onehot(bins, gh, num_bins, row_chunk):
+def _hist_onehot(bins, gh, num_bins, row_chunk, acc_dtype=jnp.float32):
     n, f = bins.shape
-    gh = gh.astype(jnp.float32)
+    gh = gh.astype(acc_dtype)
     chunk = min(row_chunk, n)
     pad = (-n) % chunk
     if pad:
@@ -336,19 +413,22 @@ def _hist_onehot(bins, gh, num_bins, row_chunk):
         b, g = args
         b = b.astype(jnp.int32)   # bins may arrive uint8; cast per chunk
         onehot = (b[:, :, None] == jnp.arange(num_bins)[None, None, :])
-        acc = acc + jnp.einsum("nfb,nc->fbc", onehot.astype(jnp.float32), g)
+        acc = acc + jnp.einsum("nfb,nc->fbc", onehot.astype(acc_dtype), g)
         return acc, None
 
-    init = jnp.zeros((f, num_bins, GH_CHANNELS), jnp.float32)
+    init = jnp.zeros((f, num_bins, GH_CHANNELS), acc_dtype)
     out, _ = jax.lax.scan(step, init, (bins_c, gh_c))
     return out
 
 
-def _hist_dot16(bins, gh, num_bins, row_chunk):
-    """Nibble-decomposed histogram: B = hi*16 + lo, two MXU contractions."""
+def _hist_dot16(bins, gh, num_bins, row_chunk, acc_dtype=jnp.float32):
+    """Nibble-decomposed histogram: B = hi*16 + lo, two MXU contractions.
+    With ``acc_dtype=int32`` (quantized mode) both one-hots and the
+    contraction run in integers — the MXU nibble fold accumulates the
+    int one-hot matmul in int32, bit-exactly."""
     n, f = bins.shape
     n_hi = (num_bins + 15) // 16
-    gh = gh.astype(jnp.float32)
+    gh = gh.astype(acc_dtype)
     chunk = min(row_chunk, n)
     pad = (-n) % chunk
     if pad:
@@ -364,8 +444,8 @@ def _hist_dot16(bins, gh, num_bins, row_chunk):
         b = b.astype(jnp.int32)          # bins may arrive uint8
         lo = b % 16                      # (c, f)
         hi = b // 16
-        lo_oh = (lo[:, :, None] == lo_iota).astype(jnp.float32)   # (c, f, 16)
-        hi_oh = (hi[:, :, None] == hi_iota).astype(jnp.float32)   # (c, f, Hh)
+        lo_oh = (lo[:, :, None] == lo_iota).astype(acc_dtype)     # (c, f, 16)
+        hi_oh = (hi[:, :, None] == hi_iota).astype(acc_dtype)     # (c, f, Hh)
         # rhs[n, f, hi, ch] = hi_oh * gh  -> contract n with lo_oh
         # two-step: t = einsum('cfh,cx->cfhx') is big; fuse instead:
         # out[f, l, h, x] = sum_c lo_oh[c,f,l] * hi_oh[c,f,h] * g[c,x]
@@ -373,12 +453,12 @@ def _hist_dot16(bins, gh, num_bins, row_chunk):
         rhs = hi_oh[:, :, :, None] * g[:, None, None, :]          # (c, f, Hh, 3)
         rhs = rhs.reshape(b.shape[0], f, n_hi * GH_CHANNELS)
         out = jnp.einsum("cfl,cfr->flr", lo_oh, rhs,
-                         preferred_element_type=jnp.float32)      # (f, 16, Hh*3)
+                         preferred_element_type=acc_dtype)        # (f, 16, Hh*3)
         out = out.reshape(f, 16, n_hi, GH_CHANNELS)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(
             f, n_hi * 16, GH_CHANNELS)
         return acc + out[:, :num_bins], None
 
-    init = jnp.zeros((f, num_bins, GH_CHANNELS), jnp.float32)
+    init = jnp.zeros((f, num_bins, GH_CHANNELS), acc_dtype)
     out, _ = jax.lax.scan(step, init, (bins_c, gh_c))
     return out

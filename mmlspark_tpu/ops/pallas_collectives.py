@@ -65,7 +65,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_histogram import BMAX, FB, LO, probe_cached
+from .pallas_histogram import BMAX, FB, LO, _accum_dtypes, probe_cached
 
 log = logging.getLogger(__name__)
 
@@ -306,6 +306,7 @@ def _fused_hist_ring_kernel(binsT_ref, idx_ref, gh_ref, out_ref,
     """
     my_id = jax.lax.axis_index(axis_name)
     right = jax.lax.rem(my_id + 1, num_dev)
+    acc_t = out_ref.dtype          # f32, or int32 when quantized
     c = row_chunk
     iota16 = jax.lax.broadcasted_iota(jnp.int32, (c, LO), 1)
 
@@ -320,7 +321,7 @@ def _fused_hist_ring_kernel(binsT_ref, idx_ref, gh_ref, out_ref,
 
             def row_body(j, _):
                 idxc = idx_ref[pl.ds(j * c, c)]
-                g = gh_ref[pl.ds(j * c, c), :].astype(jnp.float32)
+                g = gh_ref[pl.ds(j * c, c), :].astype(acc_t)
                 for f in range(FB):
                     col = jnp.take(
                         binsT_ref[pl.ds(row0 + f, 1), :][0], idxc,
@@ -328,7 +329,7 @@ def _fused_hist_ring_kernel(binsT_ref, idx_ref, gh_ref, out_ref,
                     lo_scr[:, f * LO:(f + 1) * LO] = \
                         (col % LO == iota16).astype(accum_dtype)
                     hi_scr[:, f * LO:(f + 1) * LO] = \
-                        (col // LO == iota16).astype(jnp.float32)
+                        (col // LO == iota16).astype(acc_t)
                 lo_oh = lo_scr[...]
                 hi_oh = hi_scr[...]
                 for ch in range(3):
@@ -336,7 +337,7 @@ def _fused_hist_ring_kernel(binsT_ref, idx_ref, gh_ref, out_ref,
                     work[slot, b, ch] += jax.lax.dot_general(
                         lo_oh, rhs,
                         dimension_numbers=(((0,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=acc_t)
                 return 0
 
             jax.lax.fori_loop(0, n_row_chunks, row_body, 0)
@@ -428,7 +429,7 @@ def fused_segment_hist_ring(binsT, gh_sub, idx, num_bins: int, size: int,
             f"fused ring histogram gate refused (f={f}, n={n}, "
             f"D={num_devices}); callers fall back to "
             f"histogram_pallas_fused + ring_allreduce_or_psum")
-    accum_dtype = jnp.bfloat16 if accum == "bfloat16" else jnp.float32
+    accum_dtype, out_dtype = _accum_dtypes(accum)
 
     c = min(row_chunk, size)
     # pad feature blocks to one chunk of cb blocks per device
@@ -450,12 +451,12 @@ def fused_segment_hist_ring(binsT, gh_sub, idx, num_bins: int, size: int,
             n_row_chunks=(size + s_pad) // c, accum_dtype=accum_dtype,
             interpret=interpret),
         out_shape=jax.ShapeDtypeStruct((nfb, 3, FB * LO, FB * LO),
-                                       jnp.float32),
+                                       out_dtype),
         scratch_shapes=[
-            pltpu.VMEM((2, cb, 3, FB * LO, FB * LO), jnp.float32),
-            pltpu.VMEM((2, cb, 3, FB * LO, FB * LO), jnp.float32),
+            pltpu.VMEM((2, cb, 3, FB * LO, FB * LO), out_dtype),
+            pltpu.VMEM((2, cb, 3, FB * LO, FB * LO), out_dtype),
             pltpu.VMEM((c, FB * LO), accum_dtype),
-            pltpu.VMEM((c, FB * LO), jnp.float32),
+            pltpu.VMEM((c, FB * LO), out_dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
@@ -470,7 +471,7 @@ def fused_segment_hist_ring(binsT, gh_sub, idx, num_bins: int, size: int,
             transcendentals=0),
         interpret=interpret,
     )(binsT.astype(jnp.int32) if interpret else binsT,
-      idx.astype(jnp.int32), gh_sub)
+      idx.astype(jnp.int32), gh_sub.astype(out_dtype))
     # extract the diagonal 16x16 blocks, exactly like histogram_pallas
     out = out.reshape(nfb, 3, FB, LO, FB, LO)
     diag = out[:, :, jnp.arange(FB), :, jnp.arange(FB), :]

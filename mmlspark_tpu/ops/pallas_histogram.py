@@ -52,6 +52,20 @@ FB = 8           # features folded per matmul: FB * LO = 128 lanes
 BMAX = LO * LO   # 256 bins supported; larger falls back to dot16
 
 
+def _accum_dtypes(accum: str):
+    """(matmul operand dtype, accumulator/output dtype) per accum mode.
+
+    ``"int32"`` is the quantized-gradient mode (ISSUE 17): ``gh`` holds
+    integer grid codes, both one-hot operands and the dot accumulate in
+    int32, and the kernel output is EXACT int32 — order-invariant across
+    chunk schedules and reduction topologies."""
+    if accum == "int32":
+        return jnp.int32, jnp.int32
+    if accum == "bfloat16":
+        return jnp.bfloat16, jnp.float32
+    return jnp.float32, jnp.float32
+
+
 def _hist_kernel(binsT_ref, gh_ref, out_ref, lo_scr, hi_scr, *, accum_dtype):
     """One (feature_block, row_chunk) grid step; accumulates into out_ref."""
     j = pl.program_id(1)
@@ -60,8 +74,9 @@ def _hist_kernel(binsT_ref, gh_ref, out_ref, lo_scr, hi_scr, *, accum_dtype):
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
+    acc_t = out_ref.dtype                 # f32, or int32 when quantized
     bT = binsT_ref[...].T                 # (C, FB) int32
-    g = gh_ref[...].astype(jnp.float32)   # (C, 3)
+    g = gh_ref[...].astype(acc_t)         # (C, 3)
     c = bT.shape[0]
 
     # Combined one-hots built 16 lanes at a time (per folded feature) into
@@ -72,7 +87,7 @@ def _hist_kernel(binsT_ref, gh_ref, out_ref, lo_scr, hi_scr, *, accum_dtype):
         lo_scr[:, f * LO:(f + 1) * LO] = (col % LO == iota16).astype(
             accum_dtype)
         hi_scr[:, f * LO:(f + 1) * LO] = (col // LO == iota16).astype(
-            jnp.float32)
+            acc_t)
 
     lo_oh = lo_scr[...]
     hi_oh = hi_scr[...]
@@ -80,7 +95,7 @@ def _hist_kernel(binsT_ref, gh_ref, out_ref, lo_scr, hi_scr, *, accum_dtype):
         rhs = (hi_oh * g[:, ch][:, None]).astype(accum_dtype)
         out_ref[0, ch] += jax.lax.dot_general(
             lo_oh, rhs, dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # (128, 128)
+            preferred_element_type=acc_t)                 # (128, 128)
 
 
 def _fused_kernel(binsT_ref, idx_ref, gh_ref, out_ref, lo_scr, hi_scr, *,
@@ -97,8 +112,9 @@ def _fused_kernel(binsT_ref, idx_ref, gh_ref, out_ref, lo_scr, hi_scr, *,
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
+    acc_t = out_ref.dtype                       # f32, or int32 (quantized)
     idx = idx_ref[...]                          # (C,) i32, pre-clamped
-    g = gh_ref[...].astype(jnp.float32)         # (C, 3), pre-masked
+    g = gh_ref[...].astype(acc_t)               # (C, 3), pre-masked
     c = idx.shape[0]
 
     iota16 = jax.lax.broadcasted_iota(jnp.int32, (c, LO), 1)
@@ -108,7 +124,7 @@ def _fused_kernel(binsT_ref, idx_ref, gh_ref, out_ref, lo_scr, hi_scr, *,
         lo_scr[:, f * LO:(f + 1) * LO] = (col % LO == iota16).astype(
             accum_dtype)
         hi_scr[:, f * LO:(f + 1) * LO] = (col // LO == iota16).astype(
-            jnp.float32)
+            acc_t)
 
     lo_oh = lo_scr[...]
     hi_oh = hi_scr[...]
@@ -116,7 +132,7 @@ def _fused_kernel(binsT_ref, idx_ref, gh_ref, out_ref, lo_scr, hi_scr, *,
         rhs = (hi_oh * g[:, ch][:, None]).astype(accum_dtype)
         out_ref[0, ch] += jax.lax.dot_general(
             lo_oh, rhs, dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=acc_t)
 
 
 #: VMEM budget gate for the fused kernel: the (FB, n) uint8 binsT block
@@ -155,7 +171,7 @@ def histogram_pallas_fused(binsT, gh_sub, idx, num_bins: int, size: int,
         raise ValueError(
             f"fused kernel needs the (8, n) binsT block VMEM-resident; "
             f"n={n} exceeds {FUSED_MAX_ROWS}")
-    accum_dtype = jnp.bfloat16 if accum == "bfloat16" else jnp.float32
+    accum_dtype, out_dtype = _accum_dtypes(accum)
 
     c = min(row_chunk, size)
     f_pad = (-f) % FB
@@ -182,10 +198,10 @@ def histogram_pallas_fused(binsT, gh_sub, idx, num_bins: int, size: int,
         out_specs=pl.BlockSpec((1, 3, FB * LO, FB * LO),
                                lambda i, j: (i, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nfb, 3, FB * LO, FB * LO),
-                                       jnp.float32),
+                                       out_dtype),
         scratch_shapes=[
             pltpu.VMEM((c, FB * LO), accum_dtype),
-            pltpu.VMEM((c, FB * LO), jnp.float32),
+            pltpu.VMEM((c, FB * LO), out_dtype),
         ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
@@ -193,7 +209,7 @@ def histogram_pallas_fused(binsT, gh_sub, idx, num_bins: int, size: int,
             bytes_accessed=fp * n + (size + s_pad) * 16,
             transcendentals=0),
     )(binsT.astype(jnp.int32) if interpret else binsT,
-      idx.astype(jnp.int32), gh_sub)
+      idx.astype(jnp.int32), gh_sub.astype(out_dtype))
     out = out.reshape(nfb, 3, FB, LO, FB, LO)
     diag = out[:, :, jnp.arange(FB), :, jnp.arange(FB), :]
     hist = diag.transpose(1, 0, 4, 3, 2).reshape(fp, BMAX, 3)
@@ -375,23 +391,25 @@ def histogram_pallas(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         num_bins ≤ 256.
       gh: ``(n, 3)`` float32 (grad, hess, count), pre-masked.
       accum: "float32" | "bfloat16" — MXU operand precision (accumulation
-        is always f32 via preferred_element_type).
+        is f32 via preferred_element_type) — or "int32" for the
+        quantized-gradient mode: ``gh`` holds integer grid codes and the
+        whole contraction runs (and returns) exact int32.
 
     Returns:
-      ``(f, num_bins, 3)`` float32.
+      ``(f, num_bins, 3)`` float32 (int32 when ``accum="int32"``).
     """
     if num_bins > BMAX:
         raise ValueError(f"pallas histogram supports ≤{BMAX} bins, "
                          f"got {num_bins}")
     n, f = bins.shape
-    accum_dtype = jnp.bfloat16 if accum == "bfloat16" else jnp.float32
+    accum_dtype, out_dtype = _accum_dtypes(accum)
 
     c = min(row_chunk, max(128 * ((n + 127) // 128), 128))
     n_pad = (-n) % c
     f_pad = (-f) % FB
     # padded rows point at bin 0 with zero gh weight → no contribution
     binsT = jnp.pad(bins.T, ((0, f_pad), (0, n_pad)))
-    gh = jnp.pad(gh.astype(jnp.float32), ((0, n_pad), (0, 0)))
+    gh = jnp.pad(gh.astype(out_dtype), ((0, n_pad), (0, 0)))
     fp, np_ = binsT.shape
     nfb = fp // FB
 
@@ -406,10 +424,10 @@ def histogram_pallas(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         out_specs=pl.BlockSpec((1, 3, FB * LO, FB * LO),
                                lambda i, j: (i, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nfb, 3, FB * LO, FB * LO),
-                                       jnp.float32),
+                                       out_dtype),
         scratch_shapes=[
             pltpu.VMEM((c, FB * LO), accum_dtype),
-            pltpu.VMEM((c, FB * LO), jnp.float32),
+            pltpu.VMEM((c, FB * LO), out_dtype),
         ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(

@@ -357,7 +357,11 @@ class TestValScoreScale:
 
         def spy(*args, **kw):
             out = orig(*args, **kw)
-            captured["val"] = np.asarray(out[2])   # final val_scores carry
+            # final val_scores carry — np.array (COPY), not np.asarray:
+            # on CPU the latter can be a zero-copy view of an XLA buffer
+            # that _boost_scan's donation/free recycles after fit(),
+            # leaving the view reading reallocated garbage
+            captured["val"] = np.array(out[2])
             return out
         eng._boost_scan = spy
         try:

@@ -9,9 +9,11 @@ sentinel is the automated guard:
 * **Stages** — fast (seconds-each) re-measurements of the hot paths
   the benches commit: the per-row JSON and binary wire codecs
   (identical methodology to ``bench_serving``'s ``codec_micro``), a
-  closed-loop scoring-engine burst (client-observed p50), and a tiny
-  training fit (ms/tree).  Every stage runs ``--k`` times and the
-  MEDIAN is compared — a single descheduled run cannot fire the alarm.
+  closed-loop scoring-engine burst (client-observed p50), a tiny
+  training fit (ms/tree), and the quantized histogram build at the
+  ``bench_quant`` pin (ISSUE 17's low-bit hot path).  Every stage runs
+  ``--k`` times and the MEDIAN is compared — a single descheduled run
+  cannot fire the alarm.
 * **Noise-aware thresholds** — a stage regresses only when the median
   exceeds the baseline by BOTH the relative factor (``--rel``,
   default 1.8x) and an absolute floor (per-unit: µs-scale stages need
@@ -40,7 +42,7 @@ CLI::
 
     python tools/perf_sentinel.py --baseline artifacts/bench_serving_r12.json \
         [--out artifacts/perf_sentinel_r12.json] [--k 5] [--rel 1.6] \
-        [--stages codec_json,codec_binary,scoring_engine,train_micro] \
+        [--stages codec_json,codec_binary,scoring_engine,train_micro,quantized_hist] \
         [--calibrate] [--skip-overhead]
 """
 
@@ -266,11 +268,54 @@ def stage_train_micro(args):
     return (time.perf_counter() - t0) / args.train_trees * 1e3, "ms"
 
 
+_QHIST_CACHE = {}
+
+
+def _qhist_setup():
+    """Inputs + jitted quantized-histogram builder at the committed
+    bench_quant pin, built once per process (compile and data-gen stay
+    out of every timed region)."""
+    if _QHIST_CACHE:
+        return _QHIST_CACHE
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mmlspark_tpu.ops import histogram as H
+    n, f, B, mc = 32768, 50, 256, 127
+    rng = np.random.default_rng(3)
+    bins = jnp.asarray(rng.integers(0, B, size=(n, f), dtype=np.uint8))
+    codes = rng.integers(-mc, mc + 1, size=(n, 2))
+    gh = jnp.asarray(np.concatenate([codes, np.ones((n, 1))], 1),
+                     jnp.int16)
+    method = "native" if H._native_available() and B <= 256 else "segment"
+    fn = jax.jit(lambda b, g: H.compute_histogram(
+        b, g, B, method=method, max_code=mc))
+    fn(bins, gh).block_until_ready()
+    _QHIST_CACHE.update(fn=fn, bins=bins, gh=gh, method=method)
+    return _QHIST_CACHE
+
+
+def stage_quantized_hist(args):
+    """ms: quantized histogram build (int16 grid codes, |code| <= 127
+    — the packed-int64 single-add native mode when the FFI kernel is
+    loaded) at the ``bench_quant`` pin n=32768, f=50, B=256.  Guards
+    the ISSUE 17 hot path: the committed >=1.3x quantized-vs-f32 build
+    win evaporates silently if this path regresses."""
+    c = _qhist_setup()
+    reps = args.qhist_reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        c["fn"](c["bins"], c["gh"]).block_until_ready()
+    _stretch(t0, "quantized_hist")
+    return (time.perf_counter() - t0) / reps * 1e3, "ms"
+
+
 STAGES = {
     "codec_json": stage_codec_json,
     "codec_binary": stage_codec_binary,
     "scoring_engine": stage_scoring_engine,
     "train_micro": stage_train_micro,
+    "quantized_hist": stage_quantized_hist,
 }
 
 
@@ -489,7 +534,7 @@ def main(argv=None) -> int:
                     "bench baselines (nonzero exit on regression)")
     ap.add_argument("--baseline",
                     default=os.path.join(_REPO, "artifacts",
-                                         "perf_sentinel_r12.json"),
+                                         "perf_sentinel_r17.json"),
                     help="prior sentinel artifact or committed "
                          "bench_serving artifact (a bench artifact "
                          "gates only the codec stages its codec_micro "
@@ -500,7 +545,7 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None, help="artifact JSON path")
     ap.add_argument("--stages",
                     default="codec_json,codec_binary,scoring_engine,"
-                            "train_micro")
+                            "train_micro,quantized_hist")
     ap.add_argument("--k", type=int, default=5,
                     help="median-of-K runs per stage")
     ap.add_argument("--rel", type=float, default=1.8,
@@ -511,6 +556,9 @@ def main(argv=None) -> int:
     ap.add_argument("--codec-features", type=int, default=64)
     ap.add_argument("--model-trees", type=int, default=60)
     ap.add_argument("--train-trees", type=int, default=10)
+    ap.add_argument("--qhist-reps", type=int, default=5,
+                    help="builds per quantized_hist rep (median over "
+                         "--k reps of this many back-to-back builds)")
     ap.add_argument("--outstanding", type=int, default=32)
     ap.add_argument("--burst-duration", type=float, default=1.0)
     ap.add_argument("--overhead-reps", type=int, default=3)

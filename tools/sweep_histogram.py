@@ -60,6 +60,15 @@ def main():
                          "does not work)")
     ap.add_argument("--methods", nargs="*", default=None,
                     help="subset of methods for this invocation")
+    ap.add_argument("--hist-dtype", default="f32",
+                    choices=("f32", "int16", "int32"),
+                    help="gradient dtype for the sweep (ISSUE 17): f32 "
+                         "is the normal path; int16/int32 feed grid "
+                         "codes (|code| <= 127 / 32767) so every method "
+                         "accumulates int32 — readings land in the same "
+                         "table under 'method@dtype' keys, reported as "
+                         "extra columns but never ranked into the "
+                         "winner table (_sanitize_sweep refuses them)")
     ap.add_argument("--sizes", type=int, nargs="*", default=None,
                     help="subset of bucket sizes for this invocation "
                          "(results merge into the existing table, so a "
@@ -120,6 +129,12 @@ def main():
             json.dump(state, fh, indent=1)
         write_markdown(args.out, state, backend, f, B, R)
 
+    # quantized sweep column (ISSUE 17): grid codes at the dtype's
+    # grid width; every method then accumulates in int32
+    mc = {"int16": 127, "int32": 32767}.get(args.hist_dtype, 0)
+    suffix = "" if args.hist_dtype == "f32" else f"@{args.hist_dtype}"
+    acc_np = np.float32 if not mc else np.int32
+
     def timed_per_call(method, bins, gh_stack):
         """Per-call seconds via the two-point in-program slope."""
         n = bins.shape[0]
@@ -128,10 +143,11 @@ def main():
             @jax.jit
             def run(bins, gh_stack):
                 def body(acc, gh):
-                    out = compute_histogram(bins, gh, B, method=method)
+                    out = compute_histogram(bins, gh, B, method=method,
+                                            max_code=mc)
                     return acc + out, None
                 acc, _ = jax.lax.scan(
-                    body, jnp.zeros((f, B, 3), jnp.float32),
+                    body, jnp.zeros((f, B, 3), acc_np),
                     gh_stack[:reps])
                 return acc
             return run
@@ -157,13 +173,22 @@ def main():
 
     for n in sizes:
         bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.uint8)
-        gh_stack = jnp.asarray(rng.normal(size=(R, n, 3)), jnp.float32)
+        if mc:
+            codes = rng.integers(-mc, mc + 1, size=(R, n, 2))
+            gh_stack = jnp.asarray(
+                np.concatenate([codes, np.ones((R, n, 1))], axis=2),
+                jnp.int16 if args.hist_dtype == "int16" else jnp.int32)
+        else:
+            gh_stack = jnp.asarray(rng.normal(size=(R, n, 3)), jnp.float32)
         ref = None
         times = dict(state["times_us_by_rows"].get(str(n), {}))
         for m in (args.methods or ALL_METHODS):
+            if mc and m == "pallas_bf16":
+                continue        # bf16 operands have no quantized mode
             try:
                 out = jax.jit(
-                    lambda b, g, m=m: compute_histogram(b, g, B, method=m)
+                    lambda b, g, m=m: compute_histogram(b, g, B, method=m,
+                                                        max_code=mc)
                 )(bins, gh_stack[0])
                 out.block_until_ready()
                 if ref is None:
@@ -172,10 +197,10 @@ def main():
                     err = float(np.max(np.abs(np.asarray(out) - ref)))
                     scale = float(np.max(np.abs(ref))) or 1.0
                     assert err / scale < 2e-2, f"{m} mismatch {err}"
-                times[m] = timed_per_call(m, bins, gh_stack) * 1e6
+                times[m + suffix] = timed_per_call(m, bins, gh_stack) * 1e6
             except Exception as e:  # noqa: BLE001
-                times[m] = None
-                print(f"  n={n} {m}: FAIL {type(e).__name__}: {e}",
+                times[m + suffix] = None
+                print(f"  n={n} {m}{suffix}: FAIL {type(e).__name__}: {e}",
                       file=sys.stderr)
         # A slope clamped to 0.0 means that method's measurement sat
         # below the dispatch-noise floor — it may be the FASTEST method
@@ -357,6 +382,10 @@ def write_markdown(out_path, state, backend, f, B, R):
         import jax
         kind = jax.devices()[0].device_kind
     by_rows = state["times_us_by_rows"]
+    # quantized-dtype columns (ISSUE 17): whatever method@int16 /
+    # method@int32 readings --hist-dtype sweeps have recorded
+    qcols = sorted({k for t in by_rows.values() for k in t if "@" in k})
+    cols = ALL_METHODS + qcols
     lines = [
         "# Histogram-method sweep",
         "",
@@ -365,15 +394,17 @@ def write_markdown(out_path, state, backend, f, B, R):
         f"Per-call microseconds via the in-program slope "
         f"(R={R} scan reps vs 1; each endpoint min over 5 timed runs) — "
         "per-launch timing is meaningless on a tunneled TPU where every "
-        "dispatch pays a ~2-3 ms RPC floor.",
+        "dispatch pays a ~2-3 ms RPC floor.  `method@int16`/`@int32` "
+        "columns are the quantized-gradient builds (grid codes in, "
+        "int32 accumulation; ISSUE 17) — informational, never ranked.",
         "",
-        "| rows | " + " | ".join(ALL_METHODS) + " | winner (f32-exact) |",
-        "|---:|" + "---:|" * (len(ALL_METHODS) + 1),
+        "| rows | " + " | ".join(cols) + " | winner (f32-exact) |",
+        "|---:|" + "---:|" * (len(cols) + 1),
     ]
     for n in sorted(by_rows, key=int):
         times = by_rows[n]
         cells = [f"{times[m]:.0f}" if times.get(m) is not None else "—"
-                 for m in ALL_METHODS]
+                 for m in cols]
         win = state["winner_by_rows"].get(n, "(unresolved: 0-clamped)")
         lines.append(f"| {n} | " + " | ".join(cells)
                      + f" | **{win}** |")

@@ -83,6 +83,18 @@ step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --dev
 step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 4 --parallelism voting --skip-baseline | tee artifacts/bench_tpu_session_voted_d4.out'
 step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 4 --parallelism voting --skip-baseline --pass-through collective=psum | tee artifacts/bench_tpu_session_voted_d4_psum.out'
 
+# 4f. ISSUE 17 quantized-gradient A/B: int16-slab psum vs the on-chip
+#     ring (which carries the int codes exactly in f32 lanes, so its
+#     win must come from latency, not width) at D=2 and D=4.  Each run
+#     embeds the quantized-vs-f32 twin fit, the histogram-build micro,
+#     and the vendored-data parity deltas — the journaled
+#     collective_payload_bytes across these four runs are the on-chip
+#     check of the committed 0.5x payload ratio.
+step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 2 --parallelism data --collective psum --quantized-grad 16 --skip-baseline | tee artifacts/bench_tpu_session_quant_d2_psum.out'
+step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 2 --parallelism data --collective ring --quantized-grad 16 --skip-baseline | tee artifacts/bench_tpu_session_quant_d2_ring.out'
+step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 4 --parallelism data --collective psum --quantized-grad 16 --skip-baseline | tee artifacts/bench_tpu_session_quant_d4_psum.out'
+step 1800 bash -c 'python bench.py --rows 65536 --features 2000 --iters 10 --devices 4 --parallelism data --collective ring --quantized-grad 16 --skip-baseline | tee artifacts/bench_tpu_session_quant_d4_ring.out'
+
 # 5. secondary BASELINE target: ImageFeaturizer imgs/sec on-chip
 step 900 bash -c 'python tools/bench_featurizer.py | tee artifacts/bench_featurizer_tpu.out'
 
