@@ -3,7 +3,7 @@ from .regressor import LightGBMRegressor, LightGBMRegressionModel
 from .ranking import LightGBMRanker, LightGBMRankerModel, ndcg_at_k
 from .booster import Booster, HostTree
 from .binning import BinMapper, fit_bin_mapper
-from .engine import TrainParams, train
+from .engine import TrainParams, train, train_incremental
 from .grower import GrowerConfig, TreeArrays, grow_tree
 from .objectives import Objective, get_objective
 
@@ -12,6 +12,7 @@ __all__ = [
     "LightGBMRegressor", "LightGBMRegressionModel",
     "LightGBMRanker", "LightGBMRankerModel", "ndcg_at_k",
     "Booster", "HostTree", "BinMapper", "fit_bin_mapper",
-    "TrainParams", "train", "GrowerConfig", "TreeArrays", "grow_tree",
+    "TrainParams", "train", "train_incremental",
+    "GrowerConfig", "TreeArrays", "grow_tree",
     "Objective", "get_objective",
 ]

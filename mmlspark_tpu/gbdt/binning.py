@@ -240,6 +240,51 @@ class BinMapper:
                 infos.append(f"[{ub[0]:.6g}:{ub[-1]:.6g}]")
         return infos
 
+    # -- serialization (ISSUE 18) -------------------------------------------
+
+    def to_json(self) -> str:
+        """Exact JSON round-trip of the bin ladder (ISSUE 18): the
+        streaming-ingest spill and the refresh loop persist the ACTIVE
+        model's mapper so binned uint8 segments stay interpretable
+        across process death.  Bounds are float64 and Python's JSON
+        float repr is shortest-round-trip, so
+        ``from_json(m.to_json())`` reproduces every bound bit-exactly
+        (binning, and therefore replay, is deterministic across the
+        crash)."""
+        import json
+        doc = {
+            "format": 1,
+            "upper_bounds": [ub.tolist() for ub in self.upper_bounds],
+            "has_missing": self.has_missing.astype(int).tolist(),
+            "num_total_bins": int(self.num_total_bins),
+            "missing_bin": int(self.missing_bin),
+        }
+        if self.categorical is not None:
+            doc["categorical"] = self.categorical.astype(int).tolist()
+            doc["cat_values"] = [
+                None if cv is None else cv.tolist()
+                for cv in (self.cat_values or [])]
+        return json.dumps(doc, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "BinMapper":
+        import json
+        doc = json.loads(text)
+        if doc.get("format") != 1:
+            raise ValueError(
+                f"unsupported BinMapper format {doc.get('format')!r}")
+        cat = doc.get("categorical")
+        return cls(
+            upper_bounds=[np.asarray(ub, np.float64)
+                          for ub in doc["upper_bounds"]],
+            has_missing=np.asarray(doc["has_missing"], bool),
+            num_total_bins=int(doc["num_total_bins"]),
+            missing_bin=int(doc["missing_bin"]),
+            categorical=None if cat is None else np.asarray(cat, bool),
+            cat_values=None if cat is None else [
+                None if cv is None else np.asarray(cv, np.float64)
+                for cv in doc["cat_values"]])
+
 
 def fit_bin_mapper(X: np.ndarray, max_bin: int = 255,
                    sample_cnt: int = 200000,

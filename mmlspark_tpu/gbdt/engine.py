@@ -1552,6 +1552,63 @@ def train(*args, **kwargs) -> Booster:
     return booster
 
 
+def train_incremental(bins: np.ndarray, labels: np.ndarray,
+                      mapper: BinMapper, *, init_booster: Booster,
+                      objective: Objective, params: TrainParams,
+                      weights: Optional[np.ndarray] = None,
+                      feature_names: Optional[List[str]] = None,
+                      callbacks: Optional[List[Callable]] = None
+                      ) -> Booster:
+    """Continued training straight from pre-binned rows — the
+    fit-from-ingest entry (ISSUE 18).
+
+    The streaming ingest retains rows ALREADY binned to the active
+    model's ladder, so the raw values are gone; but tree thresholds are
+    bin upper bounds, so every raw value in a bin routes through the
+    active forest exactly like the bin's representative value
+    (:func:`_bin_representatives`) — the init margins computed here are
+    bit-identical to what ``base.py`` would compute from the raw rows.
+    The new trees boost from those margins and the returned booster is
+    ``init_booster.extended(new)``, the same merged-forest contract as
+    the estimator's ``initModelPath`` path.
+
+    ``params.checkpoint_dir`` composes: the fingerprint covers
+    ``init_scores``, so a fit SIGKILLed mid-boost resumes bit-identical
+    from the last durable chunk (the chaos drill's kill point).
+    """
+    if params.boosting not in ("gbdt", "goss"):
+        raise ValueError(
+            "incremental training requires boosting gbdt or goss: "
+            f"got {params.boosting!r}")
+    if init_booster.num_class != objective.num_model_per_iteration:
+        raise ValueError(
+            f"init model has num_class={init_booster.num_class}, this "
+            f"fit trains {objective.num_model_per_iteration}")
+    if init_booster.max_feature_idx != mapper.num_features - 1:
+        raise ValueError(
+            f"init model was trained on "
+            f"{init_booster.max_feature_idx + 1} features, the binned "
+            f"matrix has {mapper.num_features}")
+    bins = np.ascontiguousarray(bins)
+    if bins.ndim != 2 or bins.shape[1] != mapper.num_features:
+        raise ValueError(
+            f"bins shape {bins.shape} does not match the mapper's "
+            f"{mapper.num_features} features")
+    reps = _bin_representatives(mapper)
+    Xr = np.empty(bins.shape, np.float64)
+    for j, rep in enumerate(reps):
+        Xr[:, j] = rep[bins[:, j].astype(np.int64)]
+    margins = np.asarray(init_booster.predict_margin(Xr), np.float64)
+    booster = train(bins, labels, weights, mapper, objective, params,
+                    feature_names, init_scores=margins,
+                    callbacks=callbacks)
+    merged = init_booster.extended(booster)
+    # the publishable profile must describe the MERGED forest's margins
+    # (the canary's drift monitor compares live margins against it)
+    _capture_reference_profile(merged, bins, mapper, feature_names)
+    return merged
+
+
 def _train_impl(bins: np.ndarray, labels: np.ndarray,
                 weights: Optional[np.ndarray],
           mapper: BinMapper, objective: Objective, params: TrainParams,

@@ -26,6 +26,8 @@ from .fleet import (ConsistentHashRing, PredictorFleet,
                     ShardedPredictor, shard_tree_ranges)
 from .registry import ModelCorruption, ModelRegistry, RegistryError
 from .rollout import RolloutConfig, RolloutController
+from .ingest import IngestBuffer, IngestError
+from .refresh import RefreshConfig, RefreshController, RefreshError
 from .binary import BinaryFileReader, read_binary_files
 from .powerbi import PowerBIWriter
 
@@ -46,6 +48,8 @@ __all__ = [
     "shard_tree_ranges",
     "ModelCorruption", "ModelRegistry", "RegistryError",
     "RolloutConfig", "RolloutController",
+    "IngestBuffer", "IngestError",
+    "RefreshConfig", "RefreshController", "RefreshError",
     "BinaryFileReader", "read_binary_files",
     "PowerBIWriter",
 ]

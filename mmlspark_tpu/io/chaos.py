@@ -402,6 +402,14 @@ class ChaosDrift:
     * ``nan_rate`` — per-row Bernoulli NaN injection drawn from the
       plan's channel (the "feature went silently null" storm).
 
+    ``ramp_rows > 0`` selects ramp mode (ISSUE 18): instead of a step
+    change at the cut, the injected shift/scale interpolate linearly
+    from no-op to full strength over the ``ramp_rows`` rows following
+    ``after_rows`` — the slow upstream-degradation shape that must
+    still cross the burn threshold.  The per-row ramp fraction is a
+    pure function of the global row index, so the injected stream is
+    identical regardless of batch boundaries.
+
     Deterministic like every injector: the NaN decision sequence is a
     pure function of ``(seed, name)`` and the row index.  Counters:
     ``rows_seen`` / ``rows_injected`` / ``nans_injected`` — the drill's
@@ -411,12 +419,13 @@ class ChaosDrift:
     def __init__(self, plan: ChaosPlan, *, feature: int,
                  shift: float = 0.0, scale: float = 1.0,
                  nan_rate: float = 0.0, after_rows: int = 0,
-                 name: str = "drift"):
+                 ramp_rows: int = 0, name: str = "drift"):
         self.feature = int(feature)
         self.shift = float(shift)
         self.scale = float(scale)
         self.nan_rate = float(nan_rate)
         self.after_rows = int(after_rows)
+        self.ramp_rows = int(ramp_rows)
         self._chan = plan.channel(name)
         self._lock = threading.Lock()
         self.rows_seen = 0
@@ -437,7 +446,18 @@ class ChaosDrift:
         if k0 >= n:
             return X[0] if squeeze else X
         X = X.astype(np.float32, copy=True)
-        col = X[k0:, self.feature] * self.scale + self.shift
+        if self.ramp_rows > 0:
+            # ramp fraction per global row index past the cut: row
+            # ``after_rows + j`` carries (j+1)/ramp_rows of the full
+            # perturbation, saturating at 1 — batch-boundary invariant
+            j = np.arange(start + k0, start + n) - self.after_rows
+            frac = np.minimum((j + 1) / self.ramp_rows, 1.0).astype(
+                np.float32)
+            eff_scale = 1.0 + (self.scale - 1.0) * frac
+            eff_shift = self.shift * frac
+            col = X[k0:, self.feature] * eff_scale + eff_shift
+        else:
+            col = X[k0:, self.feature] * self.scale + self.shift
         if self.nan_rate > 0:
             mask = np.fromiter(
                 (self._chan.fire(self.nan_rate)

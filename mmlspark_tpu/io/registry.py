@@ -159,6 +159,15 @@ class ModelRegistry:
                           sort_keys=True).encode("utf-8")
         _atomic_write(self._manifest_path(), data)
 
+    def reload(self) -> None:
+        """Re-read the manifest from disk, picking up commits made by
+        OTHER processes sharing the registry root — e.g. a refresh
+        trainer publishing a candidate while this process serves.  The
+        manifest replace is atomic, so a reload sees either the old or
+        the new state, never a torn one."""
+        with self._lock:
+            self._manifest = self._read_manifest()
+
     # -- queries -------------------------------------------------------------
 
     def entries(self) -> Dict[int, Dict[str, Any]]:
@@ -341,6 +350,49 @@ class ModelRegistry:
             self._activate_locked(int(to_version))
             self._commit()
             return int(to_version)
+
+    def prune(self, keep_last: int = 5) -> List[int]:
+        """Registry retention/GC (ISSUE 18): delete the model +
+        profile files of ``retired``/``rolled_back`` entries beyond the
+        newest ``keep_last`` of them.  An auto-refreshing loop
+        publishes a new version per drift episode, so without GC
+        ``models/`` grows until the disk fills.
+
+        Atomicity keeps the manifest-as-commit-point invariant:
+        entries leave the manifest FIRST (one atomic replace), files
+        are unlinked after — a crash between the two leaves orphan
+        files the manifest no longer names (invisible garbage, exactly
+        like a crash mid-:meth:`publish`), never a manifest entry whose
+        bytes are gone.  ``quarantined`` entries are never pruned:
+        they are the forensic evidence of proven corruption.  Active
+        and candidate entries are untouched by construction.  Returns
+        the pruned versions, oldest first."""
+        if keep_last < 0:
+            raise RegistryError(
+                f"prune keep_last must be >= 0, got {keep_last}")
+        with self._lock:
+            prunable = sorted(
+                int(v) for v, e in self._manifest["entries"].items()
+                if e.get("promoted_state") in ("retired", "rolled_back"))
+            victims = prunable[:max(0, len(prunable) - int(keep_last))]
+            if not victims:
+                return []
+            paths = []
+            for v in victims:
+                del self._manifest["entries"][str(v)]
+                paths.append(self.model_path(v))
+                paths.append(self.profile_path(v))
+            self._commit()
+        for p in paths:
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass        # profile-less entry, or a re-run after a
+                            # crash between commit and unlink
+        _fsync_dir(self._models)
+        log.info("registry pruned %d version(s): %s",
+                 len(victims), victims)
+        return victims
 
     # -- loads ---------------------------------------------------------------
 

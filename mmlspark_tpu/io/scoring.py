@@ -287,7 +287,8 @@ class ScoringEngine:
                  deadline_ms: Optional[float] = None,
                  supervise: bool = True,
                  stats: Optional[StageStats] = None,
-                 drift_monitor=None):
+                 drift_monitor=None,
+                 ingest_tap: Optional[Callable] = None):
         if (predictor is None) == (transform is None):
             raise ValueError(
                 "pass exactly one of predictor= (hot path) or "
@@ -354,6 +355,21 @@ class ScoringEngine:
         # (ns="drift" + the mmlspark_tpu_drift_* exposition) so the
         # SLO drift objectives and the worker stats beacon see it.
         self._drift = drift_monitor
+        # streaming-ingest tap (ISSUE 18): called with every scored
+        # batch's decoded rows + margins, AFTER the reply-side work is
+        # queued conceptually (same placement as the drift observe).
+        # The deployment decides what a "label" is at this point —
+        # typically enqueue features keyed by rid until ground truth
+        # arrives; the drills append with labels they know.  Advisory
+        # like the drift tap: a raising tap is counted and dropped,
+        # never an answer lost.  Deliberately SYNCHRONOUS, unlike the
+        # duty-gated drift sketches: the tap must see 100% of rows (it
+        # is the training feed), and on the small hosts this serves
+        # from, a handoff queue + drain thread costs more in wakeup
+        # churn than the bin+append it would hide (no-op async tap
+        # measured 5.6% p50 on 1 core vs 0.04% inline; the spill fsync
+        # is amortized over segment_rows).
+        self._ingest_tap = ingest_tap
         self._fatal: Optional[BaseException] = None
         self._died = threading.Event()
         self.stats = stats or StageStats()
@@ -838,6 +854,12 @@ class ScoringEngine:
             # live-traffic sketches (duty-cycle gated inside; never
             # raises) — rows as decoded, margins as scored
             self._drift.observe(X_rows[:n], m)
+        if self._ingest_tap is not None:
+            try:
+                self._ingest_tap(X_rows[:n], m)
+            except Exception:   # noqa: BLE001 - tap is advisory
+                self.stats.incr("ingest_tap_errors")
+                log.exception("ingest tap failed; batch not retained")
         if self._reply_fn is not None:
             return self._reply_fn(m)
         if self._ndarray_replies:

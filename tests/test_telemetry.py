@@ -904,6 +904,31 @@ class TestMetricFamilyDocGuard:
         dmon.flush()
         dmon.close()            # no stray drain thread past this test
         reg.register_exposition("drift", dmon.render_prometheus)
+        # the streaming-ingest and refresh-loop families (ISSUE 18),
+        # rendered off a throwaway spill dir the way io/ingest and
+        # io/refresh publish the real ones (both pre-register their
+        # counters, so every family emits even on a fresh instance)
+        import tempfile
+        from mmlspark_tpu.gbdt import fit_bin_mapper
+        from mmlspark_tpu.io.ingest import IngestBuffer
+        from mmlspark_tpu.io.refresh import RefreshController
+        from mmlspark_tpu.io.registry import ModelRegistry
+        with tempfile.TemporaryDirectory() as td:
+            ing = IngestBuffer(
+                os.path.join(td, "ing"),
+                fit_bin_mapper(np.array([[0.0], [1.0]], np.float32),
+                               max_bin=4),
+                register=False)
+            ing.append(np.array([[0.5]], np.float32),
+                       np.array([0.0]))
+            ref = RefreshController(
+                os.path.join(td, "ref"),
+                registry=ModelRegistry(os.path.join(td, "reg")),
+                rollout=None, ingest=ing, register=False)
+            ing_text = ing.render_prometheus()
+            ref_text = ref.render_prometheus()
+        reg.register_exposition("ingest", lambda: ing_text)
+        reg.register_exposition("refresh", lambda: ref_text)
         # the ops compile-probe info family, rendered off a seeded
         # cache the way ops/pallas_histogram publishes the real one,
         # and the quantized-gradient resolution family (ISSUE 17),

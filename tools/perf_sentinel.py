@@ -29,9 +29,10 @@ sentinel is the automated guard:
   (read by the ``perf_latency_budget`` SLO objective in
   ``core/slo.py``), the artifact embeds the SLO report, and the
   process exits NONZERO — the CI hook.
-* **Profiler overhead A/B** — the always-on profiler's enabled-vs-
-  disabled p50 delta on the closed-loop burst, recorded in the
-  artifact (acceptance: < 3%).
+* **Overhead A/Bs** — enabled-vs-disabled p50 deltas on the
+  closed-loop burst for the always-on profiler, the drift-sketch
+  pipeline (ISSUE 15) and the streaming-ingest tap (ISSUE 18),
+  recorded in the artifact (acceptance: < 3% each).
 
 Seeded-fault hook: ``MMLSPARK_TPU_PERF_SLOWDOWN="stage=factor[,..]"``
 stretches the named stage's measured region by real wall-clock sleeps
@@ -192,13 +193,15 @@ def _model(args):
     return b, X
 
 
-def scoring_burst_p50(args, duration=None, warm_s=0.4, drift=False):
+def scoring_burst_p50(args, duration=None, warm_s=0.4, drift=False,
+                      ingest_tap=None):
     """One closed-loop burst through a real ScoringEngine; returns the
     client-observed p50 in ms.  Shared by the ``scoring_engine`` stage
-    and the profiler/sketch overhead A/Bs (and the tier-1 overhead
-    tests).  ``drift=True`` attaches a production-configured
+    and the profiler/sketch/ingest overhead A/Bs (and the tier-1
+    overhead tests).  ``drift=True`` attaches a production-configured
     DriftMonitor (ISSUE 15) so the A/B measures the sketch hot path
-    exactly as deployed — duty-cycle gate included."""
+    exactly as deployed — duty-cycle gate included; ``ingest_tap``
+    plugs a streaming-ingest tap (ISSUE 18) into the engine."""
     import numpy as np
     from mmlspark_tpu.io.scoring import ColumnPlan, ScoringEngine
     b, X = _model(args)
@@ -226,7 +229,8 @@ def scoring_burst_p50(args, duration=None, warm_s=0.4, drift=False):
                         plan=ColumnPlan("features", X.shape[1]),
                         max_rows=64, latency_budget_ms=2.0,
                         num_scorers=1, num_repliers=0,
-                        drift_monitor=drift_monitor).start()
+                        drift_monitor=drift_monitor,
+                        ingest_tap=ingest_tap).start()
     try:
         srv.pump()
         time.sleep(warm_s)
@@ -438,6 +442,49 @@ def measure_sketch_overhead(args):
             "accept_overhead_lt_3pct": pct < 3.0}
 
 
+def measure_ingest_overhead(args):
+    """Ingest-tap-enabled vs disabled A/B on the closed-loop scoring
+    burst (ISSUE 18 satellite): the enabled arm appends every scored
+    batch — binned to the model's ladder, spilled past the segment
+    bound — into a real IngestBuffer through the engine's
+    ``ingest_tap`` seam.  Same <3% p50 discipline as the profiler and
+    sketch gates; interleaved reps, median p50 per arm."""
+    import statistics as st
+    import tempfile
+
+    import numpy as np
+    from mmlspark_tpu.gbdt import fit_bin_mapper
+    from mmlspark_tpu.io.ingest import IngestBuffer
+    _b, X = _model(args)
+    mapper = fit_bin_mapper(X, max_bin=63)
+    p50 = {True: [], False: []}
+    with tempfile.TemporaryDirectory() as td:
+        ing = IngestBuffer(os.path.join(td, "ingest"), mapper,
+                           window_rows=50000, reservoir_rows=512,
+                           segment_rows=4096, register=False)
+
+        def tap(rows, margins):
+            # the drill-grade label join: a deployment substitutes its
+            # own; the append cost being measured is identical
+            ing.append(rows, np.asarray(margins, np.float64))
+
+        for _ in range(args.overhead_reps):
+            for enabled in (True, False):
+                p50[enabled].append(scoring_burst_p50(
+                    args, duration=args.overhead_duration,
+                    ingest_tap=tap if enabled else None))
+        rows_ingested = int(ing.rows_seen)
+    on, off = st.median(p50[True]), st.median(p50[False])
+    pct = (on - off) / off * 100.0 if off > 0 else float("nan")
+    return {"p50_ms_enabled": round(on, 4),
+            "p50_ms_disabled": round(off, 4),
+            "overhead_pct": round(pct, 2),
+            "rows_ingested": rows_ingested,
+            "runs_enabled": [round(v, 4) for v in p50[True]],
+            "runs_disabled": [round(v, 4) for v in p50[False]],
+            "accept_overhead_lt_3pct": pct < 3.0}
+
+
 # ---------------------------------------------------------------- main
 
 
@@ -485,6 +532,7 @@ def run(args):
 
     overhead = None
     sketch_overhead = None
+    ingest_overhead = None
     if not args.skip_overhead:
         print("== profiler overhead A/B ==", flush=True)
         overhead = measure_profiler_overhead(args)
@@ -492,6 +540,9 @@ def run(args):
         print("== drift-sketch overhead A/B ==", flush=True)
         sketch_overhead = measure_sketch_overhead(args)
         print(json.dumps(sketch_overhead), flush=True)
+        print("== ingest-tap overhead A/B ==", flush=True)
+        ingest_overhead = measure_ingest_overhead(args)
+        print(json.dumps(ingest_overhead), flush=True)
 
     # sample the monitor twice so the gauge objective gets a window
     mon = get_monitor()
@@ -510,6 +561,7 @@ def run(args):
         "rel_threshold": args.rel,
         "profiler_overhead": overhead,
         "sketch_overhead": sketch_overhead,
+        "ingest_overhead": ingest_overhead,
         "host": host_info(),
         "slo": {"healthy": slo["healthy"],
                 "breaching": slo["breaching"],
