@@ -81,6 +81,9 @@ class SLObjective:
 
 def default_objectives() -> Tuple[SLObjective, ...]:
     """The objectives the serving/chaos stack implicitly defends."""
+    # local import: capacity pulls in telemetry/profiling and this
+    # module is imported during interpreter-level bootstrap paths
+    from .capacity import SATURATION_ONSET_RATIO
     return (
         SLObjective(
             "scoring_goodput", 0.999,
@@ -132,6 +135,24 @@ def default_objectives() -> Tuple[SLObjective, ...]:
             "gate (--rel, default 1.8) — a stricter SLO would breach "
             "on runs the sentinel itself calls healthy",
             gauge=("perf", "worst_regression_ratio"), threshold=1.8),
+        SLObjective(
+            "scoring_headroom", 0.99,
+            "scoring load staying below the saturation-onset fraction "
+            "of the estimated capacity knee (core/capacity.py "
+            "publishes the headroom gauge under ns='capacity'; silent "
+            "until a capacity monitor runs).  Burns BEFORE "
+            "scoring_goodput does: headroom crosses onset while "
+            "requests are still being answered in time, so the page "
+            "says 'approaching saturation', not 'SLO violated'",
+            gauge=("capacity", "headroom_scoring"),
+            threshold=SATURATION_ONSET_RATIO),
+        SLObjective(
+            "transport_headroom", 0.99,
+            "transport load staying below the saturation-onset "
+            "fraction of the estimated wire-capacity knee (silent "
+            "until a capacity monitor runs)",
+            gauge=("capacity", "headroom_transport"),
+            threshold=SATURATION_ONSET_RATIO),
     )
 
 

@@ -192,13 +192,44 @@ def render_prometheus(snapshots: Dict[str, dict],
     return "\n".join(out) + "\n" if out else "# no metrics registered\n"
 
 
+#: point-in-time gauges whose cross-process aggregate is the SUM —
+#: backlog/occupancy COUNTS where the fleet-wide total is the operable
+#: number (total queued requests, total in-flight fan-outs), not the
+#: single deepest member.  Level/ratio-style gauges (ages, busy
+#: fractions, headroom ratios) stay max — summing two 0.6 busy
+#: fractions into 1.2 would be nonsense.  Keyed by metric name so a
+#: beacon from an older worker merges under the same policy as a local
+#: snapshot (ISSUE 20 satellite).
+GAUGE_SUM_NAMES = frozenset({
+    "queue_depth", "fanout_inflight", "shards_awaited",
+})
+GAUGE_SUM_SUFFIXES = ("_depth", "_inflight")
+
+
+def gauge_merge_mode(name: str) -> str:
+    """``"min"`` | ``"sum"`` | ``"max"`` — the cross-process merge
+    policy for a point-in-time gauge, keyed by its metric name:
+    ``*_up`` health booleans take min (one degraded member must show),
+    depth/in-flight backlog counts sum (the aggregate is the total
+    backlog), everything else takes max (the worst level)."""
+    if name.endswith("_up"):
+        return "min"
+    if name in GAUGE_SUM_NAMES or name.endswith(GAUGE_SUM_SUFFIXES):
+        return "sum"
+    return "max"
+
+
 def merge_snapshots(snaps: Iterable[dict]) -> dict:
     """Merge several StageStats snapshots into one aggregate (the
     "workers" total block of a multiprocess scrape): rows and counters
-    SUM, rows/s sums (concurrent sources), gauges take the WORST value
-    — max for age/level-style gauges, MIN for up-style gauges (``*_up``
-    health booleans, where 1 is healthy and one degraded member must
-    show in the aggregate).  Stage latencies merge EXACTLY: the
+    SUM, rows/s sums (concurrent sources), gauges merge under the
+    name-keyed :func:`gauge_merge_mode` policy — MIN for up-style
+    health booleans (``*_up``, where 1 is healthy and one degraded
+    member must show in the aggregate), SUM for depth/in-flight
+    backlog counts (per-worker queue depths are point-in-time levels,
+    but the fleet-wide backlog is their total — taking the max under-
+    reported it), MAX for every other level-style gauge (ages, ratios,
+    occupancies).  Stage latencies merge EXACTLY: the
     log-bucket counts every :class:`~mmlspark_tpu.core.profiling.
     LatencyStats` snapshot carries are key-wise summed and the
     aggregate p50/p99 recomputed from the combined buckets — the
@@ -219,9 +250,12 @@ def merge_snapshots(snaps: Iterable[dict]) -> dict:
         for k, v in (snap.get("counters") or {}).items():
             out["counters"][k] = out["counters"].get(k, 0) + v
         for k, v in (snap.get("gauges") or {}).items():
-            if k.endswith("_up"):
+            mode = gauge_merge_mode(k)
+            if mode == "min":
                 out["gauges"][k] = min(
                     out["gauges"].get(k, float("inf")), v)
+            elif mode == "sum":
+                out["gauges"][k] = out["gauges"].get(k, 0) + v
             else:
                 out["gauges"][k] = max(
                     out["gauges"].get(k, float("-inf")), v)

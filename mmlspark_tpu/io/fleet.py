@@ -50,6 +50,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core.capacity import capacity_enabled
 from ..core.profiler import get_profiler
 from ..core.profiling import StageStats
 from ..core.telemetry import get_registry
@@ -470,10 +471,29 @@ class PredictorFleet:
         prof.alias("fleet.fanout", self._pt_fanout)
         prof.alias("fleet.wait", self._pt_wait)
         prof.alias("fleet.reduce", self._pt_reduce)
+        # saturation taps (ISSUE 20), flag cached like the scoring
+        # engine's: in-flight fan-outs and shard responses still owed
+        # are the fleet's backlog gauges (summed across processes by
+        # the gauge merge policy); reduce_wait_ms is the last request's
+        # wait+reduce tail — the first number to grow when a shard
+        # stops keeping up
+        self._cap_taps = capacity_enabled()
+        if self._cap_taps:
+            self.stats.set_gauge("fanout_inflight", 0.0)
+            self.stats.set_gauge("shards_awaited", 0.0)
         # data-quality tap (ISSUE 15): attach_drift() installs a
         # DriftMonitor; score() then sketches every request's feature
         # block + reduced margins at the fan-out point
         self._drift = None
+
+    def _note_backlog_locked(self) -> None:
+        """Refresh the fan-out backlog gauges (called under
+        ``self._lock``): requests in flight, and shard responses still
+        owed across them — the per-shard saturation signal."""
+        self.stats.set_gauge("fanout_inflight", float(len(self._calls)))
+        self.stats.set_gauge(
+            "shards_awaited",
+            float(sum(len(c.expect) for c in self._calls.values())))
 
     def attach_drift(self, monitor) -> "PredictorFleet":
         """Attach a :class:`~mmlspark_tpu.core.drift.DriftMonitor`
@@ -889,6 +909,8 @@ class PredictorFleet:
         call = _FleetCall(targets)
         with self._lock:
             self._calls[rid] = call
+            if self._cap_taps:
+                self._note_backlog_locked()
         self.stats.incr("requests")
         prof = get_profiler()
         t0 = time.perf_counter()
@@ -920,7 +942,10 @@ class PredictorFleet:
         finally:
             with self._lock:
                 self._calls.pop(rid, None)
-        self._pt_wait.record(time.perf_counter() - t_wait)
+                if self._cap_taps:
+                    self._note_backlog_locked()
+        wait_s = time.perf_counter() - t_wait
+        self._pt_wait.record(wait_s)
         t_red = time.perf_counter()
         if self.routing == "replica":
             out = call.parts[targets[0]]
@@ -932,7 +957,14 @@ class PredictorFleet:
             out = call.parts[order[0]]
             for s in order[1:]:
                 out = out + call.parts[s]
-        self._pt_reduce.record(time.perf_counter() - t_red)
+        reduce_s = time.perf_counter() - t_red
+        self._pt_reduce.record(reduce_s)
+        if self._cap_taps:
+            # the wait+reduce tail of THIS request, as an instantaneous
+            # level — the per-shard lag signal the merged scrape shows
+            # without waiting for a histogram window to fill
+            self.stats.set_gauge("reduce_wait_ms",
+                                 round((wait_s + reduce_s) * 1e3, 3))
         # the request window covers fanout+wait+reduce — it is the
         # fleet's e2e and the aliased fleet.request denominator; slow
         # fan-outs also land on the trace timeline (rid doubles as the

@@ -85,6 +85,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ..core.capacity import capacity_enabled, ensure_capacity_sampler
 from ..core.profiler import get_profiler
 from ..core.profiling import StageStats
 from ..core.schema import DataTable
@@ -400,6 +401,18 @@ class ScoringEngine:
         self._prof.alias("scoring.reply", self._pt_reply)
         self._prof.alias("scoring.e2e", self._pt_e2e)
         self._prof.alias("scoring.queue_wait", self._pt_queue_wait)
+        # saturation taps (ISSUE 20): the enabled flag is CACHED here —
+        # per-batch tap sites pay one attribute check when capacity
+        # observability is off (the sentinel A/B constructs a fresh
+        # engine per arm, so flipping capacity.configure() between
+        # bursts is the whole switch).  queue_age records the batch-max
+        # true queue age at admission (stamped exchanges only): the
+        # capacity monitor's knee estimator reads its windowed p50 —
+        # queueing delay is where saturation shows first, and e2e
+        # deliberately excludes it
+        self._cap_taps = capacity_enabled()
+        self._pt_queue_age = self.stats.timer("queue_age")
+        self._prof.alias("scoring.queue_age", self._pt_queue_age)
         # journaling is hot-path work too: attributing it explicitly
         # is what lets perf_report explain >=90% of e2e instead of
         # showing an anonymous gap
@@ -552,6 +565,20 @@ class ScoringEngine:
                         shed.append(self._norm(q.get_nowait()))
                     except queue.Empty:
                         break
+            if self._cap_taps:
+                # batch-close saturation taps (ISSUE 20): the residual
+                # backlog after this batch formed, and how full the
+                # batch is against its row cap — both per BATCH, not
+                # per row
+                if qsize is not None:
+                    try:
+                        self.stats.set_gauge("queue_depth",
+                                             float(qsize()))
+                    except (NotImplementedError, OSError):
+                        pass
+                self.stats.set_gauge(
+                    "batch_occupancy",
+                    round(len(batch) / max(1, self._max_rows), 4))
             live, errors = self._admit(batch, shed)
         except Exception:  # noqa: BLE001 - form-path bug / bad item
             # rows already pulled off the queue MUST still get replies:
@@ -614,9 +641,12 @@ class ScoringEngine:
         outside the form lock."""
         now = time.perf_counter()
         live, expired = [], []
+        max_age = 0.0
         for entry in batch:
             rid, payload, t_enq = entry
             age = now - t_enq
+            if age > max_age:
+                max_age = age
             dl = self._deadline
             if isinstance(payload, dict) and "_deadline_ms" in payload:
                 try:
@@ -636,6 +666,11 @@ class ScoringEngine:
                 shed.append(entry)
             else:
                 live.append(entry)
+        if self._cap_taps and batch:
+            # admission tap (ISSUE 20): one histogram insert per batch
+            # with the WORST queue age aboard — true queue age for
+            # stamped exchanges, ~0 for unstamped 2-tuples
+            self._pt_queue_age.record(max_age)
         errors = []
         if shed:
             self.stats.incr("shed", len(shed))
@@ -687,6 +722,13 @@ class ScoringEngine:
             self._current[slot] = (batch, t_first)
             with self._inflight_lock:
                 self._inflight += 1
+                inflight = self._inflight
+            if self._cap_taps:
+                # scorer utilization at batch start: the fraction of
+                # scorer slots busy the moment this batch shipped
+                self.stats.set_gauge(
+                    "worker_busy",
+                    round(inflight / self._num_scorers, 4))
             try:
                 if self._predictor is not None:
                     pairs = self._score_predictor(batch)
@@ -1038,6 +1080,15 @@ class ScoringEngine:
         # render_metrics) see its stage latencies and resilience
         # counters without any per-server plumbing
         get_registry().register("scoring", self.stats)
+        if self._cap_taps:
+            # saturation wiring (ISSUE 20): observable zeros for the
+            # instantaneous gauges, and the process-global capacity
+            # sampler (knee estimation, busy fractions, headroom SLO
+            # gauges) ticking wherever an engine serves
+            self.stats.set_gauge("queue_depth", 0.0)
+            self.stats.set_gauge("batch_occupancy", 0.0)
+            self.stats.set_gauge("worker_busy", 0.0)
+            ensure_capacity_sampler()
         if self._drift is not None:
             # the newest engine's monitor owns ns="drift" (and the
             # mmlspark_tpu_drift_* families), same semantics as above

@@ -131,6 +131,18 @@ class _ServingHandler(BaseHTTPRequestHandler):
         from ..core.slo import get_monitor
         return get_monitor().report()
 
+    def _statusz(self) -> str:
+        """Plain text for /statusz: the one-page operational summary
+        (model version, SLO burn, capacity headroom, top phases,
+        worker liveness) assembled from the registries that already
+        exist — no new state (ISSUE 20 satellite)."""
+        from ..core.capacity import render_statusz
+        try:
+            info = self._model_info()
+        except Exception:  # noqa: BLE001 - advisory block
+            info = None
+        return render_statusz(model_info=info)
+
     def do_GET(self):
         if self.path == "/healthz":
             # liveness: the accept loop is running
@@ -169,6 +181,20 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/statusz":
+            try:
+                text = self._statusz()
+            except Exception:  # noqa: BLE001 - a status page must
+                log.exception("serving: /statusz render failed")
+                self.send_error(503, "statusz unavailable")
+                return
+            body = text.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -354,6 +380,10 @@ class HTTPServer:
         # at its model_info() so operators can read the active
         # version/digest off the readiness probe (ISSUE 14 satellite)
         self.model_info_provider: Optional[Callable[[], dict]] = None
+        # /statusz hook: None -> the default one-page summary built
+        # from the process-global registries; the multiprocess driver
+        # points every worker's route at its fleet-wide render
+        self.statusz_provider: Optional[Callable[[], str]] = None
         outer = self
 
         class Handler(_ServingHandler):
@@ -375,6 +405,12 @@ class HTTPServer:
                 if provider is not None:
                     return provider()
                 return super()._metrics()
+
+            def _statusz(self):
+                provider = outer.statusz_provider
+                if provider is not None:
+                    return provider()
+                return super()._statusz()
 
             def do_POST(self):
                 if api_path not in ("/", self.path):
@@ -496,6 +532,18 @@ class DistributedHTTPServer:
             self, provider: Optional[Callable[[], dict]]) -> None:
         for w in self.workers:
             w.model_info_provider = provider
+
+    @property
+    def statusz_provider(self) -> Optional[Callable[[], str]]:
+        """/statusz hook, fanned out to every worker server."""
+        return self.workers[0].statusz_provider if self.workers \
+            else None
+
+    @statusz_provider.setter
+    def statusz_provider(
+            self, provider: Optional[Callable[[], str]]) -> None:
+        for w in self.workers:
+            w.statusz_provider = provider
 
     @property
     def request_queue(self) -> "queue.Queue[Tuple[str, Any, float]]":
@@ -695,13 +743,14 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
             except OSError:
                 pass
         elif channel == CH_METRICS and op in ("metrics_txt",
-                                              "slo_json"):
-            # driver's answer to a /metrics or /slo round-trip
+                                              "slo_json",
+                                              "statusz_txt"):
+            # driver's answer to a /metrics, /slo or /statusz round-trip
             with plock:
                 mw = mwaiters.pop(msg.get("req"), None)
             if mw is not None:
-                mw.response = (msg.get("text") if op == "metrics_txt"
-                               else msg.get("report"))
+                mw.response = (msg.get("report") if op == "slo_json"
+                               else msg.get("text"))
                 mw.event.set()
 
     def _payload_tid(rid, payload):
@@ -818,6 +867,34 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                 with plock:
                     mwaiters.pop(nonce, None)
                 return get_monitor().report()
+            return waiter.response
+
+        def _statusz(self):
+            # the fleet-wide status page (SLO burn, headroom, worker
+            # liveness) is assembled in the DRIVER process — one
+            # exchange round-trip like /slo; link down / driver silent
+            # degrades to this worker's local summary
+            from ..core.capacity import render_statusz
+            local = lambda: render_statusz(  # noqa: E731
+                model_info=link.get("model_info"))
+            if not client.connected:
+                return local()
+            nonce = uuid.uuid4().hex
+            waiter = _Pending()
+            with plock:
+                mwaiters[nonce] = waiter
+            try:
+                client.send(CH_METRICS,
+                            {"op": "statusz_req", "req": nonce},
+                            deadline_ms=5000)
+            except OSError:
+                with plock:
+                    mwaiters.pop(nonce, None)
+                return local()
+            if not waiter.event.wait(5.0):
+                with plock:
+                    mwaiters.pop(nonce, None)
+                return local()
             return waiter.response
 
         def do_POST(self):
@@ -963,6 +1040,13 @@ def _mp_worker_main(driver_host: str, driver_port: int, worker_id: int,
                 dm = peek_drift_monitor()
                 if dm is not None:
                     payload["drift"] = dm.snapshot()
+                # the saturation block rides the same beacon (ISSUE
+                # 20): per-worker headroom/busy gauges merge into the
+                # driver scrape under the gauge merge policy
+                from ..core.capacity import peek_capacity_monitor
+                cm = peek_capacity_monitor()
+                if cm is not None:
+                    payload["capacity"] = cm.snapshot()
                 client.send(CH_STATS, payload)
             except OSError:
                 pass
@@ -1088,6 +1172,11 @@ class MultiprocessHTTPServer:
         # merges them (counters SUM = the merged sketch, gauges take
         # the worst arm) into one ns="drift" block
         self.worker_drift: Dict[int, dict] = {}
+        # per-worker saturation blocks (ISSUE 20): capacity monitors
+        # piggyback their headroom/busy gauges on the stats beacon;
+        # render_metrics merges them (depth gauges SUM, levels take
+        # the worst arm) into one ns="capacity" view
+        self.worker_capacity: Dict[int, dict] = {}
         # worker slot -> monotonic instant of its last stats beacon (or
         # scrape piggyback): the per-worker `worker_up` gauge ages from
         # here, so a silent worker is visible from ONE scrape
@@ -1233,6 +1322,7 @@ class MultiprocessHTTPServer:
                 w: {**s, "gauges": dict(s.get("gauges") or {})}
                 for w, s in self.worker_stats.items()}
             worker_drift = list(self.worker_drift.values())
+            worker_cap = list(self.worker_capacity.values())
             seen = dict(self._beacon_seen)
         for w in range(len(self.addresses)):
             snap = per_worker.setdefault(
@@ -1258,7 +1348,40 @@ class MultiprocessHTTPServer:
             blocks = worker_drift + ([dm.snapshot()]
                                      if dm is not None else [])
             extra["drift"] = merge_snapshots(blocks)
+        # merged saturation view: the gauge merge policy (min for
+        # *_up, sum for *_depth/*_inflight, max otherwise) makes the
+        # fold meaningful — total queued work sums, worst headroom
+        # dominates (ISSUE 20)
+        from ..core.capacity import peek_capacity_monitor
+        cm = peek_capacity_monitor()
+        cap_blocks = worker_cap + ([cm.snapshot()]
+                                   if cm is not None else [])
+        if cap_blocks:
+            extra["capacity"] = merge_snapshots(cap_blocks)
         return get_registry().render_prometheus(extra=extra)
+
+    def render_statusz(self) -> str:
+        """Topology-wide ``/statusz``: the capacity module's operator
+        page plus per-slot worker liveness from the beacon ages — the
+        one-glance saturation answer for the whole serving fleet."""
+        from ..core.capacity import render_statusz
+        now = time.monotonic()
+        with self._lock:
+            seen = dict(self._beacon_seen)
+            n = len(self.addresses)
+        workers = {}
+        for w in range(n):
+            age_s = (now - seen[w]) if w in seen else float("inf")
+            workers[f"worker{w}"] = {
+                "up": age_s <= self.beacon_stale_s,
+                "beacon_age_s": round(age_s, 3)}
+        info = None
+        if self.model_info_provider is not None:
+            try:
+                info = self.model_info_provider()
+            except Exception:  # noqa: BLE001 - advisory block
+                info = None
+        return render_statusz(model_info=info, workers=workers)
 
     def _beacon_loop(self) -> None:
         """Broadcast the installed ``ready_check`` verdict to every
@@ -1398,6 +1521,9 @@ class MultiprocessHTTPServer:
                 if w is not None and isinstance(msg.get("drift"),
                                                 dict):
                     self.worker_drift[w] = msg["drift"]
+                if w is not None and isinstance(msg.get("capacity"),
+                                                dict):
+                    self.worker_capacity[w] = msg["capacity"]
         elif channel == CH_METRICS and op == "metrics_req":
             # a /metrics scrape hit this worker: fold its piggybacked
             # stats in, render the WHOLE topology (driver registry +
@@ -1436,6 +1562,21 @@ class MultiprocessHTTPServer:
                                           "req": msg.get("req"),
                                           "report": report},
                              timeout=2.0)
+            except OSError:
+                pass
+        elif channel == CH_METRICS and op == "statusz_req":
+            # a /statusz probe hit a worker: the authoritative view
+            # (burn states, headroom, fleet liveness) lives on the
+            # driver — render here and answer
+            try:
+                text = self.render_statusz()
+            except Exception:  # noqa: BLE001 - probe must degrade
+                log.exception("serving: statusz render failed")
+                text = "statusz render failed\n"
+            try:
+                session.send(CH_METRICS, {"op": "statusz_txt",
+                                          "req": msg.get("req"),
+                                          "text": text}, timeout=2.0)
             except OSError:
                 pass
 

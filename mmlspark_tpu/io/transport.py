@@ -96,6 +96,7 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..core.capacity import capacity_enabled
 from ..core.profiler import get_profiler
 from ..core.profiling import StageStats
 from ..core.telemetry import get_journal, get_registry
@@ -349,6 +350,12 @@ _PROF = get_profiler()
 _PROF.alias("transport.encode_json", _ENC_JSON)
 _PROF.alias("transport.decode_json", _DEC_JSON)
 _PT_WIRE = _PROF.timer("transport.wire_write")
+# the wire-write histogram is SHARED back into the transport namespace
+# (same zero-copy adopt the profiler aliases use) so the capacity
+# monitor's transport resource can window it from the registry — the
+# knee estimator reads throughput (frames_sent) against wire-write
+# latency, both under ns="transport" (ISSUE 20)
+transport_stats.adopt("wire_write", _PT_WIRE)
 # per-channel payload-byte counter KEYS, precomputed for the same
 # reason (no per-frame f-string build; channels above the table fall
 # back to on-the-fly names)
@@ -471,6 +478,13 @@ class Session:
         self._slock = threading.Lock()      # wire write serialization
         self._cv = threading.Condition()    # credits + connect state
         self._credits = 0
+        #: the credit window the peer last granted whole — the
+        #: denominator for the ``credit_occupancy`` saturation gauge
+        #: (1 - credits/window; ISSUE 20).  Taps are gated on the flag
+        #: cached at session construction — one bool check per send
+        #: when capacity observability is off.
+        self._credit_window = max(1, int(cfg.initial_credits))
+        self._cap_taps = capacity_enabled()
         self._next_seq = 0                  # last DATA seq assigned
         self._peer_ack = 0                  # highest seq peer confirmed
         #: seq -> (channel, payload, abs_deadline_monotonic|None, flags)
@@ -636,6 +650,8 @@ class Session:
             if stalled:
                 transport_stats.incr("backpressure_stalls")
             self._credits -= 1
+            if self._cap_taps:
+                self._note_occupancy_locked()
             self._next_seq += 1
             seq = self._next_seq
             abs_deadline = (time.monotonic() + deadline_ms / 1e3
@@ -738,10 +754,24 @@ class Session:
             get_journal().emit("hop_ack", tid=tid, seq=seq,
                                session=self.name)
 
+    def _note_occupancy_locked(self) -> None:
+        """Refresh the ``credit_occupancy`` gauge (fraction of the
+        granted window currently consumed — 1.0 means the next send
+        blocks on backpressure).  Called under ``self._cv``."""
+        transport_stats.set_gauge(
+            "credit_occupancy",
+            round(1.0 - self._credits / self._credit_window, 4))
+
     def grant(self, n: int) -> None:
         """Receive an incremental flow-control grant of ``n`` frames."""
         with self._cv:
             self._credits += n
+            if self._credits > self._credit_window:
+                # the peer widened the window (credits above the last
+                # whole grant): track it so occupancy stays in [0, 1]
+                self._credit_window = self._credits
+            if self._cap_taps:
+                self._note_occupancy_locked()
             self._cv.notify_all()
 
     def set_credits(self, n: int) -> None:
@@ -749,6 +779,9 @@ class Session:
         balance (a stale pre-blip balance must not compound)."""
         with self._cv:
             self._credits = n
+            self._credit_window = max(1, int(n))
+            if self._cap_taps:
+                self._note_occupancy_locked()
             self._cv.notify_all()
 
     def send_credit(self, n: int) -> None:
@@ -919,6 +952,9 @@ class Session:
             self._traced.clear()
             self._traced_sent.clear()
             self._credits = credits
+            self._credit_window = max(1, int(credits))
+            if self._cap_taps:
+                self._note_occupancy_locked()
             self._cv.notify_all()
         transport_stats.incr("session_resets")
 

@@ -179,6 +179,31 @@ class TestMetricsRegistry:
         assert m["stages"]["score"]["count"] == 3
         assert m["stages"]["score"]["p99_ms"] == 60.0
 
+    def test_gauge_merge_policy_two_process(self):
+        """ISSUE 20 satellite: the name-keyed gauge merge policy.
+        Depth-style gauges (queue_depth, *_inflight) SUM — two workers
+        each holding 3 queued requests is a backlog of 6, not 3;
+        up-style gauges take MIN; level-style gauges keep worst-of
+        MAX.  Pinned with a literal two-process merge so a policy
+        regression cannot hide behind the aggregate."""
+        from mmlspark_tpu.core.telemetry import gauge_merge_mode
+        assert gauge_merge_mode("queue_depth") == "sum"
+        assert gauge_merge_mode("fanout_inflight") == "sum"
+        assert gauge_merge_mode("shards_awaited") == "sum"
+        assert gauge_merge_mode("replies_depth") == "sum"
+        assert gauge_merge_mode("worker_up") == "min"
+        assert gauge_merge_mode("worker_busy") == "max"
+        assert gauge_merge_mode("headroom_scoring") == "max"
+        w0 = {"gauges": {"queue_depth": 3.0, "fanout_inflight": 2.0,
+                         "worker_busy": 0.5, "worker_up": 1.0}}
+        w1 = {"gauges": {"queue_depth": 3.0, "fanout_inflight": 1.0,
+                         "worker_busy": 0.9, "worker_up": 0.0}}
+        m = merge_snapshots([w0, w1])
+        assert m["gauges"]["queue_depth"] == 6.0
+        assert m["gauges"]["fanout_inflight"] == 3.0
+        assert m["gauges"]["worker_busy"] == 0.9
+        assert m["gauges"]["worker_up"] == 0.0
+
 
 # ---------------------------------------------------------------- satellites
 
@@ -929,6 +954,17 @@ class TestMetricFamilyDocGuard:
             ref_text = ref.render_prometheus()
         reg.register_exposition("ingest", lambda: ing_text)
         reg.register_exposition("refresh", lambda: ref_text)
+        # the capacity monitor's families (ISSUE 20), rendered off a
+        # hand-seeded monitor so every mmlspark_tpu_capacity_* family
+        # emits at least one sample (the real one is seeded by
+        # ensure_capacity_sampler at engine start)
+        from mmlspark_tpu.core.capacity import CapacityMonitor
+        cmon = CapacityMonitor(registry=reg)
+        for g, v in (("headroom_scoring", 0.5), ("knee_scoring", 100.0),
+                     ("load_scoring", 50.0), ("saturated_scoring", 0.0),
+                     ("busy_scoring.score", 0.25)):
+            cmon.stats.set_gauge(g, v)
+        reg.register_exposition("capacity", cmon.render_prometheus)
         # the ops compile-probe info family, rendered off a seeded
         # cache the way ops/pallas_histogram publishes the real one,
         # and the quantized-gradient resolution family (ISSUE 17),

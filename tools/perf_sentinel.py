@@ -442,6 +442,41 @@ def measure_sketch_overhead(args):
             "accept_overhead_lt_3pct": pct < 3.0}
 
 
+def measure_capacity_overhead(args):
+    """Capacity-saturation-sampler enabled vs disabled A/B on the
+    closed-loop scoring burst (ISSUE 20 satellite): the enabled arm
+    constructs the engine with the saturation taps live (per-batch
+    gauge stores + the queue_age histogram + the 1 Hz sampler
+    ``ensure_capacity_sampler`` installs at engine start); the
+    disabled arm flips ``capacity.configure(False)`` before
+    construction, so the engine caches the off switch and a lingering
+    sampler ticker no-ops.  Same <3% p50 discipline as the profiler /
+    sketch / ingest gates; interleaved reps, median p50 per arm."""
+    import statistics as st
+    from mmlspark_tpu.core import capacity
+    was = capacity.configure()
+    p50 = {True: [], False: []}
+    try:
+        for _ in range(args.overhead_reps):
+            for enabled in (True, False):
+                capacity.configure(enabled=enabled)
+                p50[enabled].append(scoring_burst_p50(
+                    args, duration=args.overhead_duration))
+    finally:
+        capacity.configure(enabled=was)
+        cm = capacity.peek_capacity_monitor()
+        if cm is not None:
+            cm.stop()   # the A/B's ticker must not shade later stages
+    on, off = st.median(p50[True]), st.median(p50[False])
+    pct = (on - off) / off * 100.0 if off > 0 else float("nan")
+    return {"p50_ms_enabled": round(on, 4),
+            "p50_ms_disabled": round(off, 4),
+            "overhead_pct": round(pct, 2),
+            "runs_enabled": [round(v, 4) for v in p50[True]],
+            "runs_disabled": [round(v, 4) for v in p50[False]],
+            "accept_overhead_lt_3pct": pct < 3.0}
+
+
 def measure_ingest_overhead(args):
     """Ingest-tap-enabled vs disabled A/B on the closed-loop scoring
     burst (ISSUE 18 satellite): the enabled arm appends every scored
@@ -533,6 +568,7 @@ def run(args):
     overhead = None
     sketch_overhead = None
     ingest_overhead = None
+    capacity_overhead = None
     if not args.skip_overhead:
         print("== profiler overhead A/B ==", flush=True)
         overhead = measure_profiler_overhead(args)
@@ -543,6 +579,9 @@ def run(args):
         print("== ingest-tap overhead A/B ==", flush=True)
         ingest_overhead = measure_ingest_overhead(args)
         print(json.dumps(ingest_overhead), flush=True)
+        print("== capacity-sampler overhead A/B ==", flush=True)
+        capacity_overhead = measure_capacity_overhead(args)
+        print(json.dumps(capacity_overhead), flush=True)
 
     # sample the monitor twice so the gauge objective gets a window
     mon = get_monitor()
@@ -562,6 +601,7 @@ def run(args):
         "profiler_overhead": overhead,
         "sketch_overhead": sketch_overhead,
         "ingest_overhead": ingest_overhead,
+        "capacity_overhead": capacity_overhead,
         "host": host_info(),
         "slo": {"healthy": slo["healthy"],
                 "breaching": slo["breaching"],
@@ -586,7 +626,7 @@ def main(argv=None) -> int:
                     "bench baselines (nonzero exit on regression)")
     ap.add_argument("--baseline",
                     default=os.path.join(_REPO, "artifacts",
-                                         "perf_sentinel_r17.json"),
+                                         "perf_sentinel_r20.json"),
                     help="prior sentinel artifact or committed "
                          "bench_serving artifact (a bench artifact "
                          "gates only the codec stages its codec_micro "
